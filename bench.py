@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Benchmark harness: char-GPT train tokens/sec/chip.
+
+Runs the BASELINE.json parity workload (char-GPT: 6L/6H/384C, block 256,
+batch 64 — BASELINE.md config 1/2) as jitted bf16 train steps on the
+available accelerator and reports steady-state throughput.
+
+vs_baseline is the ratio against the PyTorch-CPU reference path
+(replicatinggpt_tpu/reference_torch.py) on this machine — the BASELINE.md
+target is >50x ("reach reference loss in <1/50 wall-clock", and step time
+dominates wall-clock at fixed iteration count). The CPU measurement is
+cached in BENCH_BASELINE_CACHE.json so repeated bench runs don't re-pay it.
+
+Prints exactly ONE JSON line to stdout; all narration goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_BASELINE_CACHE.json")
+
+
+def torch_cpu_baseline(mcfg, batch_size: int, remeasure: bool) -> float:
+    key = (f"char_gpt_L{mcfg.n_layer}_H{mcfg.n_head}_C{mcfg.n_embd}"
+           f"_T{mcfg.block_size}_B{batch_size}")
+    cache = {}
+    if os.path.exists(CACHE_PATH):
+        try:
+            with open(CACHE_PATH) as f:
+                cache = json.load(f)
+        except Exception:
+            cache = {}
+    if not remeasure and key in cache:
+        log(f"torch-CPU baseline (cached): {cache[key]:,.0f} tok/s")
+        return cache[key]
+    log("measuring torch-CPU reference baseline (few steps)...")
+    import torch
+
+    from replicatinggpt_tpu.reference_torch import measure_train_throughput
+    torch.set_num_threads(os.cpu_count() or 8)
+    tps = measure_train_throughput(mcfg, batch_size=batch_size, steps=3,
+                                   warmup=1)
+    cache[key] = tps
+    try:
+        with open(CACHE_PATH, "w") as f:
+            json.dump(cache, f, indent=1)
+    except OSError:
+        pass
+    log(f"torch-CPU baseline: {tps:,.0f} tok/s")
+    return tps
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="char-gpt")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--remeasure-baseline", action="store_true")
+    p.add_argument("--skip-baseline", action="store_true",
+                   help="report vs_baseline from cache or 0 if absent")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. 'cpu'); note the "
+                        "JAX_PLATFORMS env var is overridden by PJRT "
+                        "plugins in some environments — this flag uses "
+                        "jax.config, which always wins")
+    args = p.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.data.dataset import TokenDataset, load_corpus
+    from replicatinggpt_tpu.data.loader import RandomBatcher, prefetch
+    from replicatinggpt_tpu.tokenizers import get_tokenizer
+    from replicatinggpt_tpu.train.state import create_train_state
+    from replicatinggpt_tpu.train.steps import make_train_step
+
+    cfg = get_config(args.preset)
+    mcfg, tcfg = cfg.model, cfg.train
+    B, T = args.batch_size, mcfg.block_size
+    dev = jax.devices()[0]
+    log(f"benchmark device: {dev.platform} ({dev.device_kind}), "
+        f"model {mcfg.n_layer}L/{mcfg.n_head}H/{mcfg.n_embd}C "
+        f"T={T} B={B} dtype={mcfg.dtype}")
+
+    # real input pipeline: tokenized Tiny Shakespeare, random windows
+    text = load_corpus(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    cfg.dataset))
+    tok = get_tokenizer(cfg.tokenizer, corpus_text=text)
+    ds = TokenDataset.from_text(text, tok, tcfg.val_fraction)
+    batcher = RandomBatcher(ds.train, B, T, seed=tcfg.seed)
+
+    state = create_train_state(jax.random.PRNGKey(tcfg.seed), mcfg, tcfg)
+    step = make_train_step(mcfg, tcfg)
+    batches = prefetch(iter(batcher), depth=2)
+
+    log("compiling...")
+    t0 = time.perf_counter()
+    for _ in range(args.warmup):
+        state, metrics = step(state, next(batches))
+    jax.block_until_ready(metrics["loss"])
+    log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, next(batches))
+    loss = float(jax.device_get(metrics["loss"]))  # sync
+    dt = time.perf_counter() - t0
+    tps = B * T * args.steps / dt
+    log(f"{args.steps} steps in {dt:.2f}s -> {tps:,.0f} tok/s/chip, "
+        f"loss {loss:.4f}")
+    assert np.isfinite(loss)
+
+    if args.skip_baseline:
+        base = 0.0
+        if os.path.exists(CACHE_PATH):
+            try:
+                with open(CACHE_PATH) as f:
+                    base = list(json.load(f).values())[0]
+            except Exception:
+                base = 0.0
+    else:
+        base = torch_cpu_baseline(mcfg, B, args.remeasure_baseline)
+
+    print(json.dumps({
+        "metric": "char_gpt_train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps / base, 2) if base > 0 else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

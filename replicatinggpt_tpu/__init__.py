@@ -1,0 +1,17 @@
+"""replicatinggpt_tpu — a TPU-native GPT training/inference framework.
+
+A ground-up JAX/XLA/Pallas/pjit re-design with the capabilities of
+ChaitIITB/ReplicatingGPT (see SURVEY.md): char/BPE tokenization, GPT-1/GPT-2
+style decoder-only transformers, AdamW training with periodic eval,
+KV-cached autoregressive sampling, checkpoint save/resume, HF GPT-2 weight
+import — plus the TPU-native scaling layer the reference lacks: mesh-sharded
+DP/FSDP/TP/SP execution via XLA collectives, flash attention in Pallas, and
+ring attention for long context.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config, MeshConfig, ModelConfig, TrainConfig, get_config
+
+__all__ = ["Config", "ModelConfig", "TrainConfig", "MeshConfig",
+           "get_config", "__version__"]

@@ -1,0 +1,185 @@
+"""Command-line interface.
+
+The reference has no CLI — both scripts train at import time with
+module-global hyperparameters (SURVEY.md §1 L6, §8-Q9). This CLI exposes
+every pipeline as a subcommand over the preset/override config system:
+
+    python -m replicatinggpt_tpu train    --preset char-gpt
+    python -m replicatinggpt_tpu generate --preset char-gpt --checkpoint ...
+    python -m replicatinggpt_tpu import-hf --model-type gpt2
+    python -m replicatinggpt_tpu eval     --preset char-gpt --checkpoint ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .config import add_config_flags, config_from_args, get_config
+
+
+def _build_mesh_if_needed(cfg):
+    import jax
+    if cfg.mesh.n_devices <= 1 and not cfg.mesh.fsdp:
+        return None
+    from .parallel.mesh import make_mesh
+    n = cfg.mesh.n_devices
+    if len(jax.devices()) < n:
+        print(f"warning: mesh wants {n} devices, have "
+              f"{len(jax.devices())}; running unsharded", file=sys.stderr)
+        return None
+    return make_mesh(cfg.mesh)
+
+
+def cmd_train(args) -> int:
+    cfg = config_from_args(args)
+    from .train.checkpoint import CheckpointManager
+    from .train.runner import train
+    from .utils.logging import StepLogger
+    logger = StepLogger(jsonl_path=args.log_jsonl)
+    ck = (CheckpointManager(args.checkpoint_dir)
+          if args.checkpoint_dir else None)
+    mesh = _build_mesh_if_needed(cfg)
+    res = train(cfg, mesh=mesh, logger=logger, checkpoint_manager=ck,
+                resume=args.resume)
+    if args.sample_after:
+        _sample(res.state.params, cfg, res.tokenizer, args.sample_tokens)
+    if ck:
+        ck.wait()
+    return 0
+
+
+def _sample(params, cfg, tokenizer, n_tokens: int, prompt_text: str = None,
+            top_k: int = 0, temperature: float = 1.0) -> None:
+    import jax.numpy as jnp
+    import numpy as np
+    from .sample import GenerateConfig, generate
+    if prompt_text:
+        prompt = np.asarray([tokenizer.encode(prompt_text)], np.int32)
+    else:
+        # the reference's zero-context start (GPT1.py:235)
+        prompt = np.zeros((1, 1), np.int32)
+    toks = generate(params, jnp.asarray(prompt), cfg.model,
+                    GenerateConfig(max_new_tokens=n_tokens, top_k=top_k,
+                                   temperature=temperature))
+    print(tokenizer.decode(np.asarray(toks)[0].tolist()))
+
+
+def cmd_generate(args) -> int:
+    import jax
+    cfg = config_from_args(args)
+    from .data.dataset import load_corpus
+    from .tokenizers import get_tokenizer
+    from .train.checkpoint import CheckpointManager
+    from .train.runner import _resolve_vocab
+    from .train.state import create_train_state
+    text = load_corpus(cfg.dataset)
+    tokenizer = get_tokenizer(cfg.tokenizer, corpus_text=text)
+    cfg = _resolve_vocab(cfg, tokenizer)
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
+                               cfg.model, cfg.train)
+    if args.checkpoint_dir:
+        ck = CheckpointManager(args.checkpoint_dir)
+        restored = ck.restore_latest(state)
+        if restored is None:
+            print("no checkpoint found; sampling from random init",
+                  file=sys.stderr)
+        else:
+            state = restored
+    _sample(state.params, cfg, tokenizer, args.sample_tokens,
+            prompt_text=args.prompt, top_k=args.top_k,
+            temperature=args.temperature)
+    return 0
+
+
+def cmd_import_hf(args) -> int:
+    from .interop.hf import from_pretrained
+    params, mcfg = from_pretrained(args.model_type)
+    from .models.gpt import param_count
+    print(f"imported {args.model_type}: {param_count(params):,} params, "
+          f"{mcfg.n_layer}L/{mcfg.n_head}H/{mcfg.n_embd}C")
+    if args.save_dir:
+        import jax.numpy as jnp
+        import jax
+        from .train.checkpoint import CheckpointManager
+        from .train.state import TrainState
+        state = TrainState(step=jnp.zeros((), jnp.int32),
+                           params=params, opt_state=(),
+                           rng=jax.random.PRNGKey(0))
+        ck = CheckpointManager(args.save_dir)
+        ck.save(state, wait=True)
+        print(f"saved to {args.save_dir}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    import jax
+    cfg = config_from_args(args)
+    from .data.dataset import TokenDataset, load_corpus
+    from .data.loader import make_batcher
+    from .tokenizers import get_tokenizer
+    from .train.checkpoint import CheckpointManager
+    from .train.runner import _resolve_vocab
+    from .train.state import create_train_state
+    from .train.steps import estimate_loss, make_eval_step
+    text = load_corpus(cfg.dataset)
+    tokenizer = get_tokenizer(cfg.tokenizer, corpus_text=text)
+    cfg = _resolve_vocab(cfg, tokenizer)
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
+                               cfg.model, cfg.train)
+    if args.checkpoint_dir:
+        state = (CheckpointManager(args.checkpoint_dir)
+                 .restore_latest(state) or state)
+    ds = TokenDataset.from_text(text, tokenizer, cfg.train.val_fraction)
+    batchers = {
+        "train": make_batcher("random", ds.train, cfg.train.batch_size,
+                              cfg.model.block_size, seed=1),
+        "val": make_batcher("random", ds.val, cfg.train.batch_size,
+                            cfg.model.block_size, seed=2),
+    }
+    out = estimate_loss(state.params, batchers, make_eval_step(cfg.model),
+                        cfg.train.eval_iters)
+    print(f"train loss {out['train']:.4f}, val loss = {out['val']:.4f}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="replicatinggpt_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pt = sub.add_parser("train", help="train a model")
+    add_config_flags(pt)
+    pt.add_argument("--checkpoint-dir", default=None)
+    pt.add_argument("--resume", action="store_true")
+    pt.add_argument("--log-jsonl", default=None)
+    pt.add_argument("--sample-after", action="store_true",
+                    help="print a sample after training (GPT1.py:235-236)")
+    pt.add_argument("--sample-tokens", type=int, default=500)
+    pt.set_defaults(fn=cmd_train)
+
+    pg = sub.add_parser("generate", help="sample from a model")
+    add_config_flags(pg)
+    pg.add_argument("--checkpoint-dir", default=None)
+    pg.add_argument("--prompt", default=None)
+    pg.add_argument("--sample-tokens", type=int, default=500)
+    pg.add_argument("--top-k", type=int, default=0)
+    pg.add_argument("--temperature", type=float, default=1.0)
+    pg.set_defaults(fn=cmd_generate)
+
+    pi = sub.add_parser("import-hf", help="import HF GPT-2 weights")
+    pi.add_argument("--model-type", default="gpt2",
+                    choices=["gpt2", "gpt2-medium", "gpt2-large", "gpt2-xl"])
+    pi.add_argument("--save-dir", default=None)
+    pi.set_defaults(fn=cmd_import_hf)
+
+    pe = sub.add_parser("eval", help="estimate train/val loss")
+    add_config_flags(pe)
+    pe.add_argument("--checkpoint-dir", default=None)
+    pe.set_defaults(fn=cmd_eval)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,307 @@
+"""Configuration system for the TPU-native GPT framework.
+
+Unifies the reference's three scattered config surfaces (module-level globals
+in GPT1.py:12-23 and GPT-2.py:6-16, plus the GPTConfig dataclass at
+GPT-2.py:81-87) into frozen dataclasses with named presets covering every
+configuration the reference can express, and the five BASELINE.json workloads.
+
+Everything is hashable/frozen so configs can be closed over by ``jax.jit`` as
+static arguments without retracing surprises.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture of a decoder-only pre-LN transformer LM.
+
+    One definition serves both reference flavors (GPT1.py:100-212 and
+    GPT-2.py:22-128); they differ only in field values:
+
+    - GPT-1 flavor: untied lm_head (GPT1.py:174), ReLU MLP (GPT1.py:144),
+      dropout 0.2.
+    - GPT-2 flavor: tied wte/lm_head (GPT-2.py:104), GELU MLP (GPT-2.py:62),
+      fused QKV (always fused here; the per-head Python loop of GPT1.py:130
+      is a strictly worse formulation on any hardware).
+    """
+
+    vocab_size: int = 65
+    block_size: int = 256
+    n_layer: int = 6
+    n_head: int = 6
+    n_embd: int = 384
+    dropout: float = 0.2          # residual + MLP dropout (GPT1.py:147)
+    attn_dropout: float = 0.2     # dropout on attention weights (GPT1.py:117)
+    tied_head: bool = True        # GPT-2.py:104 weight tying; False = GPT1.py:174
+    activation: str = "gelu"      # 'gelu' (GPT-2.py:62) or 'relu' (GPT1.py:144)
+    layernorm_eps: float = 1e-5
+    init_std: float = 0.02        # GPT-2 paper init; reference's NANOGPT_SCALE_INIT
+                                  # tag (GPT-2.py:31,59) is honored here for real:
+                                  # residual projections get std/sqrt(2*n_layer)
+    # --- numerics -----------------------------------------------------------
+    dtype: str = "bfloat16"       # activation/compute dtype on TPU (MXU-native)
+    param_dtype: str = "float32"  # master params stay f32
+    # --- execution ----------------------------------------------------------
+    attention_impl: str = "auto"  # 'auto' | 'einsum' | 'flash' | 'ring'
+    remat: bool = False           # jax.checkpoint each block (HBM <-> FLOPs)
+    scan_layers: bool = True      # lax.scan over stacked layer params
+
+    @property
+    def head_dim(self) -> int:
+        assert self.n_embd % self.n_head == 0, (
+            f"n_embd={self.n_embd} not divisible by n_head={self.n_head}"
+        )
+        return self.n_embd // self.n_head
+
+    def validate(self) -> "ModelConfig":
+        _ = self.head_dim
+        assert self.activation in ("gelu", "relu"), self.activation
+        assert self.attention_impl in ("auto", "einsum", "flash", "ring")
+        return self
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. Axis names are fixed framework-wide.
+
+    - ``data``: data parallelism (batch dim) + FSDP parameter sharding
+    - ``seq``:  sequence/context parallelism (ring attention over ICI)
+    - ``model``: tensor parallelism (column/row-parallel matmuls)
+
+    The reference has no distributed machinery (SURVEY.md §2.1-§2.2); this is
+    the TPU-native replacement: XLA GSPMD collectives derived from
+    NamedSharding annotations over this mesh.
+    """
+
+    data: int = 1
+    seq: int = 1
+    model: int = 1
+    fsdp: bool = False  # additionally shard params/opt-state over 'data'
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.seq * self.model
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("data", "seq", "model")
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Optimization + loop schedule.
+
+    Reference semantics preserved: AdamW (GPT1.py:218), periodic mean-of-K
+    train/val eval (GPT1.py:85-98, eval_interval GPT1.py:223), per-step loss
+    logging (GPT-2.py:229). The committed lr=5e-1 bug (GPT1.py:218) is fixed
+    to the declared 2e-4 (GPT1.py:17) per SURVEY.md §8-B4.
+    """
+
+    batch_size: int = 64
+    lr: float = 2e-4
+    betas: Tuple[float, float] = (0.9, 0.999)
+    weight_decay: float = 0.01
+    grad_clip: float = 0.0           # 0 = off (reference has none)
+    max_iters: int = 3000
+    warmup_iters: int = 0
+    lr_schedule: str = "constant"    # 'constant' | 'cosine'
+    min_lr: float = 0.0
+    eval_interval: int = 200
+    eval_iters: int = 200
+    log_interval: int = 10
+    seed: int = 1337                 # GPT1.py:10
+    sampling: str = "random"         # 'random' (GPT1.py:75-83) |
+                                     # 'sequential' (GPT-2.py:200-213)
+    val_fraction: float = 0.1        # 90/10 split, GPT1.py:68-70
+    checkpoint_every: int = 0        # 0 = only at end
+    checkpoint_dir: str = "checkpoints"
+
+
+@dataclass(frozen=True)
+class Config:
+    model: ModelConfig = ModelConfig()
+    train: TrainConfig = TrainConfig()
+    mesh: MeshConfig = MeshConfig()
+    tokenizer: str = "char"          # 'char' | 'bpe' | 'bpe:<path>' |
+                                     # 'tiktoken:gpt2' | 'tiktoken:o200k_base'
+    dataset: str = "datasets/shakespeare.txt"
+    name: str = "default"
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+
+# ---------------------------------------------------------------------------
+# Presets: every configuration the reference can express + BASELINE workloads
+# ---------------------------------------------------------------------------
+
+def _gpt2_ladder(n_layer: int, n_head: int, n_embd: int) -> ModelConfig:
+    # Size table from GPT-2.py:140-147 (vocab 50257, context 1024).
+    return ModelConfig(
+        vocab_size=50257, block_size=1024, n_layer=n_layer, n_head=n_head,
+        n_embd=n_embd, dropout=0.0, attn_dropout=0.0, tied_head=True,
+        activation="gelu",
+    )
+
+
+PRESETS = {
+    # BASELINE.json config 1/2: canonical char-GPT (n_embd=384 per
+    # BASELINE.md; GPT1.py semantics: untied head, ReLU, dropout 0.2).
+    "char-gpt": Config(
+        name="char-gpt",
+        model=ModelConfig(
+            vocab_size=65, block_size=256, n_layer=6, n_head=6, n_embd=384,
+            dropout=0.2, attn_dropout=0.2, tied_head=False, activation="relu",
+        ),
+        train=TrainConfig(batch_size=64, lr=2e-4, max_iters=3000,
+                          eval_interval=200, eval_iters=200, seed=1337,
+                          sampling="random"),
+        tokenizer="char",
+    ),
+    # The GPT1.py file exactly as committed (n_embd=126), for parity audits.
+    "char-gpt1-ref": Config(
+        name="char-gpt1-ref",
+        model=ModelConfig(
+            vocab_size=65, block_size=256, n_layer=6, n_head=6, n_embd=126,
+            dropout=0.2, attn_dropout=0.2, tied_head=False, activation="relu",
+        ),
+        train=TrainConfig(batch_size=64, lr=2e-4, max_iters=3000,
+                          eval_interval=200, eval_iters=200, seed=1337,
+                          sampling="random"),
+        tokenizer="char",
+    ),
+    # The GPT-2.py training run as intended (B=4/T=32/50 iters,
+    # lr 3e-4, sequential loader; vocab fixed to the tokenizer's per §8-B5).
+    "gpt2-shakespeare": Config(
+        name="gpt2-shakespeare",
+        model=ModelConfig(
+            vocab_size=50304, block_size=256, n_layer=6, n_head=6, n_embd=384,
+            dropout=0.0, attn_dropout=0.0, tied_head=True, activation="gelu",
+        ),
+        train=TrainConfig(batch_size=4, lr=3e-4, max_iters=50,
+                          eval_interval=0, eval_iters=20, seed=1337,
+                          sampling="sequential", log_interval=1),
+        tokenizer="bpe",
+    ),
+    # BASELINE.json config 3: GPT-2 124M, 8-chip DP.
+    "gpt2-small": Config(
+        name="gpt2-small",
+        model=_gpt2_ladder(12, 12, 768),
+        train=TrainConfig(batch_size=32, lr=3e-4, max_iters=1000,
+                          sampling="sequential", lr_schedule="cosine",
+                          warmup_iters=100, grad_clip=1.0),
+        mesh=MeshConfig(data=8),
+        tokenizer="bpe",
+    ),
+    # BASELINE.json config 4: GPT-2 350M, v4-32, bf16, FSDP.
+    "gpt2-medium": Config(
+        name="gpt2-medium",
+        model=_gpt2_ladder(24, 16, 1024),
+        train=TrainConfig(batch_size=64, lr=3e-4, max_iters=1000,
+                          sampling="sequential", lr_schedule="cosine",
+                          warmup_iters=100, grad_clip=1.0),
+        mesh=MeshConfig(data=16, fsdp=True),
+        tokenizer="bpe",
+    ),
+    "gpt2-large": Config(
+        name="gpt2-large", model=_gpt2_ladder(36, 20, 1280),
+        mesh=MeshConfig(data=16, fsdp=True), tokenizer="bpe",
+    ),
+    "gpt2-xl": Config(
+        name="gpt2-xl", model=_gpt2_ladder(48, 25, 1600),
+        mesh=MeshConfig(data=16, fsdp=True), tokenizer="bpe",
+    ),
+    # Tiny config for tests / smoke runs.
+    "test-tiny": Config(
+        name="test-tiny",
+        model=ModelConfig(
+            vocab_size=65, block_size=32, n_layer=2, n_head=2, n_embd=32,
+            dropout=0.0, attn_dropout=0.0, tied_head=True, activation="gelu",
+            dtype="float32",
+        ),
+        train=TrainConfig(batch_size=8, lr=1e-3, max_iters=50,
+                          eval_interval=25, eval_iters=4, log_interval=10),
+        tokenizer="char",
+    ),
+}
+
+
+def get_config(name: str, **overrides) -> Config:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    cfg = PRESETS[name]
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+# ---------------------------------------------------------------------------
+# CLI overlay (the reference has no CLI at all — SURVEY.md §5 config row)
+# ---------------------------------------------------------------------------
+
+def add_config_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--preset", default="char-gpt", choices=sorted(PRESETS))
+    p.add_argument("--backend", default="jax", choices=["jax"],
+                   help="execution backend (BASELINE.json names --backend=jax)")
+    # model overrides
+    for f in ("vocab_size", "block_size", "n_layer", "n_head", "n_embd"):
+        p.add_argument(f"--{f}", type=int, default=None)
+    p.add_argument("--dropout", type=float, default=None)
+    p.add_argument("--dtype", type=str, default=None)
+    p.add_argument("--attention", dest="attention_impl", default=None,
+                   choices=["auto", "einsum", "flash", "ring"])
+    # train overrides
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--max-iters", type=int, default=None)
+    p.add_argument("--eval-interval", type=int, default=None)
+    p.add_argument("--eval-iters", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    # mesh overrides
+    p.add_argument("--dp", type=int, default=None, help="mesh data axis size")
+    p.add_argument("--sp", type=int, default=None, help="mesh seq axis size")
+    p.add_argument("--tp", type=int, default=None, help="mesh model axis size")
+    p.add_argument("--fsdp", action="store_true", default=None)
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--dataset", default=None)
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    cfg = get_config(args.preset)
+    m, t, mesh = cfg.model, cfg.train, cfg.mesh
+    mk = {k: v for k, v in (
+        ("vocab_size", args.vocab_size), ("block_size", args.block_size),
+        ("n_layer", args.n_layer), ("n_head", args.n_head),
+        ("n_embd", args.n_embd), ("dropout", args.dropout),
+        ("dtype", args.dtype), ("attention_impl", args.attention_impl),
+    ) if v is not None}
+    if args.dropout is not None:
+        mk["attn_dropout"] = args.dropout
+    tk = {k: v for k, v in (
+        ("batch_size", args.batch_size), ("lr", args.lr),
+        ("max_iters", args.max_iters), ("eval_interval", args.eval_interval),
+        ("eval_iters", args.eval_iters), ("seed", args.seed),
+    ) if v is not None}
+    meshk = {k: v for k, v in (
+        ("data", args.dp), ("seq", args.sp), ("model", args.tp),
+        ("fsdp", args.fsdp),
+    ) if v is not None}
+    ck = {}
+    if args.tokenizer is not None:
+        ck["tokenizer"] = args.tokenizer
+    if args.dataset is not None:
+        ck["dataset"] = args.dataset
+    return cfg.replace(
+        model=dataclasses.replace(m, **mk).validate(),
+        train=dataclasses.replace(t, **tk),
+        mesh=dataclasses.replace(mesh, **meshk),
+        **ck,
+    )

@@ -1,0 +1,7 @@
+from .dataset import TokenDataset, load_corpus
+from .loader import RandomBatcher, SequentialBatcher, make_batcher, prefetch
+
+__all__ = [
+    "TokenDataset", "load_corpus", "RandomBatcher", "SequentialBatcher",
+    "make_batcher", "prefetch",
+]

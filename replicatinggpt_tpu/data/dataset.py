@@ -1,0 +1,38 @@
+"""Corpus loading and tokenized train/val splits.
+
+Replaces the reference's import-time corpus handling (GPT1.py:25-70): read
+text, tokenize once, 90/10 split. Tokens are held host-side as a NumPy array;
+device placement happens in the batcher/prefetcher (the reference instead did
+a synchronous ``.to(device)`` per step, GPT1.py:81).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+def load_corpus(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+@dataclasses.dataclass
+class TokenDataset:
+    """Tokenized corpus with a train/val split (GPT1.py:68-70 semantics)."""
+
+    train: np.ndarray  # int32 [n_train]
+    val: np.ndarray    # int32 [n_val]
+    vocab_size: int
+
+    @classmethod
+    def from_text(cls, text: str, tokenizer, val_fraction: float = 0.1
+                  ) -> "TokenDataset":
+        ids = np.asarray(tokenizer.encode(text), dtype=np.int32)
+        n = int(len(ids) * (1.0 - val_fraction))
+        return cls(train=ids[:n], val=ids[n:], vocab_size=tokenizer.vocab_size)
+
+    def split(self, name: str) -> np.ndarray:
+        assert name in ("train", "val"), name
+        return self.train if name == "train" else self.val

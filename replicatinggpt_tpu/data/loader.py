@@ -1,0 +1,150 @@
+"""Batchers + async device prefetch.
+
+Two sampling disciplines, matching the reference's two loaders:
+
+- :class:`RandomBatcher` — uniform random windows, fresh each step
+  (``get_batch``, GPT1.py:75-83).
+- :class:`SequentialBatcher` — contiguous ``B*T+1`` windows with wraparound
+  and a persistent cursor (``DataLoaderLite``, GPT-2.py:187-213). The cursor
+  is exposed as checkpointable state (the reference lost it on crash).
+
+Both yield ``(x, y)`` NumPy int32 arrays of shape (B, T) with y = x shifted
+by one. :func:`prefetch` overlaps host batch assembly + H2D transfer with
+device compute (the reference's per-step synchronous ``.to(device)`` at
+GPT1.py:81 is exactly the bubble this removes).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+Batch = Tuple[np.ndarray, np.ndarray]
+
+
+class RandomBatcher:
+    """Uniform random (B, T) windows — GPT1.py:75-83 semantics."""
+
+    def __init__(self, data: np.ndarray, batch_size: int, block_size: int,
+                 seed: int = 0):
+        assert len(data) > block_size + 1, "corpus shorter than block_size"
+        self.data = data
+        self.B, self.T = batch_size, block_size
+        self.rng = np.random.default_rng(seed)
+
+    def next_batch(self) -> Batch:
+        # exclusive high len-T: max start len-T-1, so y = data[i+1 : i+T+1]
+        # still fits (same bound as the reference's randint, GPT1.py:77)
+        ix = self.rng.integers(0, len(self.data) - self.T, size=self.B)
+        x = np.stack([self.data[i:i + self.T] for i in ix])
+        y = np.stack([self.data[i + 1:i + self.T + 1] for i in ix])
+        return x.astype(np.int32), y.astype(np.int32)
+
+    def __iter__(self) -> Iterator[Batch]:
+        while True:
+            yield self.next_batch()
+
+    # random sampling has no meaningful cursor; RNG state is the resume state
+    def state(self) -> dict:
+        return {"bit_generator": self.rng.bit_generator.state}
+
+    def restore(self, state: dict) -> None:
+        self.rng.bit_generator.state = state["bit_generator"]
+
+
+class SequentialBatcher:
+    """Contiguous windows with wraparound cursor — GPT-2.py:200-213 semantics."""
+
+    def __init__(self, data: np.ndarray, batch_size: int, block_size: int):
+        need = batch_size * block_size + 1
+        assert len(data) >= need, (
+            f"corpus of {len(data)} tokens cannot fill one {need}-token window")
+        self.data = data
+        self.B, self.T = batch_size, block_size
+        self.position = 0
+
+    def next_batch(self) -> Batch:
+        B, T = self.B, self.T
+        if self.position + B * T + 1 > len(self.data):
+            self.position = 0
+        buf = self.data[self.position:self.position + B * T + 1]
+        x = buf[:-1].reshape(B, T)
+        y = buf[1:].reshape(B, T)
+        self.position += B * T
+        return x.astype(np.int32), y.astype(np.int32)
+
+    def __iter__(self) -> Iterator[Batch]:
+        while True:
+            yield self.next_batch()
+
+    def state(self) -> dict:
+        return {"position": self.position}
+
+    def restore(self, state: dict) -> None:
+        self.position = int(state["position"])
+
+
+def make_batcher(kind: str, data: np.ndarray, batch_size: int,
+                 block_size: int, seed: int = 0):
+    if kind == "random":
+        return RandomBatcher(data, batch_size, block_size, seed)
+    if kind == "sequential":
+        return SequentialBatcher(data, batch_size, block_size)
+    raise ValueError(f"unknown sampling kind {kind!r}")
+
+
+def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2
+             ) -> Iterator:
+    """Move batches to device on a background thread, ``depth`` ahead.
+
+    ``sharding`` is an optional ``jax.sharding.Sharding`` for the global
+    (B, T) batch (data/seq-parallel layouts); None keeps the default single
+    -device placement.
+    """
+    import jax
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # bounded put that re-checks stop, so a full queue can't strand the
+        # producer thread (and its device-resident batches) after the
+        # consumer stops early
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        for b in batches:
+            if stop.is_set():
+                return
+            if sharding is not None:
+                b = tuple(jax.device_put(a, sharding) for a in b)
+            else:
+                b = tuple(jax.device_put(a) for a in b)
+            if not _put(b):
+                return
+        _put(None)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            b = q.get()
+            if b is None:
+                return
+            yield b
+    finally:
+        stop.set()
+        while not q.empty():  # release device references promptly
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

@@ -1,0 +1,5 @@
+from .hf import (GPT2_SIZES, import_hf_state_dict, model_config_from_hf,
+                 config_for_model_type, from_pretrained)
+
+__all__ = ["GPT2_SIZES", "import_hf_state_dict", "model_config_from_hf",
+           "config_for_model_type", "from_pretrained"]

@@ -1,0 +1,4 @@
+from .gpt import init_params, forward, param_count, init_kv_cache, decode_step
+
+__all__ = ["init_params", "forward", "param_count", "init_kv_cache",
+           "decode_step"]

@@ -1,0 +1,291 @@
+"""GPT as pure functions over a parameter pytree.
+
+Re-expresses the reference's module stacks (GPT1.py:100-212 and
+GPT-2.py:22-128) as ``init_params(rng, cfg) -> params`` and
+``forward(params, idx, cfg, ...) -> (logits, loss)``:
+
+- fused QKV projection (the GPT-2.py:28 formulation; GPT1's per-head Python
+  loop, GPT1.py:130-136, is strictly worse on any hardware),
+- pre-LN residual blocks (GPT1.py:162-165 / GPT-2.py:76-79),
+- learned positional embeddings (GPT1.py:170-171 / GPT-2.py:97),
+- optional weight tying (GPT-2.py:104) / untied head (GPT1.py:174) via
+  ``cfg.tied_head``,
+- GELU or ReLU MLP via ``cfg.activation``,
+- GPT-2-paper init (std 0.02, residual projections scaled by
+  1/sqrt(2*n_layer)) — the reference *tags* this intent
+  (NANOGPT_SCALE_INIT, GPT-2.py:31,59) but never applies it (SURVEY.md
+  §8-Q4); here it is real.
+
+Layer parameters are stacked along a leading (n_layer,) axis and the block
+stack runs under ``lax.scan`` — one compiled block body regardless of depth,
+which keeps compile time flat and maps cleanly onto pipeline/FSDP sharding.
+A KV-cache decode path shares the same block body (one position per step)
+for the lax.scan generation loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..ops.attention import cached_attention, full_causal_attention
+
+Params = Dict[str, Any]
+
+
+def _dtype(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16}[name]
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Initialize the parameter pytree. Shapes (C = n_embd, L = n_layer):
+
+    wte (V, C) · wpe (block, C) · per-layer stacked tensors with leading L ·
+    final layernorm · optional untied lm_head (C, V).
+    """
+    cfg.validate()
+    C, L, V = cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    pd = _dtype(cfg.param_dtype)
+    std = cfg.init_std
+    resid_std = std * (2 * L) ** -0.5
+    keys = jax.random.split(rng, 8)
+
+    def norm(key, shape, s):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(pd)
+
+    blocks = {
+        "ln1_scale": jnp.ones((L, C), pd),
+        "ln1_bias": jnp.zeros((L, C), pd),
+        "qkv_kernel": norm(keys[2], (L, C, 3 * C), std),
+        "qkv_bias": jnp.zeros((L, 3 * C), pd),
+        "attn_out_kernel": norm(keys[3], (L, C, C), resid_std),
+        "attn_out_bias": jnp.zeros((L, C), pd),
+        "ln2_scale": jnp.ones((L, C), pd),
+        "ln2_bias": jnp.zeros((L, C), pd),
+        "mlp_up_kernel": norm(keys[4], (L, C, 4 * C), std),
+        "mlp_up_bias": jnp.zeros((L, 4 * C), pd),
+        "mlp_down_kernel": norm(keys[5], (L, 4 * C, C), resid_std),
+        "mlp_down_bias": jnp.zeros((L, C), pd),
+    }
+    params: Params = {
+        "wte": norm(keys[0], (V, C), std),
+        "wpe": norm(keys[1], (cfg.block_size, C), std),
+        "blocks": blocks,
+        "ln_f_scale": jnp.ones((C,), pd),
+        "ln_f_bias": jnp.zeros((C,), pd),
+    }
+    if not cfg.tied_head:
+        params["lm_head"] = norm(keys[6], (C, V), std)
+    return params
+
+
+def param_count(params: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+                eps: float) -> jnp.ndarray:
+    # LN statistics in float32 for bf16 stability; result back in x.dtype.
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _dropout(x: jnp.ndarray, rate: float, rng: Optional[jax.Array],
+             train: bool) -> jnp.ndarray:
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+
+def _activation(x: jnp.ndarray, kind: str) -> jnp.ndarray:
+    return jax.nn.gelu(x) if kind == "gelu" else jax.nn.relu(x)
+
+
+def _split_heads(x: jnp.ndarray, n_head: int) -> jnp.ndarray:
+    B, T, C = x.shape
+    return x.reshape(B, T, n_head, C // n_head).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x: jnp.ndarray) -> jnp.ndarray:
+    B, H, T, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+
+
+def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
+           rng: Optional[jax.Array], train: bool,
+           attention_fn=None) -> jnp.ndarray:
+    """One pre-LN transformer block over a full (B, T, C) sequence.
+
+    ``attention_fn`` overrides the attention core (used by the ring-attention
+    sequence-parallel path); default picks einsum/flash per cfg.
+    """
+    cd = x.dtype
+    r_attn, r_drop1, r_drop2 = (jax.random.split(rng, 3)
+                                if rng is not None else (None, None, None))
+    h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_eps)
+    qkv = h @ lp["qkv_kernel"].astype(cd) + lp["qkv_bias"].astype(cd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = (_split_heads(t, cfg.n_head) for t in (q, k, v))
+    if attention_fn is not None:
+        attn = attention_fn(q, k, v)
+    else:
+        impl = cfg.attention_impl
+        if impl in ("auto", "ring"):
+            # 'ring' at the single-device level degrades to the local core;
+            # the sharded ring wrapper lives in parallel/ring_attention.py.
+            impl = "einsum"
+        attn = full_causal_attention(
+            q, k, v, dropout_rate=cfg.attn_dropout, rng=r_attn, train=train,
+            impl=impl)
+    attn = _merge_heads(attn)
+    attn = attn @ lp["attn_out_kernel"].astype(cd) + lp["attn_out_bias"].astype(cd)
+    # Projection dropout: declared-but-unapplied in the reference
+    # (GPT1.py:132,136, SURVEY.md §8-Q2); correct-by-default here.
+    x = x + _dropout(attn, cfg.dropout, r_drop1, train)
+    h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_eps)
+    h = _activation(h @ lp["mlp_up_kernel"].astype(cd)
+                    + lp["mlp_up_bias"].astype(cd), cfg.activation)
+    h = h @ lp["mlp_down_kernel"].astype(cd) + lp["mlp_down_bias"].astype(cd)
+    return x + _dropout(h, cfg.dropout, r_drop2, train)
+
+
+def _run_blocks(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
+                cfg: ModelConfig, *, rng: Optional[jax.Array], train: bool,
+                attention_fn=None) -> jnp.ndarray:
+    L = cfg.n_layer
+
+    def body(carry, inputs):
+        lp, layer_idx = inputs
+        r = (jax.random.fold_in(rng, layer_idx)
+             if rng is not None else None)
+        fn = _block
+        if cfg.remat:
+            fn = jax.checkpoint(
+                lambda c, p: _block(c, p, cfg, rng=r, train=train,
+                                    attention_fn=attention_fn))
+            return fn(carry, lp), None
+        return _block(carry, lp, cfg, rng=r, train=train,
+                      attention_fn=attention_fn), None
+
+    layer_ids = jnp.arange(L)
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(body, x, (blocks, layer_ids))
+        return x
+    for i in range(L):
+        lp = jax.tree_util.tree_map(lambda a: a[i], blocks)
+        x, _ = body(x, (lp, layer_ids[i]))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# forward (training / full-sequence)
+# ---------------------------------------------------------------------------
+
+def forward(params: Params, idx: jnp.ndarray, cfg: ModelConfig, *,
+            targets: Optional[jnp.ndarray] = None,
+            rng: Optional[jax.Array] = None, train: bool = False,
+            attention_fn=None) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Full-sequence forward. idx: (B, T) int32.
+
+    Always returns ``(logits, loss)``; loss is None without targets — the
+    reference's asymmetric return (GPT-2.py:124-128) is normalized away.
+    Cross-entropy is computed in float32 over flattened (B*T) positions
+    (GPT1.py:186-192 semantics).
+    """
+    B, T = idx.shape
+    cd = _dtype(cfg.dtype)
+    # Out-of-range ids would silently clamp on TPU gathers; the reference
+    # instead crashed (SURVEY.md §8-B1/B5). Config and tokenizer are
+    # validated host-side in the pipeline instead.
+    x = params["wte"].astype(cd)[idx] + params["wpe"].astype(cd)[:T]
+    x = _run_blocks(x, params["blocks"], cfg, rng=rng, train=train,
+                    attention_fn=attention_fn)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                    cfg.layernorm_eps)
+    head = (params["wte"].astype(cd).T if cfg.tied_head
+            else params["lm_head"].astype(cd))
+    logits = (x @ head).astype(jnp.float32)
+    if targets is None:
+        return logits, None
+    import optax
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits.reshape(B * T, -1), targets.reshape(B * T)).mean()
+    return logits, loss
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode path (shared weights, single-position block body)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: Optional[int] = None,
+                  dtype=None) -> Dict[str, jnp.ndarray]:
+    """Cache layout: (L, B, H, S, D) stacked over layers for lax.scan."""
+    S = max_len or cfg.block_size
+    dt = dtype or _dtype(cfg.dtype)
+    shape = (cfg.n_layer, batch, cfg.n_head, S, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
+                cache: Dict[str, jnp.ndarray], cfg: ModelConfig
+                ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One autoregressive step. idx_t: (B,) int32 current tokens; pos: scalar
+    int32 position. Returns (logits (B, V) float32, updated cache).
+
+    Replaces the reference's full re-forward per generated token
+    (GPT1.py:200-202) with O(T) work per token.
+    """
+    cd = _dtype(cfg.dtype)
+    B = idx_t.shape[0]
+    x = params["wte"].astype(cd)[idx_t] + params["wpe"].astype(cd)[pos]
+    x = x[:, None, :]  # (B, 1, C)
+
+    def body(carry, inputs):
+        h_in, = carry
+        lp, k_cache, v_cache = inputs
+        h = _layer_norm(h_in, lp["ln1_scale"], lp["ln1_bias"],
+                        cfg.layernorm_eps)
+        qkv = h @ lp["qkv_kernel"].astype(cd) + lp["qkv_bias"].astype(cd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (_split_heads(t, cfg.n_head) for t in (q, k, v))  # (B,H,1,D)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), pos, axis=2)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), pos, axis=2)
+        attn = cached_attention(q, k_cache, v_cache, pos)
+        attn = _merge_heads(attn)
+        attn = (attn @ lp["attn_out_kernel"].astype(cd)
+                + lp["attn_out_bias"].astype(cd))
+        h_mid = h_in + attn
+        h = _layer_norm(h_mid, lp["ln2_scale"], lp["ln2_bias"],
+                        cfg.layernorm_eps)
+        h = _activation(h @ lp["mlp_up_kernel"].astype(cd)
+                        + lp["mlp_up_bias"].astype(cd), cfg.activation)
+        h = h @ lp["mlp_down_kernel"].astype(cd) + lp["mlp_down_bias"].astype(cd)
+        return (h_mid + h,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                    cfg.layernorm_eps)
+    head = (params["wte"].astype(cd).T if cfg.tied_head
+            else params["lm_head"].astype(cd))
+    logits = (x[:, 0, :] @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}

@@ -1,0 +1,3 @@
+from .attention import full_causal_attention, cached_attention
+
+__all__ = ["full_causal_attention", "cached_attention"]

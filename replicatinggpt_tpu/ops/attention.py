@@ -1,0 +1,79 @@
+"""Attention cores.
+
+The reference materializes a full (T, T) attention matrix per head in a
+Python loop over heads (GPT1.py:109-123, 130-136) or calls torch SDPA
+(GPT-2.py:46). Here the batched multi-head core is a single einsum pair so
+XLA can tile it onto the MXU; a Pallas flash kernel (ops/flash_attention.py)
+replaces it on TPU for long sequences, and a ring variant
+(parallel/ring_attention.py) shards the sequence axis across chips.
+
+Conventions: q, k, v are (B, H, T, D); softmax runs in float32 regardless of
+compute dtype; scaling is by head_dim**-0.5 (the correct scaling — the
+reference's GPT1 path scales by n_embd**-0.5, SURVEY.md §8-Q1, reproducible
+via the ``scale`` argument if bit-parity with that quirk is ever needed).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
+                 # for fully-masked rows under bf16
+
+
+def _softmax_dropout(weights: jnp.ndarray, rate: float,
+                     rng: Optional[jax.Array], train: bool) -> jnp.ndarray:
+    # Dropout on attention weights (GPT1.py:117). Scaled (inverted) dropout.
+    if not train or rate <= 0.0 or rng is None:
+        return weights
+    keep = jax.random.bernoulli(rng, 1.0 - rate, weights.shape)
+    return jnp.where(keep, weights / (1.0 - rate), 0.0)
+
+
+def full_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                          scale: Optional[float] = None,
+                          dropout_rate: float = 0.0,
+                          rng: Optional[jax.Array] = None,
+                          train: bool = False,
+                          impl: str = "einsum") -> jnp.ndarray:
+    """Causal self-attention over a full sequence. q,k,v: (B, H, T, D)."""
+    if impl == "flash" and not (train and dropout_rate > 0.0):
+        # Flash path has no attention-weight dropout; callers fall back to
+        # einsum when training with attn dropout (semantics preserved).
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, scale=scale, causal=True)
+    *_, T, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    logits = jnp.where(kpos <= qpos, logits, NEG_INF)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights = _softmax_dropout(weights, dropout_rate, rng, train)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+def cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                     v_cache: jnp.ndarray, cache_index: jnp.ndarray, *,
+                     scale: Optional[float] = None) -> jnp.ndarray:
+    """Single-position decode attention against a KV cache.
+
+    q: (B, H, 1, D); caches: (B, H, S, D); cache_index: scalar int32 — the
+    position just written. Attends over positions <= cache_index. This is the
+    inner op of the lax.scan decode loop that replaces the reference's
+    O(T^2)-per-token re-forward generate (GPT1.py:196-212).
+    """
+    *_, S, D = k_cache.shape
+    if scale is None:
+        scale = D ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    logits = jnp.where(kpos <= cache_index, logits, NEG_INF)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v_cache.dtype), v_cache)

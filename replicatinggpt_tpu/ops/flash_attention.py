@@ -1,0 +1,46 @@
+"""Fused / flash attention entry point.
+
+``flash_attention(q, k, v)`` is the memory-efficient attention core used when
+``cfg.attention_impl == 'flash'`` (and by 'auto' on TPU): it avoids
+materializing the (T, T) weight matrix in HBM that the einsum path (and the
+reference, GPT1.py:114-116) allocates.
+
+Current implementation: a Pallas TPU kernel (blockwise online-softmax) with
+an XLA-SDPA fallback on non-TPU backends / unsupported shapes. The kernel
+lives in :mod:`.flash_pallas`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_sdpa(q, k, v, scale, causal):
+    # (B,H,T,D) -> jax.nn.dot_product_attention wants (B,T,H,D)
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    out = jax.nn.dot_product_attention(qt, kt, vt, scale=scale,
+                                       is_causal=causal)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _pallas_supported(q) -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    *_, T, D = q.shape
+    # kernel tiles: lane dim 128, sequence blocks of 128
+    return D in (32, 64, 128, 256) and T % 128 == 0 and T >= 128
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    scale: Optional[float] = None,
+                    causal: bool = True) -> jnp.ndarray:
+    """q, k, v: (B, H, T, D). Returns (B, H, T, D)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if _pallas_supported(q):
+        from .flash_pallas import pallas_flash_attention
+        return pallas_flash_attention(q, k, v, scale=scale, causal=causal)
+    return _xla_sdpa(q, k, v, scale, causal)

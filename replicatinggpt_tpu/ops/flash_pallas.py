@@ -1,0 +1,310 @@
+"""Pallas TPU flash attention: blockwise online-softmax, fwd + custom-VJP bwd.
+
+Replaces the O(T^2)-HBM attention the reference materializes per head
+(GPT1.py:114-116) with a fused kernel that keeps only (block_q, block_k)
+score tiles in VMEM. Forward follows the standard flash algorithm (running
+max m, running normalizer l, rescaled accumulator); backward recomputes
+score tiles blockwise from the saved logsumexp, producing dq in a q-major
+kernel and dk/dv in a kv-major kernel (no stored attention matrix anywhere).
+
+Layout notes (TPU): all tiles are (128, D) with D in {32, 64, 128, 256};
+score tiles are (128, 128) → MXU-native. LSE/delta are carried as (T,)
+rows per (batch*head) so their last dim stays lane-aligned at block 128.
+Causal masking skips fully-masked kv blocks entirely (the fori_loop upper
+bound is derived from the q-block index), so the kernel does ~half the
+FLOPs of the dense path on causal workloads.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on pure-CPU installs
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+BLOCK = 128
+NEG_INF = -1e30
+
+
+def _vmem_spec(block_shape, index_map):
+    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    return pl.BlockSpec(block_shape, index_map, **kw)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                seq_len, block_q, block_k):
+    j = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * scale          # (bq, D)
+    D = q.shape[-1]
+    q_first = j * block_q
+
+    if causal:
+        n_kv = (q_first + block_q + block_k - 1) // block_k
+    else:
+        n_kv = seq_len // block_k
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        if causal:
+            qpos = q_first + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_kv, body, (acc, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    B, H, T, D = q.shape
+    BH = B * H
+    qf = q.reshape(BH, T, D)
+    kf = k.reshape(BH, T, D)
+    vf = v.reshape(BH, T, D)
+    grid = (BH, T // block_q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               seq_len=T, block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+    )(qf, kf, vf)
+    return o.reshape(B, H, T, D), lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, seq_len, block_q, block_k):
+    j = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)                   # (bq, D)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, None]                          # (bq, 1)
+    delta = delta_ref[...][:, None]
+    q_first = j * block_q
+    if causal:
+        n_kv = (q_first + block_q + block_k - 1) // block_k
+    else:
+        n_kv = seq_len // block_k
+
+    def body(kb, dq):
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_first + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                             # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, n_kv,
+                           body, jnp.zeros_like(q))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, seq_len, block_q,
+                    block_k):
+    kb = pl.program_id(1)
+    k = k_ref[...].astype(jnp.float32)                   # (bk, D)
+    v = v_ref[...].astype(jnp.float32)
+    k_first = kb * block_k
+    n_q = seq_len // block_q
+    first_q = (k_first // block_q) if causal else 0
+
+    def body(jb, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(jb * block_q, block_q)][:, None]
+        delta = delta_ref[pl.ds(jb * block_q, block_q)][:, None]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        if causal:
+            qpos = jb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_first + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, D)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv0 = jnp.zeros_like(dk0)
+    dk, dv = jax.lax.fori_loop(first_q, n_q, body, (dk0, dv0))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
+    q, k, v, o, lse = residuals
+    B, H, T, D = q.shape
+    BH = B * H
+    delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
+                    axis=-1).reshape(BH, T)              # (BH, T)
+    qf, kf, vf = (t.reshape(BH, T, D) for t in (q, k, v))
+    gf = g.reshape(BH, T, D)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, seq_len=T,
+        block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, T // block_q),
+        in_specs=[
+            _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_q), lambda i, j: (i, j)),
+            _vmem_spec((None, block_q), lambda i, j: (i, j)),
+        ],
+        out_specs=_vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        interpret=_interpret_mode(),
+    )(qf, kf, vf, gf, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, seq_len=T,
+        block_q=block_q, block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH, T // block_k),
+        in_specs=[
+            _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, T), lambda i, j: (i, 0)),
+            _vmem_spec((None, T), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        ],
+        interpret=_interpret_mode(),
+    )(qf, kf, vf, gf, lse, delta)
+
+    shape = (B, H, T, D)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# public entry with custom VJP
+# ---------------------------------------------------------------------------
+
+_INTERPRET = False
+
+
+def _interpret_mode() -> bool:
+    return _INTERPRET or jax.default_backend() != "tpu"
+
+
+def set_interpret(flag: bool) -> None:
+    """Force interpreter mode (CPU testing)."""
+    global _INTERPRET
+    _INTERPRET = flag
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, residuals, g):
+    return _flash_bwd(scale, causal, block_q, block_k, residuals, g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def pallas_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                           scale: Optional[float] = None,
+                           causal: bool = True,
+                           block_q: int = BLOCK,
+                           block_k: int = BLOCK) -> jnp.ndarray:
+    """Flash attention. q,k,v: (B, H, T, D); T must be a multiple of the
+    block sizes (callers pad or fall back to the einsum path otherwise)."""
+    B, H, T, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    assert T % block_q == 0 and T % block_k == 0, (T, block_q, block_k)
+    return _flash(q, k, v, float(scale), bool(causal), block_q, block_k)

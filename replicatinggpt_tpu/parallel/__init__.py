@@ -1,0 +1,5 @@
+from .mesh import (make_mesh, make_batch_sharding, batch_pspec, state_pspecs,
+                   param_pspecs, shard_train_state)
+
+__all__ = ["make_mesh", "make_batch_sharding", "batch_pspec", "state_pspecs",
+           "param_pspecs", "shard_train_state"]

@@ -1,0 +1,147 @@
+"""Device mesh + partition rules: the framework's entire distributed layer.
+
+The reference has no distributed machinery (SURVEY.md §2.1-§2.2). The
+TPU-native replacement is declarative: build a ``jax.sharding.Mesh`` over
+axes ``('data', 'seq', 'model')``, attach ``NamedSharding``s to the train
+state and batches, and let XLA GSPMD insert the collectives (psum for DP
+grad reduction, all-gather for FSDP parameter gathering, reduce-scatter /
+all-reduce around the Megatron-style column/row-parallel matmuls) over
+ICI/DCN. No hand-written transport code exists anywhere in the framework —
+that is the point.
+
+Partition rules (Megatron-style TP over 'model', SURVEY.md §2.1 table):
+
+=====================  ==================  ==========================
+param                  shape               spec (layer-stacked dim 0)
+=====================  ==================  ==========================
+wte                    (V, C)              ('model', None) — vocab-parallel
+                                           embedding + tied head
+lm_head (untied)       (C, V)              (None, 'model')
+qkv_kernel             (L, C, 3C)          (None, None, 'model')  column
+attn_out_kernel        (L, C, C)           (None, 'model', None)  row
+mlp_up_kernel          (L, C, 4C)          (None, None, 'model')  column
+mlp_down_kernel        (L, 4C, C)          (None, 'model', None)  row
+biases of column ops   (L, K)              (None, 'model')
+everything else        —                   replicated
+=====================  ==================  ==========================
+
+FSDP (``MeshConfig.fsdp``) additionally shards each param (and its Adam
+moments, which inherit specs by tree-path) over 'data' on the largest
+still-unsharded divisible dim — ZeRO-3 semantics for free under GSPMD.
+Batches are (B, T) sharded ('data', 'seq').
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import MeshConfig, ModelConfig
+
+# param-name → (tp_dim or None); dims are indices into the *unstacked* shape
+# (block params carry a leading layer dim handled by offset)
+_COLUMN_PARALLEL = {"qkv_kernel", "mlp_up_kernel"}
+_COLUMN_BIAS = {"qkv_bias", "mlp_up_bias"}
+_ROW_PARALLEL = {"attn_out_kernel", "mlp_down_kernel"}
+
+
+def make_mesh(mesh_cfg: MeshConfig,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = mesh_cfg.n_devices
+    assert len(devices) >= n, (
+        f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(
+        mesh_cfg.data, mesh_cfg.seq, mesh_cfg.model)
+    return Mesh(arr, mesh_cfg.axis_names)
+
+
+def batch_pspec() -> P:
+    return P("data", "seq")
+
+
+def make_batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_pspec())
+
+
+def _tp_spec(name: str, ndim: int) -> list:
+    """Tensor-parallel placement for a leaf called ``name``."""
+    spec = [None] * ndim
+    if name == "wte":
+        spec[0] = "model"
+    elif name == "lm_head":
+        spec[1] = "model"
+    elif name in _COLUMN_PARALLEL:
+        spec[ndim - 1] = "model"
+    elif name in _COLUMN_BIAS:
+        spec[ndim - 1] = "model"
+    elif name in _ROW_PARALLEL:
+        spec[ndim - 2] = "model"
+    return spec
+
+
+def _leaf_spec(path, shape: Tuple[int, ...], mesh_cfg: MeshConfig) -> P:
+    """Spec for one leaf of the train state, identified by its tree path.
+
+    Works uniformly for params and optimizer moments because optax's
+    mu/nu subtrees mirror the params dict, so the param name appears as the
+    final DictKey on the path either way.
+    """
+    name = None
+    for k in reversed(path):
+        if isinstance(k, jax.tree_util.DictKey):
+            name = str(k.key)
+            break
+    ndim = len(shape)
+    spec = [None] * ndim
+    if name is not None and ndim > 0:
+        spec = _tp_spec(name, ndim)
+        # drop TP sharding where the dim isn't divisible by the axis size
+        for d, ax in enumerate(spec):
+            if ax == "model" and shape[d] % mesh_cfg.model != 0:
+                spec[d] = None
+    if mesh_cfg.fsdp and ndim > 0:
+        # shard the largest unsharded divisible dim over 'data' (ZeRO-3)
+        dims = sorted(range(ndim), key=lambda d: -shape[d])
+        for d in dims:
+            if spec[d] is None and shape[d] % mesh_cfg.data == 0 \
+                    and shape[d] >= mesh_cfg.data:
+                spec[d] = "data"
+                break
+    return P(*spec)
+
+
+def state_pspecs(tree: Any, mesh_cfg: MeshConfig) -> Any:
+    """PartitionSpec pytree for any state-shaped tree (TrainState, params,
+    opt_state, ...). Scalars / unnamed leaves replicate."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _leaf_spec(path, tuple(leaf.shape), mesh_cfg),
+        tree)
+
+
+def state_shardings(tree: Any, mesh: Mesh, mesh_cfg: MeshConfig) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), state_pspecs(tree, mesh_cfg))
+
+
+def param_pspecs(mcfg: ModelConfig, mesh_cfg: MeshConfig) -> Any:
+    """Specs for just the model params (used by checkpoint restore and the
+    HF importer)."""
+    from ..models.gpt import init_params
+    abstract = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), mcfg))
+    return state_pspecs(abstract, mesh_cfg)
+
+
+def shard_train_state(create_fn: Callable[[], Any], mesh: Mesh,
+                      mesh_cfg: MeshConfig) -> Any:
+    """Initialize train state directly in its sharded layout: jit the
+    initializer with out_shardings so every device materializes only its own
+    parameter/optimizer shards (no host-side full copy)."""
+    abstract = jax.eval_shape(create_fn)
+    shardings = state_shardings(abstract, mesh, mesh_cfg)
+    with jax.set_mesh(mesh):
+        return jax.jit(create_fn, out_shardings=shardings)()

@@ -1,0 +1,203 @@
+"""PyTorch-CPU reference backend.
+
+BASELINE.json's north star keeps "the PyTorch path … as the CPU reference"
+sharing the tokenizer and config with the JAX backend. This module is that
+path: a from-scratch torch implementation of the same architecture driven by
+the same :class:`~replicatinggpt_tpu.config.ModelConfig`, with lossless
+weight transfer to/from the JAX param pytree. It serves three roles:
+
+1. numerical parity oracle for the JAX model (tests/test_torch_parity.py);
+2. the CPU-reference throughput baseline for bench.py (the "<1/50
+   wall-clock" BASELINE.md target is measured against this);
+3. the capability equivalent of the reference's torch training path
+   (GPT1.py/GPT-2.py), with their §8 bugs fixed.
+
+Weights are stored in the same (in, out) kernel layout as the JAX pytree
+(applied as ``x @ W``), so transfer is a plain tree copy — no transposes.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+from .config import ModelConfig
+
+
+def _act(x: torch.Tensor, kind: str) -> torch.Tensor:
+    # matches jax.nn.gelu's default tanh approximation
+    return F.gelu(x, approximate="tanh") if kind == "gelu" else F.relu(x)
+
+
+class RefBlock(nn.Module):
+    def __init__(self, cfg: ModelConfig):
+        super().__init__()
+        C = cfg.n_embd
+        self.cfg = cfg
+        self.ln1_scale = nn.Parameter(torch.ones(C))
+        self.ln1_bias = nn.Parameter(torch.zeros(C))
+        self.qkv_kernel = nn.Parameter(torch.empty(C, 3 * C))
+        self.qkv_bias = nn.Parameter(torch.zeros(3 * C))
+        self.attn_out_kernel = nn.Parameter(torch.empty(C, C))
+        self.attn_out_bias = nn.Parameter(torch.zeros(C))
+        self.ln2_scale = nn.Parameter(torch.ones(C))
+        self.ln2_bias = nn.Parameter(torch.zeros(C))
+        self.mlp_up_kernel = nn.Parameter(torch.empty(C, 4 * C))
+        self.mlp_up_bias = nn.Parameter(torch.zeros(4 * C))
+        self.mlp_down_kernel = nn.Parameter(torch.empty(4 * C, C))
+        self.mlp_down_bias = nn.Parameter(torch.zeros(C))
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        cfg = self.cfg
+        B, T, C = x.shape
+        H, D = cfg.n_head, cfg.head_dim
+        h = F.layer_norm(x, (C,), self.ln1_scale, self.ln1_bias,
+                         cfg.layernorm_eps)
+        qkv = h @ self.qkv_kernel + self.qkv_bias
+        q, k, v = qkv.split(C, dim=-1)
+        q, k, v = (t.view(B, T, H, D).transpose(1, 2) for t in (q, k, v))
+        attn = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=cfg.attn_dropout if self.training else 0.0)
+        attn = attn.transpose(1, 2).reshape(B, T, C)
+        attn = attn @ self.attn_out_kernel + self.attn_out_bias
+        x = x + F.dropout(attn, cfg.dropout, self.training)
+        h = F.layer_norm(x, (C,), self.ln2_scale, self.ln2_bias,
+                         cfg.layernorm_eps)
+        h = _act(h @ self.mlp_up_kernel + self.mlp_up_bias, cfg.activation)
+        h = h @ self.mlp_down_kernel + self.mlp_down_bias
+        return x + F.dropout(h, cfg.dropout, self.training)
+
+
+class RefGPT(nn.Module):
+    """Decoder-only LM with the framework's exact architecture semantics."""
+
+    def __init__(self, cfg: ModelConfig):
+        super().__init__()
+        cfg.validate()
+        self.cfg = cfg
+        C, V = cfg.n_embd, cfg.vocab_size
+        self.wte = nn.Parameter(torch.empty(V, C))
+        self.wpe = nn.Parameter(torch.empty(cfg.block_size, C))
+        self.blocks = nn.ModuleList(RefBlock(cfg)
+                                    for _ in range(cfg.n_layer))
+        self.ln_f_scale = nn.Parameter(torch.ones(C))
+        self.ln_f_bias = nn.Parameter(torch.zeros(C))
+        if not cfg.tied_head:
+            self.lm_head = nn.Parameter(torch.empty(C, V))
+        self._init()
+
+    def _init(self):
+        cfg = self.cfg
+        std, rstd = cfg.init_std, cfg.init_std * (2 * cfg.n_layer) ** -0.5
+        with torch.no_grad():
+            self.wte.normal_(0, std)
+            self.wpe.normal_(0, std)
+            if not cfg.tied_head:
+                self.lm_head.normal_(0, std)
+            for b in self.blocks:
+                b.qkv_kernel.normal_(0, std)
+                b.mlp_up_kernel.normal_(0, std)
+                b.attn_out_kernel.normal_(0, rstd)
+                b.mlp_down_kernel.normal_(0, rstd)
+
+    def forward(self, idx: torch.Tensor,
+                targets: Optional[torch.Tensor] = None
+                ) -> Tuple[torch.Tensor, Optional[torch.Tensor]]:
+        cfg = self.cfg
+        B, T = idx.shape
+        assert T <= cfg.block_size
+        x = self.wte[idx] + self.wpe[:T]
+        for b in self.blocks:
+            x = b(x)
+        x = F.layer_norm(x, (cfg.n_embd,), self.ln_f_scale, self.ln_f_bias,
+                         cfg.layernorm_eps)
+        head = self.wte.t() if cfg.tied_head else self.lm_head
+        logits = x @ head
+        if targets is None:
+            return logits, None
+        loss = F.cross_entropy(logits.view(B * T, -1), targets.view(B * T))
+        return logits, loss
+
+
+# ---------------------------------------------------------------------------
+# weight transfer: JAX pytree <-> RefGPT (same layout, plain copies)
+# ---------------------------------------------------------------------------
+
+def params_to_torch(params: Dict, model: RefGPT) -> RefGPT:
+    def t(a):
+        return torch.from_numpy(np.asarray(a, dtype=np.float32))
+
+    with torch.no_grad():
+        model.wte.copy_(t(params["wte"]))
+        model.wpe.copy_(t(params["wpe"]))
+        model.ln_f_scale.copy_(t(params["ln_f_scale"]))
+        model.ln_f_bias.copy_(t(params["ln_f_bias"]))
+        if not model.cfg.tied_head:
+            model.lm_head.copy_(t(params["lm_head"]))
+        bl = params["blocks"]
+        for i, b in enumerate(model.blocks):
+            for name in ("ln1_scale", "ln1_bias", "qkv_kernel", "qkv_bias",
+                         "attn_out_kernel", "attn_out_bias", "ln2_scale",
+                         "ln2_bias", "mlp_up_kernel", "mlp_up_bias",
+                         "mlp_down_kernel", "mlp_down_bias"):
+                getattr(b, name).copy_(t(bl[name][i]))
+    return model
+
+
+def torch_to_params(model: RefGPT) -> Dict:
+    def n(p):
+        return p.detach().cpu().numpy().astype(np.float32)
+
+    names = ("ln1_scale", "ln1_bias", "qkv_kernel", "qkv_bias",
+             "attn_out_kernel", "attn_out_bias", "ln2_scale", "ln2_bias",
+             "mlp_up_kernel", "mlp_up_bias", "mlp_down_kernel",
+             "mlp_down_bias")
+    blocks = {name: np.stack([n(getattr(b, name)) for b in model.blocks])
+              for name in names}
+    params = {"wte": n(model.wte), "wpe": n(model.wpe), "blocks": blocks,
+              "ln_f_scale": n(model.ln_f_scale),
+              "ln_f_bias": n(model.ln_f_bias)}
+    if not model.cfg.tied_head:
+        params["lm_head"] = n(model.lm_head)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# CPU-reference throughput (the bench.py baseline)
+# ---------------------------------------------------------------------------
+
+def measure_train_throughput(cfg: ModelConfig, batch_size: int = 64,
+                             steps: int = 3, warmup: int = 1,
+                             lr: float = 2e-4, seed: int = 0) -> float:
+    """Train tokens/sec of the torch-CPU reference path (AdamW, same config
+    the JAX backend runs). Used as BASELINE for vs_baseline ratios."""
+    torch.manual_seed(seed)
+    model = RefGPT(cfg)
+    model.train()
+    opt = torch.optim.AdamW(model.parameters(), lr=lr)
+    g = torch.Generator().manual_seed(seed)
+    x = torch.randint(0, cfg.vocab_size, (batch_size, cfg.block_size),
+                      generator=g)
+    y = torch.randint(0, cfg.vocab_size, (batch_size, cfg.block_size),
+                      generator=g)
+
+    def one_step():
+        opt.zero_grad(set_to_none=True)
+        _, loss = model(x, y)
+        loss.backward()
+        opt.step()
+
+    for _ in range(warmup):
+        one_step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        one_step()
+    dt = time.perf_counter() - t0
+    return batch_size * cfg.block_size * steps / dt

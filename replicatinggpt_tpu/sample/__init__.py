@@ -1,0 +1,3 @@
+from .generate import generate, GenerateConfig
+
+__all__ = ["generate", "GenerateConfig"]

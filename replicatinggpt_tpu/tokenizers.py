@@ -1,0 +1,272 @@
+"""Tokenizers: char-level, self-contained byte-level BPE, optional tiktoken.
+
+Capability parity with the reference's tokenizer mux (GPT1.py:25-70):
+
+- ``'base'`` char branch (GPT1.py:54-66)  -> :class:`CharTokenizer`
+- ``'tiktoken'`` branch (GPT1.py:29-36)   -> :class:`TiktokenTokenizer`
+  (optional: tiktoken fetches its BPE ranks over the network on first use,
+  which is unavailable in air-gapped environments — so the framework also
+  ships its own trainable byte-level BPE, :class:`ByteBPETokenizer`, giving
+  the BPE capability with zero downloads)
+- the broken ``'nltk'`` branch (GPT1.py:38-52, SURVEY.md §8-B2) is dropped
+  deliberately.
+
+All tokenizers expose the same interface the reference's encode/decode
+closures had (GPT1.py:63-64): ``encode(str) -> list[int]``,
+``decode(ids) -> str``, plus ``vocab_size`` and JSON save/load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# GPT-2-style pre-tokenization pattern (public regex from the GPT-2 release;
+# splits into contractions / letter runs / digit runs / symbol runs / spaces).
+_PRETOKEN_PAT = (
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
+)
+
+
+def _bytes_to_unicode() -> Dict[int, str]:
+    """Reversible byte <-> printable-unicode map (GPT-2's byte-level trick).
+
+    Maps every possible byte to a unicode character that is printable and
+    never a space, so BPE merges can be stored as plain strings.
+    """
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+_BYTE_ENCODER = _bytes_to_unicode()
+_BYTE_DECODER = {v: k for k, v in _BYTE_ENCODER.items()}
+
+
+class CharTokenizer:
+    """Character-level tokenizer (GPT1.py:54-66 'base' branch).
+
+    Vocabulary is the sorted set of characters of the corpus (65 for Tiny
+    Shakespeare, verified in SURVEY.md §2.0).
+    """
+
+    kind = "char"
+
+    def __init__(self, chars: Sequence[str]):
+        self.chars = list(chars)
+        self.stoi = {c: i for i, c in enumerate(self.chars)}
+        self.itos = {i: c for i, c in enumerate(self.chars)}
+
+    @classmethod
+    def from_text(cls, text: str) -> "CharTokenizer":
+        return cls(sorted(set(text)))
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.chars)
+
+    def encode(self, s: str) -> List[int]:
+        return [self.stoi[c] for c in s]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return "".join(self.itos[int(i)] for i in ids)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"kind": self.kind, "chars": self.chars}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "CharTokenizer":
+        with open(path) as f:
+            d = json.load(f)
+        return cls(d["chars"])
+
+
+class ByteBPETokenizer:
+    """Self-contained byte-level BPE: trainable, saveable, download-free.
+
+    Gives the framework the BPE capability of the reference's tiktoken branch
+    (GPT1.py:29-36, GPT-2.py:192-196) without network access. Standard GPT-2
+    construction: GPT-2 pre-tokenizer regex, byte-to-unicode base alphabet of
+    256 symbols, then learned merges ranked by training order.
+    """
+
+    kind = "bpe"
+
+    def __init__(self, merges: List[Tuple[str, str]],
+                 vocab: Optional[List[str]] = None):
+        import regex
+        self._pat = regex.compile(_PRETOKEN_PAT)
+        self.merges = [tuple(m) for m in merges]
+        self.ranks = {m: i for i, m in enumerate(self.merges)}
+        if vocab is None:
+            base = [(_BYTE_ENCODER[b]) for b in range(256)]
+            vocab = base + ["".join(m) for m in self.merges]
+        self.vocab = vocab
+        self.token_to_id = {t: i for i, t in enumerate(vocab)}
+        self.id_to_token = {i: t for i, t in enumerate(vocab)}
+        self._cache: Dict[str, List[int]] = {}
+
+    # --- training ----------------------------------------------------------
+
+    @classmethod
+    def train(cls, text: str, vocab_size: int = 1024) -> "ByteBPETokenizer":
+        """Learn merges on ``text`` until the vocab reaches ``vocab_size``.
+
+        Counting is done on deduplicated pre-token "words" weighted by
+        frequency, so training on megabyte-scale corpora is fast in pure
+        Python.
+        """
+        import regex
+        assert vocab_size > 256, "byte alphabet alone is 256 symbols"
+        pat = regex.compile(_PRETOKEN_PAT)
+        words = Counter()
+        for w in pat.findall(text):
+            units = tuple(_BYTE_ENCODER[b] for b in w.encode("utf-8"))
+            words[units] += 1
+
+        merges: List[Tuple[str, str]] = []
+        words = dict(words)
+        while 256 + len(merges) < vocab_size:
+            pairs: Counter = Counter()
+            for units, freq in words.items():
+                for a, b in zip(units, units[1:]):
+                    pairs[(a, b)] += freq
+            if not pairs:
+                break
+            best = max(pairs, key=lambda p: (pairs[p], p))
+            merges.append(best)
+            merged = best[0] + best[1]
+            new_words = {}
+            for units, freq in words.items():
+                out = []
+                i = 0
+                while i < len(units):
+                    if (i + 1 < len(units)
+                            and units[i] == best[0] and units[i + 1] == best[1]):
+                        out.append(merged)
+                        i += 2
+                    else:
+                        out.append(units[i])
+                        i += 1
+                new_words[tuple(out)] = new_words.get(tuple(out), 0) + freq
+            words = new_words
+        return cls(merges)
+
+    # --- encode/decode -----------------------------------------------------
+
+    def _bpe_word(self, word: str) -> List[int]:
+        if word in self._cache:
+            return self._cache[word]
+        units = [_BYTE_ENCODER[b] for b in word.encode("utf-8")]
+        while len(units) > 1:
+            pairs = list(zip(units, units[1:]))
+            ranked = [(self.ranks.get(p, 1 << 30), i) for i, p in enumerate(pairs)]
+            rank, i = min(ranked)
+            if rank >= (1 << 30):
+                break
+            units = units[:i] + [units[i] + units[i + 1]] + units[i + 2:]
+        ids = [self.token_to_id[u] for u in units]
+        self._cache[word] = ids
+        return ids
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def encode(self, s: str) -> List[int]:
+        out: List[int] = []
+        for w in self._pat.findall(s):
+            out.extend(self._bpe_word(w))
+        return out
+
+    def decode(self, ids: Sequence[int]) -> str:
+        text = "".join(self.id_to_token[int(i)] for i in ids)
+        data = bytes(_BYTE_DECODER[ch] for ch in text)
+        return data.decode("utf-8", errors="replace")
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"kind": self.kind, "merges": self.merges,
+                       "vocab": self.vocab}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "ByteBPETokenizer":
+        with open(path) as f:
+            d = json.load(f)
+        return cls([tuple(m) for m in d["merges"]], d["vocab"])
+
+
+class TiktokenTokenizer:
+    """Wrapper over tiktoken encodings (GPT1.py:29-36 used o200k_base;
+    GPT-2.py:192 used gpt2). Requires tiktoken's BPE ranks to be cached
+    locally or downloadable; raises a clear error otherwise."""
+
+    kind = "tiktoken"
+
+    def __init__(self, encoding_name: str = "gpt2"):
+        import tiktoken
+        try:
+            self.enc = tiktoken.get_encoding(encoding_name)
+        except Exception as e:  # network failure in air-gapped envs
+            raise RuntimeError(
+                f"tiktoken encoding {encoding_name!r} unavailable (needs "
+                f"cached BPE ranks or network). Use tokenizer='bpe' for the "
+                f"self-contained byte-level BPE instead. Original: {e}"
+            ) from e
+        self.encoding_name = encoding_name
+
+    @property
+    def vocab_size(self) -> int:
+        # Correct per-encoding vocab (fixes SURVEY.md §8-B1, where the
+        # reference hard-coded 50257 for o200k_base).
+        return self.enc.n_vocab
+
+    def encode(self, s: str) -> List[int]:
+        return self.enc.encode(s)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.enc.decode(list(int(i) for i in ids))
+
+
+def get_tokenizer(spec: str, corpus_text: Optional[str] = None,
+                  cache_dir: str = "datasets"):
+    """Resolve a tokenizer spec string.
+
+    - ``'char'``            : char vocab built from ``corpus_text``
+    - ``'bpe'``             : byte-level BPE trained on ``corpus_text``
+                              (cached to ``cache_dir/bpe_<vocab>.json``)
+    - ``'bpe:<path>'``      : load a saved ByteBPETokenizer
+    - ``'tiktoken:<name>'`` : tiktoken encoding (gpt2, o200k_base, ...)
+    """
+    if spec == "char":
+        assert corpus_text is not None, "char tokenizer needs corpus text"
+        return CharTokenizer.from_text(corpus_text)
+    if spec == "bpe" or spec.startswith("bpe:"):
+        if ":" in spec:
+            return ByteBPETokenizer.load(spec.split(":", 1)[1])
+        assert corpus_text is not None, "training BPE needs corpus text"
+        cache = os.path.join(cache_dir, "bpe_1024.json")
+        if os.path.exists(cache):
+            return ByteBPETokenizer.load(cache)
+        tok = ByteBPETokenizer.train(corpus_text, vocab_size=1024)
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            tok.save(cache)
+        except OSError:
+            pass
+        return tok
+    if spec.startswith("tiktoken"):
+        name = spec.split(":", 1)[1] if ":" in spec else "gpt2"
+        return TiktokenTokenizer(name)
+    raise ValueError(f"unknown tokenizer spec {spec!r}")

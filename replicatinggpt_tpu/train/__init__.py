@@ -1,0 +1,5 @@
+from .state import TrainState, create_train_state, make_optimizer
+from .steps import make_train_step, make_eval_step, estimate_loss
+
+__all__ = ["TrainState", "create_train_state", "make_optimizer",
+           "make_train_step", "make_eval_step", "estimate_loss"]

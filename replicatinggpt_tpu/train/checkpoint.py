@@ -1,0 +1,80 @@
+"""Checkpoint save/restore: full training state, resumable, sharding-aware.
+
+The reference saves only the final model state_dict (GPT1.py:239-241) and
+has no load path at all (SURVEY.md §5) — a crash loses the run. Here a
+checkpoint is the complete resume state named in SURVEY.md §5:
+
+    {params, optimizer state, step, dropout RNG key, data-loader cursor}
+
+backed by orbax (async-capable, sharded-array aware: each host writes its
+own shards; restore can re-lay-out onto any mesh via abstract targets).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from .state import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    def save(self, state: TrainState, batcher: Any = None,
+             wait: bool = False) -> int:
+        step = int(jax.device_get(state.step))
+        args = {"state": ocp.args.StandardSave(state)}
+        if batcher is not None:
+            args["data"] = ocp.args.JsonSave(batcher.state())
+        self.mngr.save(step, args=ocp.args.Composite(**args))
+        if wait:
+            self.mngr.wait_until_finished()
+        return step
+
+    def latest_step(self) -> Optional[int]:
+        return self.mngr.latest_step()
+
+    def restore(self, step: int, state_template: TrainState,
+                batcher: Any = None,
+                shardings: Any = None) -> TrainState:
+        """Restore into the template's structure. ``shardings`` (optional
+        pytree of NamedSharding matching the state) re-lays-out arrays onto
+        a mesh at load time — resume on a different topology than the save.
+        """
+        target = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            state_template)
+        if shardings is not None:
+            target = jax.tree_util.tree_map(
+                lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                                  sharding=s),
+                target, shardings)
+        args = {"state": ocp.args.StandardRestore(target)}
+        if batcher is not None:
+            args["data"] = ocp.args.JsonRestore()
+        out = self.mngr.restore(step, args=ocp.args.Composite(**args))
+        if batcher is not None and out.get("data") is not None:
+            batcher.restore(out["data"])
+        return out["state"]
+
+    def restore_latest(self, state_template: TrainState, batcher: Any = None,
+                       shardings: Any = None) -> Optional[TrainState]:
+        step = self.latest_step()
+        if step is None:
+            return None
+        return self.restore(step, state_template, batcher, shardings)
+
+    def wait(self) -> None:
+        self.mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self.mngr.close()

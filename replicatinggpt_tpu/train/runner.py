@@ -1,0 +1,135 @@
+"""End-to-end training runner: the framework's L5/L6 (SURVEY.md §1).
+
+Drives the full reference pipeline — corpus → tokenize → split → train loop
+with periodic train/val eval → sample → checkpoint (GPT1.py:215-241) — on
+top of the jitted steps, with optional mesh sharding, async device prefetch,
+structured logging, and resumable checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..config import Config
+from ..data.dataset import TokenDataset, load_corpus
+from ..data.loader import make_batcher, prefetch
+from ..models.gpt import param_count
+from ..tokenizers import get_tokenizer
+from ..utils.logging import StepLogger
+from .state import TrainState, create_train_state
+from .steps import estimate_loss, make_eval_step, make_train_step
+
+
+@dataclass
+class TrainResult:
+    state: TrainState
+    history: list          # [(step, train_loss, val_loss)]
+    final_eval: Dict[str, float]
+    tokenizer: Any
+    tokens_per_sec_per_chip: float
+
+
+def _resolve_vocab(cfg: Config, tokenizer) -> Config:
+    """Make model vocab consistent with the tokenizer (fixes SURVEY.md
+    §8-B1/B5, where reference vocab/tokenizer mismatches crashed training).
+    Keeps a configured vocab that is >= tokenizer vocab (padded vocabs like
+    50304 are MXU-friendlier than 50257)."""
+    v = tokenizer.vocab_size
+    if cfg.model.vocab_size < v:
+        import dataclasses as dc
+        cfg = cfg.replace(model=dc.replace(cfg.model, vocab_size=v))
+    return cfg
+
+
+def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
+          checkpoint_manager=None, resume: bool = False) -> TrainResult:
+    logger = logger or StepLogger()
+    text = load_corpus(cfg.dataset)
+    tokenizer = get_tokenizer(cfg.tokenizer, corpus_text=text,
+                              cache_dir=os.path.dirname(cfg.dataset) or ".")
+    cfg = _resolve_vocab(cfg, tokenizer)
+    mcfg, tcfg = cfg.model, cfg.train
+
+    ds = TokenDataset.from_text(text, tokenizer, tcfg.val_fraction)
+    logger.log(f"dataset: {len(ds.train):,} train / {len(ds.val):,} val "
+               f"tokens, vocab {tokenizer.vocab_size}")
+
+    train_batcher = make_batcher(tcfg.sampling, ds.train, tcfg.batch_size,
+                                 mcfg.block_size, seed=tcfg.seed)
+    eval_batchers = {
+        "train": make_batcher("random", ds.train, tcfg.batch_size,
+                              mcfg.block_size, seed=tcfg.seed + 1),
+        "val": make_batcher("random", ds.val, tcfg.batch_size,
+                            mcfg.block_size, seed=tcfg.seed + 2),
+    }
+
+    rng = jax.random.PRNGKey(tcfg.seed)
+    batch_sharding = None
+    n_chips = 1
+    if mesh is not None:
+        from ..parallel.mesh import make_batch_sharding, shard_train_state
+        batch_sharding = make_batch_sharding(mesh)
+        n_chips = mesh.size
+        state = shard_train_state(
+            lambda: create_train_state(rng, mcfg, tcfg), mesh, cfg.mesh)
+    else:
+        state = create_train_state(rng, mcfg, tcfg)
+    logger.log(f"model: {param_count(state.params):,} params "
+               f"({mcfg.n_layer}L/{mcfg.n_head}H/{mcfg.n_embd}C, "
+               f"dtype={mcfg.dtype})")
+
+    train_step = make_train_step(mcfg, tcfg)
+    eval_step = make_eval_step(mcfg)
+    dput = ((lambda a: jax.device_put(a, batch_sharding))
+            if batch_sharding is not None else jax.device_put)
+
+    start_step = 0
+    if checkpoint_manager is not None and resume:
+        restored = checkpoint_manager.restore_latest(state, train_batcher)
+        if restored is not None:
+            state = restored
+            start_step = int(jax.device_get(state.step))
+            logger.log(f"resumed from step {start_step}")
+
+    history = []
+    tokens_per_batch = tcfg.batch_size * mcfg.block_size
+    batches = prefetch(iter(train_batcher), sharding=batch_sharding)
+    import time
+    t0 = time.perf_counter()
+    tokens_seen = 0
+    logger.reset_timer()
+    for it in range(start_step, tcfg.max_iters):
+        if (tcfg.eval_interval and it % tcfg.eval_interval == 0):
+            losses = estimate_loss(state.params, eval_batchers, eval_step,
+                                   tcfg.eval_iters, device_put=dput)
+            logger.log_eval(it, losses["train"], losses["val"])
+            history.append((it, losses["train"], losses["val"]))
+            logger.reset_timer()
+        batch = next(batches)
+        state, metrics = train_step(state, batch)
+        tokens_seen += tokens_per_batch
+        if tcfg.log_interval and (it + 1) % tcfg.log_interval == 0:
+            logger.log_step(it, float(metrics["loss"]),
+                            tokens_per_batch * tcfg.log_interval, n_chips)
+        if (checkpoint_manager is not None and tcfg.checkpoint_every
+                and (it + 1) % tcfg.checkpoint_every == 0):
+            checkpoint_manager.save(state, train_batcher)
+
+    jax.block_until_ready(state.params)
+    wall = time.perf_counter() - t0
+    final_eval = estimate_loss(state.params, eval_batchers, eval_step,
+                               tcfg.eval_iters, device_put=dput)
+    logger.log_eval(tcfg.max_iters, final_eval["train"], final_eval["val"])
+    history.append((tcfg.max_iters, final_eval["train"], final_eval["val"]))
+    if checkpoint_manager is not None:
+        checkpoint_manager.save(state, train_batcher)
+    tps = tokens_seen / wall / n_chips if wall > 0 else 0.0
+    logger.log(f"trained {tokens_seen:,} tokens in {wall:.1f}s "
+               f"({tps:,.0f} tok/s/chip)")
+    return TrainResult(state=state, history=history, final_eval=final_eval,
+                       tokenizer=tokenizer, tokens_per_sec_per_chip=tps)

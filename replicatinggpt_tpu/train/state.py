@@ -1,0 +1,60 @@
+"""Train state: params + optimizer state + step + RNG, as one pytree.
+
+The reference keeps optimizer state implicitly inside torch.optim.AdamW
+(GPT1.py:218) and loses it at checkpoint time (only model state_dict saved,
+GPT1.py:239-241). Here the full state is one pytree — jit-donated through
+the train step, sharded by the same partition rules as params, and
+checkpointed whole (SURVEY.md §5 checkpoint row).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config import ModelConfig, TrainConfig
+from ..models.gpt import init_params
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray          # scalar int32
+    params: Any                # model parameter pytree
+    opt_state: Any             # optax state
+    rng: jax.Array             # threaded PRNG key (dropout)
+
+
+def lr_schedule_fn(tcfg: TrainConfig):
+    if tcfg.lr_schedule == "constant" and tcfg.warmup_iters == 0:
+        return tcfg.lr
+    if tcfg.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=tcfg.lr,
+            warmup_steps=max(tcfg.warmup_iters, 1),
+            decay_steps=max(tcfg.max_iters, tcfg.warmup_iters + 1),
+            end_value=tcfg.min_lr)
+    return optax.linear_schedule(0.0, tcfg.lr, max(tcfg.warmup_iters, 1))
+
+
+def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
+    """AdamW matching the reference's optimizer choice (GPT1.py:218,
+    GPT-2.py:221), with optional global-norm clipping and LR schedule."""
+    chain = []
+    if tcfg.grad_clip and tcfg.grad_clip > 0:
+        chain.append(optax.clip_by_global_norm(tcfg.grad_clip))
+    chain.append(optax.adamw(
+        learning_rate=lr_schedule_fn(tcfg),
+        b1=tcfg.betas[0], b2=tcfg.betas[1],
+        weight_decay=tcfg.weight_decay))
+    return optax.chain(*chain)
+
+
+def create_train_state(rng: jax.Array, mcfg: ModelConfig, tcfg: TrainConfig
+                       ) -> TrainState:
+    p_rng, d_rng = jax.random.split(rng)
+    params = init_params(p_rng, mcfg)
+    opt_state = make_optimizer(tcfg).init(params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=opt_state, rng=d_rng)

@@ -1,0 +1,86 @@
+"""Jitted train / eval steps.
+
+One compiled ``train_step(state, batch) -> (state, metrics)`` replaces the
+reference's eager zero_grad/forward/backward/step sequence (GPT1.py:227-233,
+GPT-2.py:223-228); a jitted K-batch eval replaces ``estimate_loss``
+(GPT1.py:85-98) — same semantics (dropout off, mean over eval_iters fresh
+batches per split) but compiled, so the 400-forwards-per-eval cost
+(SURVEY.md §3.3) stops dominating wall-clock.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig, TrainConfig
+from ..models.gpt import forward
+from .state import TrainState, make_optimizer
+
+
+def loss_fn(params, batch, cfg: ModelConfig, rng=None, train=False):
+    x, y = batch
+    _, loss = forward(params, x, cfg, targets=y, rng=rng, train=train)
+    return loss
+
+
+def make_train_step(mcfg: ModelConfig, tcfg: TrainConfig,
+                    donate: bool = True,
+                    with_grad_norm: bool = False) -> Callable:
+    """Build the jitted train step. Sharded execution comes from the
+    shardings already attached to ``state``/``batch`` arrays (GSPMD); this
+    function is mesh-agnostic. ``with_grad_norm`` adds a tree-wide grad-norm
+    reduction to the metrics (off by default — it costs a full-tree
+    reduction per step)."""
+    optimizer = make_optimizer(tcfg)
+
+    def step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
+        rng = jax.random.fold_in(state.rng, state.step)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, batch, mcfg, rng=rng,
+            train=(mcfg.dropout > 0 or mcfg.attn_dropout > 0))
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state, rng=state.rng)
+        metrics = {"loss": loss}
+        if with_grad_norm:
+            metrics["grad_norm"] = jax.tree_util.tree_reduce(
+                lambda a, g: a + jnp.sum(jnp.square(g.astype(jnp.float32))),
+                grads, jnp.float32(0.0)) ** 0.5
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(mcfg: ModelConfig) -> Callable:
+    """Jitted single-batch eval loss (dropout off — GPT1.py:88 model.eval)."""
+
+    @jax.jit
+    def eval_step(params, batch) -> jnp.ndarray:
+        return loss_fn(params, batch, mcfg, rng=None, train=False)
+
+    return eval_step
+
+
+def estimate_loss(params, batchers: Dict[str, Any], eval_step: Callable,
+                  eval_iters: int, device_put: Callable = None
+                  ) -> Dict[str, float]:
+    """Mean loss over ``eval_iters`` fresh batches for each split —
+    ``estimate_loss`` semantics (GPT1.py:85-98), including the quirk that
+    'train' loss is itself a random K-batch sample (SURVEY.md §8-Q8)."""
+    out = {}
+    for split, batcher in batchers.items():
+        total = 0.0
+        for _ in range(eval_iters):
+            xb, yb = batcher.next_batch()
+            if device_put is not None:
+                xb, yb = device_put(xb), device_put(yb)
+            total += float(eval_step(params, (xb, yb)))
+        out[split] = total / eval_iters
+    return out

@@ -1,0 +1,3 @@
+from .logging import StepLogger
+
+__all__ = ["StepLogger"]

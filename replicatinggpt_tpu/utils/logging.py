@@ -1,0 +1,58 @@
+"""Structured step logging.
+
+The reference's observability is bare print() calls (SURVEY.md §5): periodic
+``step {i} : train loss X, val loss = Y`` (GPT1.py:225) and per-step
+``Step {i}, Loss: L`` (GPT-2.py:229). This logger keeps those exact
+human-readable formats (for parity eyeballing) and adds a JSONL stream with
+throughput (tokens/sec/chip — the BASELINE.json primary metric).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Optional, TextIO
+
+
+class StepLogger:
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 stream: TextIO = sys.stdout):
+        self.stream = stream
+        self.jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self.t_last = time.perf_counter()
+
+    def log_step(self, step: int, loss: float, tokens: int,
+                 n_chips: int = 1, lr: Optional[float] = None) -> None:
+        now = time.perf_counter()
+        dt = max(now - self.t_last, 1e-9)
+        self.t_last = now
+        tps = tokens / dt / max(n_chips, 1)
+        # GPT-2.py:229 format, extended
+        print(f"Step {step}, Loss: {loss:.6f} | {tps:,.0f} tok/s/chip",
+              file=self.stream)
+        self._jsonl({"event": "step", "step": step, "loss": float(loss),
+                     "tokens_per_sec_per_chip": tps, "lr": lr,
+                     "time": time.time()})
+
+    def log_eval(self, step: int, train_loss: float, val_loss: float) -> None:
+        # GPT1.py:225 format
+        print(f"step {step} : train loss {train_loss:.4f}, "
+              f"val loss = {val_loss:.4f}", file=self.stream)
+        self._jsonl({"event": "eval", "step": step,
+                     "train_loss": float(train_loss),
+                     "val_loss": float(val_loss), "time": time.time()})
+
+    def log(self, msg: str, **fields) -> None:
+        print(msg, file=self.stream)
+        if fields:
+            self._jsonl({"event": "info", "msg": msg, **fields,
+                         "time": time.time()})
+
+    def _jsonl(self, obj: dict) -> None:
+        if self.jsonl:
+            self.jsonl.write(json.dumps(obj) + "\n")
+            self.jsonl.flush()
+
+    def reset_timer(self) -> None:
+        self.t_last = time.perf_counter()

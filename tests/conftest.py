@@ -1,0 +1,41 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Sharding/collective tests run against CPU XLA with 8 virtual devices
+(SURVEY.md §4 implication) — no TPU hardware needed. Env must be set before
+jax first imports.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+os.environ.setdefault("TOKENIZERS_PARALLELISM", "false")
+
+# Some PJRT plugin environments (e.g. tunneled TPU backends) override
+# JAX_PLATFORMS at plugin-registration time; the config API wins over both.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def corpus_text():
+    return (REPO / "datasets" / "shakespeare.txt").read_text()
+
+
+@pytest.fixture(scope="session")
+def tiny_corpus(corpus_text):
+    return corpus_text[:50_000]

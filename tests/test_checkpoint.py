@@ -1,0 +1,100 @@
+"""Checkpoint tests: full-state roundtrip, resume continues identically,
+data-loader cursor restoration, latest-step selection."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from replicatinggpt_tpu.config import get_config
+from replicatinggpt_tpu.data import SequentialBatcher
+from replicatinggpt_tpu.train.checkpoint import CheckpointManager
+from replicatinggpt_tpu.train.state import create_train_state
+from replicatinggpt_tpu.train.steps import make_train_step
+
+
+@pytest.fixture()
+def tiny():
+    return get_config("test-tiny")
+
+
+def _trees_equal(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_roundtrip_full_state(tiny, tmp_path):
+    m, t = tiny.model, tiny.train
+    state = create_train_state(jax.random.PRNGKey(0), m, t)
+    step = make_train_step(m, t, donate=False)
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, m.block_size), 0,
+                           m.vocab_size)
+    for _ in range(3):
+        state, _ = step(state, (x, x))
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    ck.save(state, wait=True)
+    restored = ck.restore(3, state)
+    _trees_equal(state, restored)
+    assert int(restored.step) == 3
+    ck.close()
+
+
+def test_resume_training_is_identical(tiny, tmp_path):
+    """Save at step 2, keep training to 5; restore at 2 and retrain to 5 —
+    final params must be bit-identical (step-keyed dropout RNG makes the
+    tail deterministic)."""
+    m, t = tiny.model, tiny.train
+    step = make_train_step(m, t, donate=False)
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, m.block_size), 0,
+                           m.vocab_size)
+    state = create_train_state(jax.random.PRNGKey(0), m, t)
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    for _ in range(2):
+        state, _ = step(state, (x, x))
+    ck.save(state, wait=True)
+    cont = state
+    for _ in range(3):
+        cont, _ = step(cont, (x, x))
+    resumed = ck.restore(2, state)
+    for _ in range(3):
+        resumed, _ = step(resumed, (x, x))
+    _trees_equal(cont.params, resumed.params)
+    ck.close()
+
+
+def test_batcher_cursor_roundtrip(tiny, tmp_path):
+    m, t = tiny.model, tiny.train
+    state = create_train_state(jax.random.PRNGKey(0), m, t)
+    data = np.arange(5000, dtype=np.int32)
+    b = SequentialBatcher(data, 4, m.block_size)
+    b.next_batch(); b.next_batch()
+    expected_next, _ = SequentialBatcher(data, 4, m.block_size), None
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    ck.save(state, batcher=b, wait=True)
+    want, _ = b.next_batch()
+    b2 = SequentialBatcher(data, 4, m.block_size)
+    ck.restore(0, state, batcher=b2)
+    got, _ = b2.next_batch()
+    np.testing.assert_array_equal(want, got)
+    ck.close()
+
+
+def test_latest_step(tiny, tmp_path):
+    m, t = tiny.model, tiny.train
+    state = create_train_state(jax.random.PRNGKey(0), m, t)
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    assert ck.latest_step() is None
+    assert ck.restore_latest(state) is None
+    ck.save(state, wait=True)
+    step = make_train_step(m, t, donate=False)
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, m.block_size), 0,
+                           m.vocab_size)
+    state2, _ = step(state, (x, x))
+    ck.save(state2, wait=True)
+    assert ck.latest_step() == 1
+    r = ck.restore_latest(state)
+    assert int(r.step) == 1
+    ck.close()

@@ -1,0 +1,170 @@
+"""Distributed tests on a virtual 8-device CPU mesh (conftest forces
+--xla_force_host_platform_device_count=8).
+
+Covers the SURVEY.md §2.1 strategy table: DP, FSDP (ZeRO-3 param+opt
+sharding), TP (Megatron column/row), and their composition — all via GSPMD
+shardings, no hand-written collectives.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from replicatinggpt_tpu.config import MeshConfig, ModelConfig, get_config
+from replicatinggpt_tpu.models.gpt import forward, init_params
+from replicatinggpt_tpu.parallel.mesh import (make_batch_sharding, make_mesh,
+                                              state_pspecs,
+                                              shard_train_state)
+from replicatinggpt_tpu.train.state import create_train_state
+from replicatinggpt_tpu.train.steps import make_train_step
+
+TINY = ModelConfig(vocab_size=64, block_size=32, n_layer=2, n_head=2,
+                   n_embd=32, dropout=0.0, attn_dropout=0.0, dtype="float32")
+
+
+def _state_fn(mcfg, tcfg):
+    return lambda: create_train_state(jax.random.PRNGKey(0), mcfg, tcfg)
+
+
+def _find_adam(state):
+    """Locate ScaleByAdamState anywhere in optax's nested chain tuples."""
+    if type(state).__name__ == "ScaleByAdamState":
+        return state
+    if isinstance(state, (tuple, list)):
+        for s in state:
+            r = _find_adam(s)
+            if r is not None:
+                return r
+    return None
+
+
+@pytest.fixture(scope="module")
+def tcfg():
+    return get_config("test-tiny").train
+
+
+def _batch(mcfg, B=8, seed=0):
+    x = jax.random.randint(jax.random.PRNGKey(seed), (B, mcfg.block_size), 0,
+                           mcfg.vocab_size)
+    return x, x
+
+
+def test_requires_eight_devices():
+    assert len(jax.devices()) == 8, "conftest must fake 8 CPU devices"
+
+
+def test_mesh_construction():
+    mesh = make_mesh(MeshConfig(data=2, seq=2, model=2))
+    assert mesh.shape == {"data": 2, "seq": 2, "model": 2}
+    assert make_batch_sharding(mesh).spec == P("data", "seq")
+
+
+def test_tp_specs_follow_megatron_pattern(tcfg):
+    specs = state_pspecs(jax.eval_shape(_state_fn(TINY, tcfg)),
+                         MeshConfig(data=1, seq=1, model=2))
+    p = specs.params
+    assert p["blocks"]["qkv_kernel"] == P(None, None, "model")
+    assert p["blocks"]["attn_out_kernel"] == P(None, "model", None)
+    assert p["blocks"]["mlp_up_kernel"] == P(None, None, "model")
+    assert p["blocks"]["mlp_down_kernel"] == P(None, "model", None)
+    assert p["blocks"]["ln1_scale"] == P(None, None)
+    assert p["wte"] == P("model", None)  # 64 % 2 == 0 → vocab-parallel
+    # Adam moments mirror param specs through the tree path
+    adam = _find_adam(specs.opt_state)
+    assert adam.mu["blocks"]["qkv_kernel"] == P(None, None, "model")
+
+
+def test_tp_indivisible_dims_stay_replicated(tcfg):
+    odd = dataclasses.replace(TINY, vocab_size=65)  # 65 % 2 != 0
+    specs = state_pspecs(jax.eval_shape(_state_fn(odd, tcfg)),
+                         MeshConfig(model=2))
+    assert specs.params["wte"] == P(None, None)
+
+
+def test_fsdp_shards_params_and_moments(tcfg):
+    specs = state_pspecs(jax.eval_shape(_state_fn(TINY, tcfg)),
+                         MeshConfig(data=8, fsdp=True))
+    p = specs.params
+    # largest dim of (L=2, C=32, 3C=96) divisible by 8 → last dim
+    assert "data" in tuple(p["blocks"]["qkv_kernel"])
+    assert "data" in tuple(p["wte"])
+    adam = _find_adam(specs.opt_state)
+    assert "data" in tuple(adam.mu["blocks"]["qkv_kernel"])
+
+
+def test_dp_training_matches_single_device(tcfg):
+    """8-way DP must be numerically equivalent to single-device training
+    (same global batch, same init)."""
+    tcfg = dataclasses.replace(tcfg, lr=1e-3)
+    batch = _batch(TINY, B=8)
+    # single device
+    state1 = _state_fn(TINY, tcfg)()
+    step1 = make_train_step(TINY, tcfg, donate=False)
+    losses1 = []
+    for _ in range(3):
+        state1, m = step1(state1, batch)
+        losses1.append(float(m["loss"]))
+    # 8-way DP
+    mesh = make_mesh(MeshConfig(data=8))
+    state8 = shard_train_state(_state_fn(TINY, tcfg), mesh,
+                               MeshConfig(data=8))
+    bs = make_batch_sharding(mesh)
+    batch8 = tuple(jax.device_put(np.asarray(b), bs) for b in batch)
+    step8 = make_train_step(TINY, tcfg, donate=False)
+    losses8 = []
+    for _ in range(3):
+        state8, m = step8(state8, batch8)
+        losses8.append(float(m["loss"]))
+    np.testing.assert_allclose(losses1, losses8, rtol=2e-4)
+
+
+def test_tp_forward_matches_unsharded(tcfg):
+    mesh = make_mesh(MeshConfig(data=2, seq=1, model=2))
+    mesh_cfg = MeshConfig(data=2, seq=1, model=2)
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    specs = state_pspecs(params, mesh_cfg)
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+    x, _ = _batch(TINY, B=4)
+    ref, _ = forward(params, x, TINY)
+    xb = jax.device_put(np.asarray(x), NamedSharding(mesh, P("data", None)))
+    got, _ = jax.jit(lambda p, i: forward(p, i, TINY))(sharded, xb)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=2e-4)
+
+
+def test_fsdp_training_matches_single_device(tcfg):
+    tcfg = dataclasses.replace(tcfg, lr=1e-3)
+    batch = _batch(TINY, B=8)
+    state1 = _state_fn(TINY, tcfg)()
+    step = make_train_step(TINY, tcfg, donate=False)
+    state1, m1 = step(state1, batch)
+    mesh = make_mesh(MeshConfig(data=8, fsdp=True))
+    mesh_cfg = MeshConfig(data=8, fsdp=True)
+    state8 = shard_train_state(_state_fn(TINY, tcfg), mesh, mesh_cfg)
+    bs = make_batch_sharding(mesh)
+    batch8 = tuple(jax.device_put(np.asarray(b), bs) for b in batch)
+    state8, m8 = step(state8, batch8)
+    np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]),
+                               rtol=2e-4)
+    # params stayed sharded after the step (no silent gather-to-replicated)
+    qkv = state8.params["blocks"]["qkv_kernel"]
+    assert "data" in tuple(qkv.sharding.spec)
+
+
+def test_runner_with_mesh(tcfg):
+    """End-to-end runner on a 4-way DP mesh."""
+    cfg = get_config("test-tiny")
+    cfg = cfg.replace(
+        train=dataclasses.replace(cfg.train, max_iters=5, eval_interval=0,
+                                  eval_iters=2, log_interval=0,
+                                  batch_size=8),
+        mesh=MeshConfig(data=4),
+        dataset="datasets/shakespeare.txt")
+    from replicatinggpt_tpu.train.runner import train
+    mesh = make_mesh(cfg.mesh)
+    res = train(cfg, mesh=mesh)
+    assert np.isfinite(res.final_eval["val"])
