@@ -49,7 +49,8 @@ class ModelConfig:
     dtype: str = "bfloat16"       # activation/compute dtype on TPU (MXU-native)
     param_dtype: str = "float32"  # master params stay f32
     # --- execution ----------------------------------------------------------
-    attention_impl: str = "auto"  # 'auto' | 'einsum' | 'flash' | 'ring'
+    attention_impl: str = "auto"  # 'auto' | 'einsum' | 'flash' | 'ring' |
+                                  # 'ulysses' (seq-parallel all-to-all)
     remat: bool = False           # jax.checkpoint each block (HBM <-> FLOPs)
     scan_layers: bool = True      # lax.scan over stacked layer params
 
@@ -63,7 +64,8 @@ class ModelConfig:
     def validate(self) -> "ModelConfig":
         _ = self.head_dim
         assert self.activation in ("gelu", "relu"), self.activation
-        assert self.attention_impl in ("auto", "einsum", "flash", "ring")
+        assert self.attention_impl in ("auto", "einsum", "flash", "ring",
+                                       "ulysses")
         return self
 
 
@@ -257,7 +259,7 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dropout", type=float, default=None)
     p.add_argument("--dtype", type=str, default=None)
     p.add_argument("--attention", dest="attention_impl", default=None,
-                   choices=["auto", "einsum", "flash", "ring"])
+                   choices=["auto", "einsum", "flash", "ring", "ulysses"])
     # train overrides
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)

@@ -147,9 +147,10 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
         attn = attention_fn(q, k, v)
     else:
         impl = cfg.attention_impl
-        if impl in ("auto", "ring"):
-            # 'ring' at the single-device level degrades to the local core;
-            # the sharded ring wrapper lives in parallel/ring_attention.py.
+        if impl in ("auto", "ring", "ulysses"):
+            # seq-parallel impls ('ring'/'ulysses') only exist as sharded
+            # wrappers (parallel/ring_attention.py, parallel/ulysses.py)
+            # passed in via attention_fn; locally they degrade to einsum.
             impl = "einsum"
         attn = full_causal_attention(
             q, k, v, dropout_rate=cfg.attn_dropout, rng=r_attn, train=train,

@@ -1,5 +1,33 @@
 from .mesh import (make_mesh, make_batch_sharding, batch_pspec, state_pspecs,
                    param_pspecs, shard_train_state)
+from .ring_attention import make_ring_attention_fn, ring_attention
+from .ulysses import make_ulysses_attention_fn, ulysses_attention
 
 __all__ = ["make_mesh", "make_batch_sharding", "batch_pspec", "state_pspecs",
-           "param_pspecs", "shard_train_state"]
+           "param_pspecs", "shard_train_state", "ring_attention",
+           "make_ring_attention_fn", "ulysses_attention",
+           "make_ulysses_attention_fn", "select_attention_fn"]
+
+
+def select_attention_fn(mcfg, mesh_cfg, mesh):
+    """Pick the sequence-parallel attention core for a (config, mesh) pair.
+
+    Returns None — use the local einsum/flash core, GSPMD handles any
+    sharding (including gathering a seq-sharded KV) — unless the mesh
+    shards the sequence axis AND the configured impl opts into an explicit
+    seq-parallel core: 'ulysses' selects the all-to-all path, 'ring'/'auto'
+    the ppermute ring. An explicit 'einsum' or 'flash' is respected as-is
+    (einsum is the only core with attention-weight dropout).
+    """
+    if mesh is None or mesh_cfg.seq <= 1:
+        return None
+    if mcfg.attention_impl == "ulysses":
+        # inside the Ulysses region each device sees the full sequence;
+        # use the flash kernel there on TPU (einsum elsewhere — the pallas
+        # interpreter is too slow to be a win off-TPU)
+        import jax
+        local = "flash" if jax.default_backend() == "tpu" else "einsum"
+        return make_ulysses_attention_fn(mesh, impl=local)
+    if mcfg.attention_impl in ("auto", "ring"):
+        return make_ring_attention_fn(mesh)
+    return None

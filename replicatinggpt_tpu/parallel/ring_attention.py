@@ -1,0 +1,120 @@
+"""Ring attention: causal self-attention with the sequence axis sharded
+across devices ('seq' mesh axis), KV blocks rotating around the ring via
+``lax.ppermute`` over ICI.
+
+The reference caps context at block_size because attention materializes the
+full (T, T) weight matrix on one device (GPT1.py:106,114-116; the assert at
+GPT-2.py:109). This module removes the single-device sequence cap: each of
+the ``n`` devices on the 'seq' axis holds a (B, H, T/n, D) shard of q/k/v,
+and at ring step ``s`` device ``i`` computes the attention block between its
+local queries and the KV chunk originating on device ``(i - s) mod n``,
+accumulated with the online-softmax recurrence (running max ``m``, running
+normalizer ``l``, rescaled accumulator) so nothing bigger than a
+(T/n, T/n) score tile ever exists. KV chunks move one hop per step
+(device j -> j+1), so the collective is a neighbor ``ppermute`` that rides
+ICI links, overlapping with the local block matmul.
+
+Causality falls out of masking on *global* positions (chunk_index * T_local
++ local offset) — the diagonal block gets a triangular mask, blocks from
+earlier chunks are unmasked, blocks from later chunks mask to -inf and
+contribute nothing. The loop is a ``lax.scan`` with static trip count
+``n``, so the whole ring is reverse-mode differentiable (the VJP of
+``ppermute`` is the inverse rotation, and XLA overlaps those transfers the
+same way).
+
+Composition: ``make_ring_attention_fn(mesh)`` returns an ``attention_fn``
+for ``models.gpt.forward`` — a ``jax.shard_map`` region over the mesh whose
+'data' and 'model' axes are plain partitioning (batch, heads) and whose
+'seq' axis carries the ring. It drops into the otherwise-GSPMD training
+step; XLA stitches the sharding transitions.
+
+Note: like the flash path, the ring core has no attention-weight dropout
+(GPT1.py:117); callers training with ``attn_dropout > 0`` should disable it
+or accept the deviation (recorded in PARITY.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import NEG_INF
+
+
+def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                axis_name: str, scale: Optional[float]) -> jnp.ndarray:
+    """Per-device ring attention body. q/k/v: local (B, H, T_local, D)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+
+    qf = q.astype(jnp.float32) * scale
+    qpos = idx * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def block_update(acc, m, l, k_cur, v_cur, src):
+        """Online-softmax accumulation of one (Tl, Tl) score block against
+        the KV chunk originating on device ``src``."""
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                            k_cur.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+        kpos = src * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
+        logits = jnp.where(kpos <= qpos, logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    # step 0 is the resident diagonal block — no rotation needed for it, and
+    # peeling it keeps the scan at n-1 rotations (no dead final ppermute)
+    acc0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+    m0 = jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
+    acc, m, l = block_update(acc0, m0, l0, k, v, idx)
+
+    def step(carry, s):
+        acc, m, l, k_cur, v_cur = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (idx - s) % n  # chunk id the rotating KV now holds
+        acc, m, l = block_update(acc, m, l, k_cur, v_cur, src)
+        return (acc, m, l, k_cur, v_cur), None
+
+    (acc, _, l, _, _), _ = jax.lax.scan(
+        step, (acc, m, l, k, v), jnp.arange(1, n))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                   mesh: Mesh, scale: Optional[float] = None,
+                   seq_axis: str = "seq") -> jnp.ndarray:
+    """Causal ring attention over a sharded sequence.
+
+    q, k, v: global (B, H, T, D) with T sharded over ``seq_axis`` (and
+    optionally B over 'data', H over 'model'). Returns (B, H, T, D) with the
+    same sharding. T must divide evenly by the seq axis size.
+    """
+    spec = P("data", "model", seq_axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_local, axis_name=seq_axis, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def make_ring_attention_fn(mesh: Mesh, scale: Optional[float] = None):
+    """attention_fn for ``models.gpt.forward`` / ``train.steps`` — plugs the
+    sharded ring core into the per-block attention slot."""
+    def attention_fn(q, k, v):
+        return ring_attention(q, k, v, mesh=mesh, scale=scale)
+    return attention_fn

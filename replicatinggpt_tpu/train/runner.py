@@ -83,8 +83,18 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                f"({mcfg.n_layer}L/{mcfg.n_head}H/{mcfg.n_embd}C, "
                f"dtype={mcfg.dtype})")
 
-    train_step = make_train_step(mcfg, tcfg)
-    eval_step = make_eval_step(mcfg)
+    attention_fn = None
+    if mesh is not None:
+        from ..parallel import select_attention_fn
+        attention_fn = select_attention_fn(mcfg, cfg.mesh, mesh)
+        if attention_fn is not None:
+            logger.log(f"sequence parallelism: seq axis {cfg.mesh.seq}, "
+                       f"impl {mcfg.attention_impl!r}"
+                       + (" (attention-weight dropout not applied on the "
+                          "seq-parallel path)" if mcfg.attn_dropout > 0
+                          else ""))
+    train_step = make_train_step(mcfg, tcfg, attention_fn=attention_fn)
+    eval_step = make_eval_step(mcfg, attention_fn=attention_fn)
     dput = ((lambda a: jax.device_put(a, batch_sharding))
             if batch_sharding is not None else jax.device_put)
 

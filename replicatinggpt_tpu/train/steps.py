@@ -21,27 +21,32 @@ from ..models.gpt import forward
 from .state import TrainState, make_optimizer
 
 
-def loss_fn(params, batch, cfg: ModelConfig, rng=None, train=False):
+def loss_fn(params, batch, cfg: ModelConfig, rng=None, train=False,
+            attention_fn=None):
     x, y = batch
-    _, loss = forward(params, x, cfg, targets=y, rng=rng, train=train)
+    _, loss = forward(params, x, cfg, targets=y, rng=rng, train=train,
+                      attention_fn=attention_fn)
     return loss
 
 
 def make_train_step(mcfg: ModelConfig, tcfg: TrainConfig,
                     donate: bool = True,
-                    with_grad_norm: bool = False) -> Callable:
+                    with_grad_norm: bool = False,
+                    attention_fn=None) -> Callable:
     """Build the jitted train step. Sharded execution comes from the
     shardings already attached to ``state``/``batch`` arrays (GSPMD); this
     function is mesh-agnostic. ``with_grad_norm`` adds a tree-wide grad-norm
     reduction to the metrics (off by default — it costs a full-tree
-    reduction per step)."""
+    reduction per step). ``attention_fn`` overrides the attention core —
+    the sequence-parallel paths (ring / Ulysses) plug in here."""
     optimizer = make_optimizer(tcfg)
 
     def step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
         rng = jax.random.fold_in(state.rng, state.step)
         loss, grads = jax.value_and_grad(loss_fn)(
             state.params, batch, mcfg, rng=rng,
-            train=(mcfg.dropout > 0 or mcfg.attn_dropout > 0))
+            train=(mcfg.dropout > 0 or mcfg.attn_dropout > 0),
+            attention_fn=attention_fn)
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
         params = jax.tree_util.tree_map(
@@ -58,12 +63,13 @@ def make_train_step(mcfg: ModelConfig, tcfg: TrainConfig,
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def make_eval_step(mcfg: ModelConfig) -> Callable:
+def make_eval_step(mcfg: ModelConfig, attention_fn=None) -> Callable:
     """Jitted single-batch eval loss (dropout off — GPT1.py:88 model.eval)."""
 
     @jax.jit
     def eval_step(params, batch) -> jnp.ndarray:
-        return loss_fn(params, batch, mcfg, rng=None, train=False)
+        return loss_fn(params, batch, mcfg, rng=None, train=False,
+                       attention_fn=attention_fn)
 
     return eval_step
 
