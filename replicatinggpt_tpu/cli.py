@@ -32,6 +32,11 @@ def _build_mesh_if_needed(cfg):
 
 
 def cmd_train(args) -> int:
+    if args.coordinator or args.num_processes:
+        from .parallel.distributed import initialize
+        pi, pn = initialize(args.coordinator, args.num_processes,
+                            args.process_id)
+        print(f"distributed: process {pi}/{pn}", file=sys.stderr)
     cfg = config_from_args(args)
     from .train.checkpoint import CheckpointManager
     from .train.runner import train
@@ -155,6 +160,12 @@ def main(argv=None) -> int:
     pt.add_argument("--sample-after", action="store_true",
                     help="print a sample after training (GPT1.py:235-236)")
     pt.add_argument("--sample-tokens", type=int, default=500)
+    pt.add_argument("--coordinator", default=None,
+                    help="multi-host coordinator address host:port "
+                         "(jax.distributed.initialize); TPU pods usually "
+                         "auto-detect and need none of these")
+    pt.add_argument("--num-processes", type=int, default=None)
+    pt.add_argument("--process-id", type=int, default=None)
     pt.set_defaults(fn=cmd_train)
 
     pg = sub.add_parser("generate", help="sample from a model")

@@ -76,6 +76,8 @@ class MeshConfig:
     - ``data``: data parallelism (batch dim) + FSDP parameter sharding
     - ``seq``:  sequence/context parallelism (ring attention over ICI)
     - ``model``: tensor parallelism (column/row-parallel matmuls)
+    - ``pipe``: pipeline parallelism (layer-stacked block params sharded by
+      stage; microbatches flow via ppermute — parallel/pipeline.py)
 
     The reference has no distributed machinery (SURVEY.md §2.1-§2.2); this is
     the TPU-native replacement: XLA GSPMD collectives derived from
@@ -85,15 +87,17 @@ class MeshConfig:
     data: int = 1
     seq: int = 1
     model: int = 1
+    pipe: int = 1
     fsdp: bool = False  # additionally shard params/opt-state over 'data'
+    microbatches: int = 0  # pipeline microbatches (0 = 2 per stage)
 
     @property
     def n_devices(self) -> int:
-        return self.data * self.seq * self.model
+        return self.data * self.seq * self.model * self.pipe
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return ("data", "seq", "model")
+        return ("data", "seq", "model", "pipe")
 
 
 @dataclass(frozen=True)
@@ -271,6 +275,9 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dp", type=int, default=None, help="mesh data axis size")
     p.add_argument("--sp", type=int, default=None, help="mesh seq axis size")
     p.add_argument("--tp", type=int, default=None, help="mesh model axis size")
+    p.add_argument("--pp", type=int, default=None, help="mesh pipe axis size")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="pipeline microbatches (default 2 per stage)")
     p.add_argument("--fsdp", action="store_true", default=None)
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--dataset", default=None)
@@ -294,6 +301,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
     ) if v is not None}
     meshk = {k: v for k, v in (
         ("data", args.dp), ("seq", args.sp), ("model", args.tp),
+        ("pipe", args.pp), ("microbatches", args.microbatches),
         ("fsdp", args.fsdp),
     ) if v is not None}
     ck = {}

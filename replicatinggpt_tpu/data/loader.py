@@ -56,10 +56,20 @@ class RandomBatcher:
 
 
 class SequentialBatcher:
-    """Contiguous windows with wraparound cursor — GPT-2.py:200-213 semantics."""
+    """Contiguous windows with wraparound cursor — GPT-2.py:200-213 semantics.
 
-    def __init__(self, data: np.ndarray, batch_size: int, block_size: int):
-        need = batch_size * block_size + 1
+    ``shard=(i, n)`` makes this one of n multi-host shards: the cursor walks
+    *global* (n*B*T)-token windows and this instance materializes only its
+    i-th contiguous B*T slice, so the assembled global batch is the same
+    token stream a single-host run would see. The cursor is identical on
+    every shard (it is global state), which keeps checkpoint save/restore
+    host-count independent.
+    """
+
+    def __init__(self, data: np.ndarray, batch_size: int, block_size: int,
+                 shard: Tuple[int, int] = (0, 1)):
+        self.shard_index, self.num_shards = shard
+        need = self.num_shards * batch_size * block_size + 1
         assert len(data) >= need, (
             f"corpus of {len(data)} tokens cannot fill one {need}-token window")
         self.data = data
@@ -68,12 +78,14 @@ class SequentialBatcher:
 
     def next_batch(self) -> Batch:
         B, T = self.B, self.T
-        if self.position + B * T + 1 > len(self.data):
+        stride = self.num_shards * B * T
+        if self.position + stride + 1 > len(self.data):
             self.position = 0
-        buf = self.data[self.position:self.position + B * T + 1]
+        start = self.position + self.shard_index * B * T
+        buf = self.data[start:start + B * T + 1]
         x = buf[:-1].reshape(B, T)
         y = buf[1:].reshape(B, T)
-        self.position += B * T
+        self.position += stride
         return x.astype(np.int32), y.astype(np.int32)
 
     def __iter__(self) -> Iterator[Batch]:
@@ -88,11 +100,12 @@ class SequentialBatcher:
 
 
 def make_batcher(kind: str, data: np.ndarray, batch_size: int,
-                 block_size: int, seed: int = 0):
+                 block_size: int, seed: int = 0,
+                 shard: Tuple[int, int] = (0, 1)):
     if kind == "random":
         return RandomBatcher(data, batch_size, block_size, seed)
     if kind == "sequential":
-        return SequentialBatcher(data, batch_size, block_size)
+        return SequentialBatcher(data, batch_size, block_size, shard=shard)
     raise ValueError(f"unknown sampling kind {kind!r}")
 
 
@@ -122,11 +135,15 @@ def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2
         return False
 
     def producer():
+        from ..parallel.distributed import global_batch
         for b in batches:
             if stop.is_set():
                 return
             if sharding is not None:
-                b = tuple(jax.device_put(a, sharding) for a in b)
+                # multi-process: each host contributes only its local rows
+                # (jax.make_array_from_process_local_data); single-process
+                # this is plain device_put with the sharding
+                b = tuple(global_batch(a, sharding) for a in b)
             else:
                 b = tuple(jax.device_put(a) for a in b)
             if not _put(b):

@@ -202,13 +202,16 @@ def _run_blocks(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
 def forward(params: Params, idx: jnp.ndarray, cfg: ModelConfig, *,
             targets: Optional[jnp.ndarray] = None,
             rng: Optional[jax.Array] = None, train: bool = False,
-            attention_fn=None) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+            attention_fn=None, blocks_fn=None
+            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Full-sequence forward. idx: (B, T) int32.
 
     Always returns ``(logits, loss)``; loss is None without targets — the
     reference's asymmetric return (GPT-2.py:124-128) is normalized away.
     Cross-entropy is computed in float32 over flattened (B*T) positions
-    (GPT1.py:186-192 semantics).
+    (GPT1.py:186-192 semantics). ``blocks_fn`` replaces the whole block
+    stack (the pipeline-parallel schedule plugs in here); ``attention_fn``
+    replaces just the attention core inside the default stack.
     """
     B, T = idx.shape
     cd = _dtype(cfg.dtype)
@@ -216,8 +219,11 @@ def forward(params: Params, idx: jnp.ndarray, cfg: ModelConfig, *,
     # instead crashed (SURVEY.md §8-B1/B5). Config and tokenizer are
     # validated host-side in the pipeline instead.
     x = params["wte"].astype(cd)[idx] + params["wpe"].astype(cd)[:T]
-    x = _run_blocks(x, params["blocks"], cfg, rng=rng, train=train,
-                    attention_fn=attention_fn)
+    if blocks_fn is not None:
+        x = blocks_fn(x, params["blocks"], cfg, rng=rng, train=train)
+    else:
+        x = _run_blocks(x, params["blocks"], cfg, rng=rng, train=train,
+                        attention_fn=attention_fn)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                     cfg.layernorm_eps)
     head = (params["wte"].astype(cd).T if cfg.tied_head

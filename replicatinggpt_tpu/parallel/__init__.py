@@ -1,12 +1,14 @@
 from .mesh import (make_mesh, make_batch_sharding, batch_pspec, state_pspecs,
                    param_pspecs, shard_train_state)
+from .pipeline import make_pipeline_blocks_fn, pipeline_blocks
 from .ring_attention import make_ring_attention_fn, ring_attention
 from .ulysses import make_ulysses_attention_fn, ulysses_attention
 
 __all__ = ["make_mesh", "make_batch_sharding", "batch_pspec", "state_pspecs",
            "param_pspecs", "shard_train_state", "ring_attention",
            "make_ring_attention_fn", "ulysses_attention",
-           "make_ulysses_attention_fn", "select_attention_fn"]
+           "make_ulysses_attention_fn", "select_attention_fn",
+           "pipeline_blocks", "make_pipeline_blocks_fn", "select_blocks_fn"]
 
 
 def select_attention_fn(mcfg, mesh_cfg, mesh):
@@ -31,3 +33,12 @@ def select_attention_fn(mcfg, mesh_cfg, mesh):
     if mcfg.attention_impl in ("auto", "ring"):
         return make_ring_attention_fn(mesh)
     return None
+
+
+def select_blocks_fn(mcfg, mesh_cfg, mesh):
+    """Pipeline-parallel block stack when the mesh has a pipe axis > 1
+    (supersedes attention_fn — the PP region runs its own in-scope ring
+    attention core over 'seq')."""
+    if mesh is None or mesh_cfg.pipe <= 1:
+        return None
+    return make_pipeline_blocks_fn(mesh, mesh_cfg)

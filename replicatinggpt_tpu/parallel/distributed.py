@@ -1,0 +1,98 @@
+"""Multi-host (pod-slice) runtime: the framework's DCN story.
+
+The reference is strictly single-process (SURVEY.md §2.2 — no NCCL/MPI/
+torch.distributed anywhere). The TPU-native equivalent is *not* a transport
+backend: ``jax.distributed.initialize()`` joins the processes of a pod
+slice, after which the same ``Mesh`` + ``NamedSharding`` annotations used
+single-host make XLA route collectives over ICI within a slice and DCN
+across slices. What this module adds on top is the host-side glue a
+multi-process data-parallel run actually needs:
+
+- :func:`initialize` — idempotent ``jax.distributed.initialize`` wrapper
+  (auto-detects TPU pod environments when no coordinator is given; no-op
+  for single-process runs).
+- :func:`local_batch_slice` — which rows of the global batch this process
+  must produce (each host feeds only its shard; per-host seeds derive from
+  the global seed + process index).
+- :func:`global_batch` — assemble a globally-sharded (B, T) array from
+  this process's local rows via ``jax.make_array_from_process_local_data``
+  (no host ever materializes the global batch).
+
+Single-process runs pass through all of these unchanged, so the training
+loop has exactly one code path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> Tuple[int, int]:
+    """Join the multi-process runtime. Returns (process_index, process_count).
+
+    With no arguments on a TPU pod slice, jax auto-detects the topology
+    from the TPU environment; on a single host this is a no-op. Safe to
+    call more than once.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_index(), jax.process_count()
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        _initialized = True
+    elif jax.process_count() > 1:
+        _initialized = True  # runtime already multi-process (launcher did it)
+    return jax.process_index(), jax.process_count()
+
+
+def local_batch_slice(global_batch_size: int) -> slice:
+    """Rows of the global (B, T) batch owned by this process.
+
+    Processes split the batch dim evenly; B must divide by process_count
+    (same contract the mesh 'data' axis imposes).
+    """
+    n, i = jax.process_count(), jax.process_index()
+    assert global_batch_size % n == 0, (
+        f"global batch {global_batch_size} not divisible by "
+        f"{n} processes")
+    per = global_batch_size // n
+    return slice(i * per, (i + 1) * per)
+
+
+def per_process_seed(seed: int) -> int:
+    """Decorrelate host-side batch sampling across processes.
+
+    Spaced 16 apart so callers can derive a few offset seeds (+1, +2 for
+    eval batchers) without colliding with a neighbor process's streams.
+    """
+    return seed * 1000003 + 16 * jax.process_index()
+
+
+def global_batch(local_rows: np.ndarray, sharding) -> jax.Array:
+    """Assemble the global array from this process's local rows.
+
+    ``local_rows``: (B/process_count, T) NumPy array; ``sharding``: the
+    NamedSharding of the global batch (P('data', 'seq')). Each process
+    contributes only its rows — the global batch never exists on any one
+    host. Single-process: equivalent to ``jax.device_put``.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(local_rows, sharding)
+    global_shape = (local_rows.shape[0] * jax.process_count(),
+                    *local_rows.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, local_rows, global_shape)
+
+
+def is_coordinator() -> bool:
+    """True on the process that should write checkpoints/logs (process 0)."""
+    return jax.process_index() == 0

@@ -55,7 +55,7 @@ def make_mesh(mesh_cfg: MeshConfig,
     assert len(devices) >= n, (
         f"mesh needs {n} devices, have {len(devices)}")
     arr = np.asarray(devices[:n]).reshape(
-        mesh_cfg.data, mesh_cfg.seq, mesh_cfg.model)
+        mesh_cfg.data, mesh_cfg.seq, mesh_cfg.model, mesh_cfg.pipe)
     return Mesh(arr, mesh_cfg.axis_names)
 
 
@@ -95,6 +95,8 @@ def _leaf_spec(path, shape: Tuple[int, ...], mesh_cfg: MeshConfig) -> P:
         if isinstance(k, jax.tree_util.DictKey):
             name = str(k.key)
             break
+    in_blocks = any(isinstance(k, jax.tree_util.DictKey)
+                    and str(k.key) == "blocks" for k in path)
     ndim = len(shape)
     spec = [None] * ndim
     if name is not None and ndim > 0:
@@ -103,6 +105,10 @@ def _leaf_spec(path, shape: Tuple[int, ...], mesh_cfg: MeshConfig) -> P:
         for d, ax in enumerate(spec):
             if ax == "model" and shape[d] % mesh_cfg.model != 0:
                 spec[d] = None
+    # pipeline: each stage stores its slice of the layer-stacked (L, ...) dim
+    if (mesh_cfg.pipe > 1 and in_blocks and ndim > 0
+            and shape[0] % mesh_cfg.pipe == 0):
+        spec[0] = "pipe"
     if mesh_cfg.fsdp and ndim > 0:
         # shard the largest unsharded divisible dim over 'data' (ZeRO-3)
         dims = sorted(range(ndim), key=lambda d: -shape[d])

@@ -1,0 +1,153 @@
+"""Pipeline parallelism: the block stack sharded over a 'pipe' mesh axis,
+microbatches flowing stage-to-stage via ``lax.ppermute`` (GPipe-style
+skewed schedule).
+
+The reference runs its blocks in an in-process Python loop on one device
+(GPT-2.py:117-118); SURVEY.md §2.1 lists PP as the remaining parallelism
+row. TPU-native formulation: each of the P stages holds n_layer/P of the
+layer-stacked block params (the (L, ...) leading dim is sharded over
+'pipe' — see mesh.py partition rules), the global batch splits into M
+microbatches, and the schedule runs M + P - 1 ticks. At tick t, stage s
+works on microbatch m = t - s (stage 0 reads fresh microbatches, the last
+stage banks finished ones), then every stage hands its activation to stage
+s+1 over a neighbor ppermute riding ICI. Finished outputs are broadcast
+from the last stage with a masked psum. The whole schedule is a
+``lax.scan``, so reverse-mode AD gives GPipe's backward for free.
+
+Composition: inside the shard_map region the 'seq' axis name is in scope,
+so the per-block attention core is the ring-attention local body — seq
+parallelism composes with PP natively (a 1-sized seq axis degrades to the
+plain causal core). The 'data' axis partitions microbatch rows as usual.
+The 'model' axis is *replicated* through this region in the current
+implementation (kernels are all-gathered on entry; TP-inside-PP would need
+hand-written Megatron collectives here — future work, documented
+limitation).
+
+Bubble math: utilization = M / (M + P - 1); pick microbatches >= 4*P to
+keep the bubble under ~25%.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import MeshConfig, ModelConfig
+
+
+def _pp_local(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
+              rng: Optional[jax.Array], *, cfg: ModelConfig, train: bool,
+              n_stages: int, axis_name: str = "pipe") -> jnp.ndarray:
+    """Per-device pipeline schedule.
+
+    x: (M, Bm, T_local, C) — all microbatches (replicated over 'pipe';
+    only stage 0 reads them). blocks: local leaves with leading
+    n_layer/n_stages. Returns (M, Bm, T_local, C) finished activations
+    (identical on every stage after the final broadcast).
+    """
+    from ..models.gpt import _block
+    from .ring_attention import _ring_local
+
+    stage = jax.lax.axis_index(axis_name)
+    M = x.shape[0]
+    Lp = cfg.n_layer // n_stages
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+    attn_local = functools.partial(_ring_local, axis_name="seq", scale=None)
+
+    if rng is not None:
+        # the rng enters replicated; decorrelate dropout masks across the
+        # data/seq shards (each device draws masks over its *local* shape,
+        # so an unfolded key would repeat the same mask on every shard)
+        shard_id = (jax.lax.axis_index("data") * jax.lax.axis_size("seq")
+                    + jax.lax.axis_index("seq"))
+        rng = jax.random.fold_in(rng, shard_id)
+
+    def run_stage(h: jnp.ndarray, m_idx: jnp.ndarray) -> jnp.ndarray:
+        """One microbatch through this stage's local layers."""
+        def body(carry, inputs):
+            lp, l_local = inputs
+            r = None
+            if rng is not None:
+                g_layer = stage * Lp + l_local
+                r = jax.random.fold_in(jax.random.fold_in(rng, g_layer),
+                                       m_idx)
+            return _block(carry, lp, cfg, rng=r, train=train,
+                          attention_fn=attn_local), None
+
+        h, _ = jax.lax.scan(body, h, (blocks, jnp.arange(Lp)))
+        return h
+
+    def tick(carry, t):
+        buf, out = carry
+        m = t - stage                       # microbatch this stage handles
+        active = jnp.logical_and(m >= 0, m < M)
+        m_c = jnp.clip(m, 0, M - 1)
+        # stage 0 ingests a fresh microbatch; later stages consume what
+        # arrived over the ring last tick (zeros during fill — harmless)
+        inp = jnp.where(stage == 0, x[jnp.clip(t, 0, M - 1)], buf)
+        h = run_stage(inp, m_c)
+        banked = jax.lax.dynamic_update_index_in_dim(out, h, m_c, 0)
+        out = jnp.where(jnp.logical_and(stage == n_stages - 1, active),
+                        banked, out)
+        buf = jax.lax.ppermute(h, axis_name, perm)
+        return (buf, out), None
+
+    buf0 = jnp.zeros_like(x[0])
+    out0 = jnp.zeros_like(x)
+    (_, out), _ = jax.lax.scan(tick, (buf0, out0),
+                               jnp.arange(M + n_stages - 1))
+    # everyone needs the result (loss/head are replicated over 'pipe'):
+    # masked psum broadcasts the last stage's bank
+    out = jax.lax.psum(
+        jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)),
+        axis_name)
+    return out
+
+
+def pipeline_blocks(x: jnp.ndarray, blocks, cfg: ModelConfig, *,
+                    mesh: Mesh, n_microbatches: int,
+                    rng: Optional[jax.Array] = None,
+                    train: bool = False) -> jnp.ndarray:
+    """Run the block stack pipelined. x: global (B, T, C); blocks: the
+    layer-stacked params dict ((L, ...) leaves, 'pipe'-sharded on dim 0).
+
+    Drop-in replacement for models.gpt._run_blocks on a pipe>1 mesh.
+    """
+    B, T, C = x.shape
+    M = n_microbatches
+    assert B % M == 0, f"batch {B} not divisible into {M} microbatches"
+    n_stages = mesh.shape["pipe"]
+    assert cfg.n_layer % n_stages == 0, (
+        f"n_layer {cfg.n_layer} not divisible by {n_stages} pipeline stages")
+
+    xm = x.reshape(M, B // M, T, C)
+    x_spec = P(None, "data", "seq", None)
+    blocks_spec = jax.tree_util.tree_map(
+        lambda leaf: P(*(("pipe",) + (None,) * (leaf.ndim - 1))), blocks)
+    rng_spec = None if rng is None else P()
+
+    fn = jax.shard_map(
+        functools.partial(_pp_local, cfg=cfg, train=train,
+                          n_stages=n_stages),
+        mesh=mesh,
+        in_specs=(x_spec, blocks_spec, rng_spec),
+        out_specs=x_spec,
+        check_vma=False)
+    out = fn(xm, blocks, rng)
+    return out.reshape(B, T, C)
+
+
+def make_pipeline_blocks_fn(mesh: Mesh, mesh_cfg: MeshConfig):
+    """blocks_fn for ``models.gpt.forward`` — binds mesh + microbatch count
+    (mesh_cfg.microbatches, defaulting to 2 per stage)."""
+    M = mesh_cfg.microbatches or 2 * mesh_cfg.pipe
+
+    def blocks_fn(x, blocks, cfg, *, rng, train):
+        return pipeline_blocks(x, blocks, cfg, mesh=mesh, n_microbatches=M,
+                               rng=rng, train=train)
+
+    return blocks_fn

@@ -59,13 +59,38 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     logger.log(f"dataset: {len(ds.train):,} train / {len(ds.val):,} val "
                f"tokens, vocab {tokenizer.vocab_size}")
 
-    train_batcher = make_batcher(tcfg.sampling, ds.train, tcfg.batch_size,
-                                 mcfg.block_size, seed=tcfg.seed)
+    # Multi-host: each process assembles only its slice of the global batch
+    # (rows land in the global array via make_array_from_process_local_data
+    # in the prefetch producer). Single-process: local == global, seeds
+    # untouched so the reference-seeded run is bit-stable.
+    n_proc = jax.process_count()
+    seed = tcfg.seed
+    proc = 0
+    if n_proc > 1:
+        from ..parallel.distributed import (is_coordinator,
+                                            local_batch_slice,
+                                            per_process_seed)
+        sl = local_batch_slice(tcfg.batch_size)
+        local_bs = sl.stop - sl.start
+        seed = per_process_seed(tcfg.seed)
+        proc = jax.process_index()
+        # the batch's 'data' dim must split along process boundaries for
+        # make_array_from_process_local_data to assemble per-host rows
+        assert cfg.mesh.data % n_proc == 0, (
+            f"multi-host runs need the 'data' mesh axis ({cfg.mesh.data}) "
+            f"to span the {n_proc} processes")
+        logger.quiet = not is_coordinator()
+    else:
+        local_bs = tcfg.batch_size
+
+    train_batcher = make_batcher(tcfg.sampling, ds.train, local_bs,
+                                 mcfg.block_size, seed=seed,
+                                 shard=(proc, n_proc))
     eval_batchers = {
-        "train": make_batcher("random", ds.train, tcfg.batch_size,
-                              mcfg.block_size, seed=tcfg.seed + 1),
-        "val": make_batcher("random", ds.val, tcfg.batch_size,
-                            mcfg.block_size, seed=tcfg.seed + 2),
+        "train": make_batcher("random", ds.train, local_bs,
+                              mcfg.block_size, seed=seed + 1),
+        "val": make_batcher("random", ds.val, local_bs,
+                            mcfg.block_size, seed=seed + 2),
     }
 
     rng = jax.random.PRNGKey(tcfg.seed)
@@ -83,24 +108,48 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                f"({mcfg.n_layer}L/{mcfg.n_head}H/{mcfg.n_embd}C, "
                f"dtype={mcfg.dtype})")
 
-    attention_fn = None
+    attention_fn = blocks_fn = None
     if mesh is not None:
-        from ..parallel import select_attention_fn
-        attention_fn = select_attention_fn(mcfg, cfg.mesh, mesh)
-        if attention_fn is not None:
-            logger.log(f"sequence parallelism: seq axis {cfg.mesh.seq}, "
-                       f"impl {mcfg.attention_impl!r}"
-                       + (" (attention-weight dropout not applied on the "
-                          "seq-parallel path)" if mcfg.attn_dropout > 0
+        from ..parallel import select_attention_fn, select_blocks_fn
+        blocks_fn = select_blocks_fn(mcfg, cfg.mesh, mesh)
+        if blocks_fn is not None:
+            logger.log(f"pipeline parallelism: {cfg.mesh.pipe} stages, "
+                       f"{cfg.mesh.microbatches or 2 * cfg.mesh.pipe} "
+                       f"microbatches"
+                       + (" (attention-weight dropout not applied on the"
+                          " pipeline path)" if mcfg.attn_dropout > 0
                           else ""))
-    train_step = make_train_step(mcfg, tcfg, attention_fn=attention_fn)
-    eval_step = make_eval_step(mcfg, attention_fn=attention_fn)
-    dput = ((lambda a: jax.device_put(a, batch_sharding))
-            if batch_sharding is not None else jax.device_put)
+        else:
+            attention_fn = select_attention_fn(mcfg, cfg.mesh, mesh)
+            if attention_fn is not None:
+                logger.log(f"sequence parallelism: seq axis {cfg.mesh.seq}, "
+                           f"impl {mcfg.attention_impl!r}"
+                           + (" (attention-weight dropout not applied on the"
+                              " seq-parallel path)" if mcfg.attn_dropout > 0
+                              else ""))
+    train_step = make_train_step(mcfg, tcfg, attention_fn=attention_fn,
+                                 blocks_fn=blocks_fn)
+    eval_step = make_eval_step(mcfg, attention_fn=attention_fn,
+                               blocks_fn=blocks_fn)
+    if batch_sharding is not None:
+        from ..parallel.distributed import global_batch
+        dput = (lambda a: global_batch(a, batch_sharding))
+    else:
+        dput = jax.device_put
 
     start_step = 0
     if checkpoint_manager is not None and resume:
-        restored = checkpoint_manager.restore_latest(state, train_batcher)
+        # Random-sampling batcher state is a host-local RNG; restoring the
+        # (single, primary-host) saved copy onto every host would collapse
+        # the per-process decorrelation. The sequential cursor is global
+        # state and restores safely on any host count.
+        restore_batcher = (train_batcher
+                           if (n_proc == 1 or tcfg.sampling == "sequential")
+                           else None)
+        if restore_batcher is None:
+            logger.log("multi-host resume: random-batcher RNG state not "
+                       "restored; streams re-seeded per process")
+        restored = checkpoint_manager.restore_latest(state, restore_batcher)
         if restored is not None:
             state = restored
             start_step = int(jax.device_get(state.step))

@@ -22,17 +22,17 @@ from .state import TrainState, make_optimizer
 
 
 def loss_fn(params, batch, cfg: ModelConfig, rng=None, train=False,
-            attention_fn=None):
+            attention_fn=None, blocks_fn=None):
     x, y = batch
     _, loss = forward(params, x, cfg, targets=y, rng=rng, train=train,
-                      attention_fn=attention_fn)
+                      attention_fn=attention_fn, blocks_fn=blocks_fn)
     return loss
 
 
 def make_train_step(mcfg: ModelConfig, tcfg: TrainConfig,
                     donate: bool = True,
                     with_grad_norm: bool = False,
-                    attention_fn=None) -> Callable:
+                    attention_fn=None, blocks_fn=None) -> Callable:
     """Build the jitted train step. Sharded execution comes from the
     shardings already attached to ``state``/``batch`` arrays (GSPMD); this
     function is mesh-agnostic. ``with_grad_norm`` adds a tree-wide grad-norm
@@ -46,7 +46,7 @@ def make_train_step(mcfg: ModelConfig, tcfg: TrainConfig,
         loss, grads = jax.value_and_grad(loss_fn)(
             state.params, batch, mcfg, rng=rng,
             train=(mcfg.dropout > 0 or mcfg.attn_dropout > 0),
-            attention_fn=attention_fn)
+            attention_fn=attention_fn, blocks_fn=blocks_fn)
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
         params = jax.tree_util.tree_map(
@@ -63,13 +63,14 @@ def make_train_step(mcfg: ModelConfig, tcfg: TrainConfig,
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def make_eval_step(mcfg: ModelConfig, attention_fn=None) -> Callable:
+def make_eval_step(mcfg: ModelConfig, attention_fn=None,
+                   blocks_fn=None) -> Callable:
     """Jitted single-batch eval loss (dropout off — GPT1.py:88 model.eval)."""
 
     @jax.jit
     def eval_step(params, batch) -> jnp.ndarray:
         return loss_fn(params, batch, mcfg, rng=None, train=False,
-                       attention_fn=attention_fn)
+                       attention_fn=attention_fn, blocks_fn=blocks_fn)
 
     return eval_step
 

@@ -17,13 +17,18 @@ from typing import Optional, TextIO
 
 class StepLogger:
     def __init__(self, jsonl_path: Optional[str] = None,
-                 stream: TextIO = sys.stdout):
+                 stream: TextIO = sys.stdout, quiet: bool = False):
         self.stream = stream
         self.jsonl = open(jsonl_path, "a") if jsonl_path else None
         self.t_last = time.perf_counter()
+        # quiet silences everything (set on non-coordinator hosts of a
+        # multi-process run so the pod logs once, not n_proc times)
+        self.quiet = quiet
 
     def log_step(self, step: int, loss: float, tokens: int,
                  n_chips: int = 1, lr: Optional[float] = None) -> None:
+        if self.quiet:
+            return
         now = time.perf_counter()
         dt = max(now - self.t_last, 1e-9)
         self.t_last = now
@@ -36,6 +41,8 @@ class StepLogger:
                      "time": time.time()})
 
     def log_eval(self, step: int, train_loss: float, val_loss: float) -> None:
+        if self.quiet:
+            return
         # GPT1.py:225 format
         print(f"step {step} : train loss {train_loss:.4f}, "
               f"val loss = {val_loss:.4f}", file=self.stream)
@@ -44,6 +51,8 @@ class StepLogger:
                      "val_loss": float(val_loss), "time": time.time()})
 
     def log(self, msg: str, **fields) -> None:
+        if self.quiet:
+            return
         print(msg, file=self.stream)
         if fields:
             self._jsonl({"event": "info", "msg": msg, **fields,

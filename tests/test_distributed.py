@@ -1,0 +1,40 @@
+"""Multi-host glue (parallel/distributed.py), exercised in its
+single-process degenerate form (real multi-process needs a pod; the
+structural contract — local slices, per-process seeds, global assembly —
+is what these tests pin)."""
+
+import jax
+import numpy as np
+import pytest
+
+from replicatinggpt_tpu.config import MeshConfig
+from replicatinggpt_tpu.parallel.distributed import (global_batch,
+                                                     initialize,
+                                                     is_coordinator,
+                                                     local_batch_slice,
+                                                     per_process_seed)
+from replicatinggpt_tpu.parallel.mesh import make_batch_sharding, make_mesh
+
+
+def test_initialize_single_process_noop():
+    pi, pn = initialize()
+    assert (pi, pn) == (0, 1)
+    assert is_coordinator()
+
+
+def test_local_batch_slice_covers_batch():
+    s = local_batch_slice(64)
+    assert (s.start, s.stop) == (0, 64)
+
+
+def test_per_process_seed_deterministic():
+    assert per_process_seed(1337) == per_process_seed(1337)
+
+
+def test_global_batch_matches_device_put_single_process():
+    mesh = make_mesh(MeshConfig(data=4, seq=2, model=1))
+    sharding = make_batch_sharding(mesh)
+    x = np.arange(8 * 16, dtype=np.int32).reshape(8, 16)
+    arr = global_batch(x, sharding)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    assert arr.sharding == sharding

@@ -59,7 +59,7 @@ def test_requires_eight_devices():
 
 def test_mesh_construction():
     mesh = make_mesh(MeshConfig(data=2, seq=2, model=2))
-    assert mesh.shape == {"data": 2, "seq": 2, "model": 2}
+    assert mesh.shape == {"data": 2, "seq": 2, "model": 2, "pipe": 1}
     assert make_batch_sharding(mesh).spec == P("data", "seq")
 
 
