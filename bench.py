@@ -61,9 +61,47 @@ def torch_cpu_baseline(mcfg, batch_size: int, remeasure: bool) -> float:
     return tps
 
 
+def bench_generate(args) -> None:
+    """BASELINE.json config 5: autoregressive generate latency — 1k-token
+    sample, p50 tokens/sec — measured with the blocking StepTimer
+    discipline (one lap per 256-token decode segment)."""
+    import jax
+    import jax.numpy as jnp
+
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.sample import GenerateConfig, generate
+    from replicatinggpt_tpu.train.state import create_train_state
+    from replicatinggpt_tpu.utils.profiling import StepTimer
+
+    cfg = get_config(args.preset)
+    mcfg = cfg.model
+    state = create_train_state(jax.random.PRNGKey(0), mcfg, cfg.train)
+    gcfg = GenerateConfig(max_new_tokens=1000, top_k=50)
+    prompt = jnp.zeros((1, 1), jnp.int32)
+    log(f"generate bench: 1000 tokens, top-k 50, "
+        f"{mcfg.n_layer}L/{mcfg.n_head}H/{mcfg.n_embd}C")
+    jax.block_until_ready(generate(state.params, prompt, mcfg, gcfg))  # warm
+    timer = StepTimer()
+    timer.start()
+    for i in range(args.steps):
+        toks = generate(state.params, prompt, mcfg, gcfg,
+                        rng=jax.random.PRNGKey(i))
+        timer.lap(toks)
+    s = timer.summary(tokens_per_step=gcfg.max_new_tokens)
+    log(f"p50 {s['p50_s'] * 1e3:.1f} ms/1k-tok, "
+        f"{s['tokens_per_sec_per_chip']:,.0f} tok/s p50")
+    print(json.dumps({
+        "metric": "generate_1k_tokens_per_sec_p50",
+        "value": round(s["tokens_per_sec_per_chip"], 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,  # reference publishes no generation numbers
+    }))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="char-gpt")
+    p.add_argument("--mode", default="train", choices=["train", "generate"])
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=5)
@@ -80,6 +118,8 @@ def main() -> None:
     import jax
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    if args.mode == "generate":
+        return bench_generate(args)
     import numpy as np
 
     from replicatinggpt_tpu.config import get_config

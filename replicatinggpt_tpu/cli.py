@@ -45,8 +45,14 @@ def cmd_train(args) -> int:
     ck = (CheckpointManager(args.checkpoint_dir)
           if args.checkpoint_dir else None)
     mesh = _build_mesh_if_needed(cfg)
+    if args.profile_port:
+        from .utils.profiling import start_server
+        start_server(args.profile_port)
+        print(f"profiler server on :{args.profile_port}", file=sys.stderr)
     res = train(cfg, mesh=mesh, logger=logger, checkpoint_manager=ck,
-                resume=args.resume)
+                resume=args.resume, profile_dir=args.profile_dir,
+                profile_start=args.profile_start,
+                profile_steps=args.profile_steps)
     if args.sample_after:
         _sample(res.state.params, cfg, res.tokenizer, args.sample_tokens)
     if ck:
@@ -166,6 +172,13 @@ def main(argv=None) -> int:
                          "auto-detect and need none of these")
     pt.add_argument("--num-processes", type=int, default=None)
     pt.add_argument("--process-id", type=int, default=None)
+    pt.add_argument("--profile-dir", default=None,
+                    help="write a jax.profiler trace of a few hot-loop "
+                         "steps here (view in TensorBoard/Perfetto)")
+    pt.add_argument("--profile-start", type=int, default=10)
+    pt.add_argument("--profile-steps", type=int, default=5)
+    pt.add_argument("--profile-port", type=int, default=0,
+                    help="start a live profiler server on this port")
     pt.set_defaults(fn=cmd_train)
 
     pg = sub.add_parser("generate", help="sample from a model")

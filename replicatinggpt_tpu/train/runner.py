@@ -47,7 +47,9 @@ def _resolve_vocab(cfg: Config, tokenizer) -> Config:
 
 
 def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
-          checkpoint_manager=None, resume: bool = False) -> TrainResult:
+          checkpoint_manager=None, resume: bool = False,
+          profile_dir: Optional[str] = None,
+          profile_start: int = 10, profile_steps: int = 5) -> TrainResult:
     logger = logger or StepLogger()
     text = load_corpus(cfg.dataset)
     tokenizer = get_tokenizer(cfg.tokenizer, corpus_text=text,
@@ -159,26 +161,41 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     tokens_per_batch = tcfg.batch_size * mcfg.block_size
     batches = prefetch(iter(train_batcher), sharding=batch_sharding)
     import time
+
+    from ..utils.profiling import trace_window
+    if profile_dir and start_step + profile_start >= tcfg.max_iters:
+        # clamp so a short/resumed run still produces the promised trace
+        profile_start = max(tcfg.max_iters - start_step - profile_steps, 0)
+    profiler = trace_window(profile_dir, start=start_step + profile_start,
+                            n_steps=profile_steps)
+    if profile_dir:
+        logger.log(f"profiling steps {start_step + profile_start}.."
+                   f"{start_step + profile_start + profile_steps} "
+                   f"-> {profile_dir}")
     t0 = time.perf_counter()
     tokens_seen = 0
     logger.reset_timer()
-    for it in range(start_step, tcfg.max_iters):
-        if (tcfg.eval_interval and it % tcfg.eval_interval == 0):
-            losses = estimate_loss(state.params, eval_batchers, eval_step,
-                                   tcfg.eval_iters, device_put=dput)
-            logger.log_eval(it, losses["train"], losses["val"])
-            history.append((it, losses["train"], losses["val"]))
-            logger.reset_timer()
-        batch = next(batches)
-        state, metrics = train_step(state, batch)
-        tokens_seen += tokens_per_batch
-        if tcfg.log_interval and (it + 1) % tcfg.log_interval == 0:
-            logger.log_step(it, float(metrics["loss"]),
-                            tokens_per_batch * tcfg.log_interval, n_chips)
-        if (checkpoint_manager is not None and tcfg.checkpoint_every
-                and (it + 1) % tcfg.checkpoint_every == 0):
-            checkpoint_manager.save(state, train_batcher)
-
+    try:
+        for it in range(start_step, tcfg.max_iters):
+            if (tcfg.eval_interval and it % tcfg.eval_interval == 0):
+                losses = estimate_loss(state.params, eval_batchers, eval_step,
+                                       tcfg.eval_iters, device_put=dput)
+                logger.log_eval(it, losses["train"], losses["val"])
+                history.append((it, losses["train"], losses["val"]))
+                logger.reset_timer()
+            # after the eval block so the trace captures train steps only
+            profiler.step(it)
+            batch = next(batches)
+            state, metrics = train_step(state, batch)
+            tokens_seen += tokens_per_batch
+            if tcfg.log_interval and (it + 1) % tcfg.log_interval == 0:
+                logger.log_step(it, float(metrics["loss"]),
+                                tokens_per_batch * tcfg.log_interval, n_chips)
+            if (checkpoint_manager is not None and tcfg.checkpoint_every
+                    and (it + 1) % tcfg.checkpoint_every == 0):
+                checkpoint_manager.save(state, train_batcher)
+    finally:
+        profiler.close()
     jax.block_until_ready(state.params)
     wall = time.perf_counter() - t0
     final_eval = estimate_loss(state.params, eval_batchers, eval_step,

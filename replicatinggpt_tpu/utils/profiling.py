@@ -1,0 +1,131 @@
+"""Tracing / profiling subsystem.
+
+The reference has no profiler, timers, or even per-step timing (SURVEY.md §5
+row 1 — ABSENT). The TPU-native equivalent supplied here:
+
+- ``trace(logdir)``: context manager around ``jax.profiler`` emitting an XLA
+  trace viewable in TensorBoard / Perfetto (device timelines, HLO op costs,
+  HBM usage).
+- ``trace_window``: step-triggered tracing for the hot loop — capture steps
+  [start, start+n) of a training run without paying trace overhead elsewhere.
+- ``start_server``: on-demand profiling of a live job from TensorBoard.
+- ``annotate``: named host-side regions that show up on the trace timeline.
+- ``StepTimer``: blocking per-step latency statistics (p50/p90/mean,
+  tokens/sec) — used by the latency benchmarks (``bench.py --mode
+  generate``, the BASELINE.json "p50 generate latency" metric); every lap
+  calls ``jax.block_until_ready`` so async dispatch can't hide device
+  time. Throughput benchmarks deliberately time an unsynchronized span
+  instead, since a per-step device sync over a tunneled TPU would
+  dominate small step times.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+
+def start_server(port: int = 9012):
+    """Start the profiler RPC server so TensorBoard can capture on demand."""
+    return jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Trace everything inside the block into ``logdir``."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region on the profiler timeline (host + linked device ops)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class trace_window:
+    """Step-triggered tracing: trace steps [start, start + n_steps).
+
+    Usage in a loop::
+
+        win = trace_window(logdir, start=10, n_steps=5)
+        for it in range(max_iters):
+            win.step(it)        # starts/stops the trace at the boundaries
+            ...
+        win.close()             # in case the loop ended mid-window
+    """
+
+    def __init__(self, logdir: Optional[str], start: int = 10,
+                 n_steps: int = 5):
+        self.logdir = logdir
+        self.start = start
+        self.stop_at = start + n_steps
+        self._active = False
+
+    def step(self, it: int) -> None:
+        if not self.logdir:
+            return
+        if not self._active and self.start <= it < self.stop_at:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif self._active and it >= self.stop_at:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+class StepTimer:
+    """Blocking wall-clock statistics for jitted steps."""
+
+    def __init__(self) -> None:
+        self.laps: List[float] = []
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def lap(self, *block_on: Any) -> float:
+        """End the current lap, blocking on ``block_on`` first. Returns
+        the lap time and immediately starts the next lap."""
+        if block_on:
+            jax.block_until_ready(block_on)
+        now = time.perf_counter()
+        assert self._t0 is not None, "call start() before lap()"
+        dt = now - self._t0
+        self.laps.append(dt)
+        self._t0 = now
+        return dt
+
+    @staticmethod
+    def _pct(sorted_laps: List[float], q: float) -> float:
+        if not sorted_laps:
+            return 0.0
+        i = min(int(q * (len(sorted_laps) - 1) + 0.5), len(sorted_laps) - 1)
+        return sorted_laps[i]
+
+    def summary(self, tokens_per_step: int = 0, n_chips: int = 1,
+                skip: int = 0) -> Dict[str, float]:
+        """Stats over laps[skip:] (skip warmup/compile laps)."""
+        laps = self.laps[skip:]
+        if not laps:
+            return {"n": 0, "mean_s": 0.0, "p50_s": 0.0, "p90_s": 0.0,
+                    "tokens_per_sec_per_chip": 0.0}
+        s = sorted(laps)
+        mean = sum(laps) / len(laps)
+        p50 = self._pct(s, 0.50)
+        out = {"n": float(len(laps)), "mean_s": mean, "p50_s": p50,
+               "p90_s": self._pct(s, 0.90),
+               "tokens_per_sec_per_chip": 0.0}
+        if tokens_per_step and p50 > 0:
+            out["tokens_per_sec_per_chip"] = (
+                tokens_per_step / p50 / max(n_chips, 1))
+        return out

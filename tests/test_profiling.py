@@ -1,0 +1,81 @@
+"""Profiling subsystem tests (SURVEY.md §5 row 1 — absent in reference;
+supplied as jax.profiler traces + blocking step-latency statistics)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from replicatinggpt_tpu.utils.profiling import (StepTimer, annotate, trace,
+                                                trace_window)
+
+
+def test_trace_writes_artifacts(tmp_path):
+    logdir = str(tmp_path / "trace")
+    f = jax.jit(lambda x: (x * 2.0).sum())
+    with trace(logdir):
+        with annotate("hot-region"):
+            jax.block_until_ready(f(jnp.ones((64, 64))))
+    hits = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                     recursive=True)
+    assert hits, f"no trace artifacts under {logdir}"
+
+
+def test_trace_window_covers_requested_steps(tmp_path):
+    logdir = str(tmp_path / "win")
+    win = trace_window(logdir, start=2, n_steps=2)
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8)
+    for it in range(6):
+        win.step(it)
+        assert win._active == (2 <= it < 4)
+        x = f(x)
+    win.close()
+    assert not win._active
+    assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                     recursive=True)
+
+
+def test_trace_window_disabled_without_logdir():
+    win = trace_window(None, start=0, n_steps=100)
+    for it in range(5):
+        win.step(it)
+        assert not win._active
+    win.close()
+
+
+def test_step_timer_stats():
+    t = StepTimer()
+    t.start()
+    t.laps = [0.1, 0.2, 0.3, 0.4, 1.0]  # inject deterministic laps
+    s = t.summary(tokens_per_step=1000, n_chips=2, skip=1)
+    assert s["n"] == 4
+    assert abs(s["mean_s"] - (0.2 + 0.3 + 0.4 + 1.0) / 4) < 1e-9
+    assert s["p50_s"] in (0.3, 0.4)
+    assert s["tokens_per_sec_per_chip"] == 1000 / s["p50_s"] / 2
+
+
+def test_step_timer_laps_block():
+    t = StepTimer()
+    t.start()
+    y = jax.jit(lambda x: x @ x)(jnp.ones((128, 128)))
+    dt = t.lap(y)
+    assert dt > 0 and len(t.laps) == 1
+    assert t.summary()["n"] == 1
+
+
+def test_runner_profile_dir(tmp_path):
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.train.runner import train
+    from replicatinggpt_tpu.utils.logging import StepLogger
+    import dataclasses as dc
+
+    cfg = get_config("test-tiny")
+    cfg = cfg.replace(train=dc.replace(cfg.train, max_iters=4,
+                                       eval_interval=0, log_interval=0))
+    logdir = str(tmp_path / "prof")
+    train(cfg, logger=StepLogger(stream=open(os.devnull, "w")),
+          profile_dir=logdir, profile_start=1, profile_steps=2)
+    assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                     recursive=True)
