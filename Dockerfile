@@ -1,0 +1,39 @@
+# Training/inference image for the TPU-native framework.
+#
+# The reference ships a placeholder (docker/whalesay + fortune|cowsay,
+# /root/reference/Dockerfile:1-4) — packaging existed as a gesture only
+# (SURVEY.md §2.0 C23). This is the real equivalent: a runnable image with
+# the framework, its JAX TPU stack, and the native fastpath toolchain.
+#
+# Build:  docker build -t replicatinggpt-tpu .
+# Train:  docker run --privileged replicatinggpt-tpu \
+#             train --preset char-gpt --checkpoint-dir /ckpt
+# (TPU VMs need --privileged and the host's /dev accelerator nodes; on a
+#  pod slice, run one container per host with --num-processes/--process-id
+#  or let the TPU runtime auto-configure jax.distributed.)
+
+FROM python:3.12-slim
+
+# g++ compiles the native host-side fastpath (replicatinggpt_tpu/native/)
+# on first import; build-essential keeps that path available in-image.
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        build-essential \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+
+# TPU wheel pulls libtpu; the same image runs on CPU (tests, dry runs).
+RUN pip install --no-cache-dir "jax[tpu]" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir optax orbax-checkpoint regex numpy pytest
+
+COPY replicatinggpt_tpu/ replicatinggpt_tpu/
+COPY datasets/ datasets/
+COPY tests/ tests/
+COPY bench.py ./
+
+# pre-build the native fastpath so first run doesn't pay the compile
+RUN python -m replicatinggpt_tpu.native.build
+
+ENTRYPOINT ["python", "-m", "replicatinggpt_tpu"]
+CMD ["train", "--preset", "char-gpt"]

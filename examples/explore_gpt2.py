@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Exploration walkthrough — the TPU-native equivalent of the reference's
+``GPTNotebook2.ipynb`` (its only test artifact, SURVEY.md §2.0 C22).
+
+The notebook's three exercises, re-done against this framework, offline:
+
+1. cells 0-2 — inspect the GPT-2 parameter inventory (names + shapes).
+   The notebook downloads HF gpt2 and prints ``state_dict`` entries; here
+   the same inventory comes from the framework's own pytree layout for the
+   124M config, alongside the HF name each tensor imports from
+   (interop/hf.py mapping of GPT-2.py:132-177). With network access,
+   ``python -m replicatinggpt_tpu import-hf --model-type gpt2`` does the
+   real import.
+2. cell 3 — seeded generation smoke test (the notebook uses HF
+   ``pipeline('text-generation')`` + ``set_seed(42)``): a seeded sample
+   from a framework model.
+3. cells 4-6 — tokenize 1000 characters of the corpus and reshape a
+   24-token prefix to (8, 3) batches.
+
+Run: python examples/explore_gpt2.py  (CPU-safe, ~30 s)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def section(title):
+    print(f"\n=== {title} " + "=" * max(0, 60 - len(title)))
+
+
+# --- 1. parameter inventory (notebook cells 0-2) ---------------------------
+section("GPT-2 124M parameter inventory")
+from replicatinggpt_tpu.interop.hf import config_for_model_type
+from replicatinggpt_tpu.models.gpt import init_params, param_count
+
+cfg = config_for_model_type("gpt2")
+params = jax.eval_shape(lambda k: init_params(k, cfg), jax.random.PRNGKey(0))
+flat, _ = jax.tree_util.tree_flatten_with_path(params)
+for path, leaf in flat:
+    name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+    print(f"{name:<28} {tuple(leaf.shape)}")
+print(f"total params: {param_count(params):,} "
+      f"(the notebook's gpt2 state_dict counts 124M)")
+print("per-layer tensors carry a leading (n_layer,) axis — the lax.scan "
+      "layout; HF Conv1D weights import untransposed (interop/hf.py)")
+
+# --- 2. seeded generation smoke test (notebook cell 3) ---------------------
+section("seeded generation smoke test")
+from replicatinggpt_tpu.config import get_config
+from replicatinggpt_tpu.data.dataset import load_corpus
+from replicatinggpt_tpu.sample import GenerateConfig, generate
+from replicatinggpt_tpu.tokenizers import get_tokenizer
+
+tiny = get_config("test-tiny")
+text = load_corpus(os.path.join(os.path.dirname(__file__), "..",
+                                tiny.dataset))
+tok = get_tokenizer("char", corpus_text=text)
+mcfg = tiny.model
+params = init_params(jax.random.PRNGKey(0), mcfg)
+prompt = jnp.asarray(np.array([tok.encode("ROMEO:")], np.int32))
+toks = generate(params, prompt, mcfg,
+                GenerateConfig(max_new_tokens=40, top_k=50),
+                rng=jax.random.PRNGKey(42))  # the notebook's set_seed(42)
+print("prompt 'ROMEO:' ->", repr(tok.decode(np.asarray(toks)[0].tolist())))
+print("(untrained weights: expect noise; train with "
+      "`python -m replicatinggpt_tpu train --preset char-gpt`)")
+
+# --- 3. tokenize + reshape (notebook cells 4-6) ----------------------------
+section("tokenize 1000 chars, reshape 24 tokens to (8, 3)")
+bpe = get_tokenizer("bpe", corpus_text=text,
+                    cache_dir=os.path.join(os.path.dirname(__file__), "..",
+                                           "datasets"))
+ids = bpe.encode(text[:1000])
+print(f"1000 chars -> {len(ids)} BPE tokens (vocab {bpe.vocab_size})")
+buf = np.asarray(ids[:24], np.int32).reshape(8, 3)
+print("first 24 tokens as an (8, 3) batch:\n", buf)
+print("decoded row 0:", repr(bpe.decode(buf[0].tolist())))

@@ -29,7 +29,10 @@ class TokenDataset:
     @classmethod
     def from_text(cls, text: str, tokenizer, val_fraction: float = 0.1
                   ) -> "TokenDataset":
-        ids = np.asarray(tokenizer.encode(text), dtype=np.int32)
+        if hasattr(tokenizer, "encode_np"):  # native fastpath when built
+            ids = np.asarray(tokenizer.encode_np(text), dtype=np.int32)
+        else:
+            ids = np.asarray(tokenizer.encode(text), dtype=np.int32)
         n = int(len(ids) * (1.0 - val_fraction))
         return cls(train=ids[:n], val=ids[n:], vocab_size=tokenizer.vocab_size)
 

@@ -31,7 +31,7 @@ class RandomBatcher:
     def __init__(self, data: np.ndarray, batch_size: int, block_size: int,
                  seed: int = 0):
         assert len(data) > block_size + 1, "corpus shorter than block_size"
-        self.data = data
+        self.data = np.ascontiguousarray(data, np.int32)
         self.B, self.T = batch_size, block_size
         self.rng = np.random.default_rng(seed)
 
@@ -39,9 +39,11 @@ class RandomBatcher:
         # exclusive high len-T: max start len-T-1, so y = data[i+1 : i+T+1]
         # still fits (same bound as the reference's randint, GPT1.py:77)
         ix = self.rng.integers(0, len(self.data) - self.T, size=self.B)
-        x = np.stack([self.data[i:i + self.T] for i in ix])
-        y = np.stack([self.data[i + 1:i + self.T + 1] for i in ix])
-        return x.astype(np.int32), y.astype(np.int32)
+        # fused native gather (C++), NumPy fallback inside — batch content
+        # is a pure function of (data, ix) either way, so the seeded token
+        # stream is independent of which path runs
+        from ..native import gather_batch
+        return gather_batch(self.data, ix, self.T)
 
     def __iter__(self) -> Iterator[Batch]:
         while True:

@@ -1,0 +1,13 @@
+"""Native (C++) host-side fastpath: tokenization + batch assembly.
+
+See fastpath.cpp for the kernels, build.py for the on-demand g++ build,
+binding.py for the ctypes surface. All callers degrade to NumPy/Python
+automatically when no toolchain is present (``available()`` is False) or
+when ``RGTPU_NO_NATIVE=1``.
+"""
+
+from .binding import (BpeMergeTable, available, bpe_encode_words, encode_lut,
+                      gather_batch)
+
+__all__ = ["BpeMergeTable", "available", "bpe_encode_words", "encode_lut",
+           "gather_batch"]

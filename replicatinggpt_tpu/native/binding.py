@@ -1,0 +1,165 @@
+"""ctypes binding for the native fastpath (fastpath.cpp).
+
+Public surface (all take/return NumPy arrays; every function has identical
+NumPy-fallback semantics when the library is unavailable):
+
+- ``available()`` — did the .so build/load?
+- ``encode_lut(data_bytes, lut)`` — byte->id map; raises on unmapped bytes.
+- ``gather_batch(data, offsets, T)`` — fused (B,T) x/y window gather.
+- ``bpe_encode_words(word_units, word_off, merge_table)`` — greedy
+  lowest-rank merges over pre-split words, in token-id space.
+
+Environment toggle: ``RGTPU_NO_NATIVE=1`` disables the native path (used by
+the parity tests to exercise both sides).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("RGTPU_NO_NATIVE"):
+            return None
+        from .build import build
+        path = build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.rg_encode_lut.restype = ctypes.c_long
+        lib.rg_encode_lut.argtypes = [_u8p, ctypes.c_long, _i32p, _i32p]
+        lib.rg_gather_batch.restype = None
+        lib.rg_gather_batch.argtypes = [_i32p, ctypes.c_long, _i64p,
+                                        ctypes.c_int, ctypes.c_int,
+                                        _i32p, _i32p]
+        lib.rg_bpe_encode.restype = ctypes.c_long
+        lib.rg_bpe_encode.argtypes = [_i32p, _i64p, ctypes.c_long,
+                                      _i32p, _i32p, _i32p, ctypes.c_long,
+                                      ctypes.c_int64, _i32p]
+        lib.rg_bpe_free_table.restype = None
+        lib.rg_bpe_free_table.argtypes = [ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def encode_lut(data: bytes, lut: np.ndarray) -> np.ndarray:
+    """Map each byte of ``data`` through ``lut`` (int32[256], -1=unmapped).
+
+    Raises ValueError if any byte is unmapped (mirrors dict KeyError on the
+    Python path)."""
+    buf = np.frombuffer(data, np.uint8)
+    lut = np.ascontiguousarray(lut, np.int32)
+    lib = _load()
+    if lib is not None:
+        out = np.empty(len(buf), np.int32)
+        bad = lib.rg_encode_lut(buf, len(buf), lut, out)
+        if bad:
+            raise ValueError(f"{bad} bytes outside the tokenizer alphabet")
+        return out
+    ids = lut[buf]
+    if (ids < 0).any():
+        raise ValueError(
+            f"{int((ids < 0).sum())} bytes outside the tokenizer alphabet")
+    return ids
+
+
+def gather_batch(data: np.ndarray, offsets: np.ndarray,
+                 T: int) -> Tuple[np.ndarray, np.ndarray]:
+    """x[b] = data[o_b : o_b+T], y[b] = data[o_b+1 : o_b+T+1]."""
+    data = np.ascontiguousarray(data, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    B = len(offsets)
+    assert offsets.max(initial=0) + T + 1 <= len(data)
+    lib = _load()
+    if lib is not None:
+        x = np.empty((B, T), np.int32)
+        y = np.empty((B, T), np.int32)
+        lib.rg_gather_batch(data, len(data), offsets, B, T, x, y)
+        return x, y
+    idx = offsets[:, None] + np.arange(T + 1)[None, :]
+    win = data[idx]
+    return np.ascontiguousarray(win[:, :-1]), np.ascontiguousarray(win[:, 1:])
+
+
+import itertools
+
+_table_ids = itertools.count(1)  # process-unique C++ cache tokens
+
+
+class BpeMergeTable:
+    """Rank-ordered merge rules in token-id space, held in stable arrays.
+
+    Each instance mints a process-unique ``table_id``; the C++ side caches
+    its hash map under that token (fastpath.cpp MergeCache) — never under
+    an array pointer, which the allocator can recycle across tokenizer
+    lifetimes. One instance per tokenizer amortizes the table build across
+    encode calls; the cache entry is freed when the instance is collected.
+    Pairs must be pre-deduplicated by the caller (Python-dict semantics:
+    for a duplicate (left,right) pair the last rank wins —
+    tokenizers.py:111).
+    """
+
+    def __init__(self, pair_keys: np.ndarray, ranks: np.ndarray,
+                 new_ids: np.ndarray):
+        pair_keys = np.asarray(pair_keys, np.int32).reshape(-1, 2)
+        ranks = np.asarray(ranks, np.int32)
+        order = np.argsort(ranks, kind="stable")  # row index == priority
+        self.left = np.ascontiguousarray(pair_keys[order, 0], np.int32)
+        self.right = np.ascontiguousarray(pair_keys[order, 1], np.int32)
+        self.new_ids = np.ascontiguousarray(
+            np.asarray(new_ids, np.int32)[order], np.int32)
+        self.table_id = next(_table_ids)
+
+    def __del__(self):
+        lib = _lib  # only free if the library was ever loaded
+        if lib is not None:
+            try:
+                lib.rg_bpe_free_table(self.table_id)
+            except Exception:
+                pass  # interpreter teardown
+
+
+def bpe_encode_words(word_units: np.ndarray, word_off: np.ndarray,
+                     table: BpeMergeTable) -> Optional[np.ndarray]:
+    """Greedy BPE merge loop over a flattened batch of words.
+
+    word_units: concatenated byte-ids of all words; word_off: int64[W+1]
+    offsets. Returns merged ids, or None when the native library is
+    unavailable (the caller keeps its Python loop as the fallback — it
+    needs the string domain anyway for cache warm-up).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    word_units = np.ascontiguousarray(word_units, np.int32)
+    word_off = np.ascontiguousarray(word_off, np.int64)
+    out = np.empty(len(word_units), np.int32)
+    n = lib.rg_bpe_encode(word_units, word_off, len(word_off) - 1,
+                          table.left, table.right, table.new_ids,
+                          len(table.left), table.table_id, out)
+    return out[:n]

@@ -1,0 +1,81 @@
+"""On-demand compilation of the native fastpath library.
+
+No pybind11 in this environment, so the binding is plain ctypes over an
+``extern "C"`` ABI; the library is compiled once per source change with g++
+and cached next to the source (``_build/fastpath-<hash>.so``). Everything
+degrades gracefully: if no compiler is available the Python/NumPy fallbacks
+run instead (binding.py), so the framework never hard-depends on a
+toolchain at runtime.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "fastpath.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_build")
+
+
+def _src_tag() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def lib_path() -> str:
+    return os.path.join(_BUILD_DIR, f"fastpath-{_src_tag()}.so")
+
+
+def build(verbose: bool = False) -> Optional[str]:
+    """Compile (if stale) and return the .so path, or None on failure."""
+    out = lib_path()
+    if os.path.exists(out):
+        return out
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # compile into a temp file then atomic-rename, so concurrent builders
+    # (e.g. pytest-xdist workers) never load a half-written .so
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+    os.close(fd)
+    # portable codegen only: the cached .so can outlive the build host (the
+    # Dockerfile pre-builds it into the image), and -march=native would
+    # SIGILL on an older CPU at runtime with no fallback. The kernels are
+    # memcpy/hash-bound; ISA-specific vectorization buys nothing here.
+    # Opt in explicitly via RGTPU_NATIVE_CXXFLAGS for same-host builds.
+    extra = os.environ.get("RGTPU_NATIVE_CXXFLAGS", "").split()
+    cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", *extra,
+           _SRC, "-o", tmp]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            if verbose:
+                print(f"native build failed:\n{r.stderr}", file=sys.stderr)
+            os.unlink(tmp)
+            return None
+        os.replace(tmp, out)
+        return out
+    except Exception as e:  # compiler missing/hung — fall back silently
+        if verbose:
+            print(f"native build error: {e}", file=sys.stderr)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+if __name__ == "__main__":
+    p = build(verbose=True)
+    if p is None:
+        print("BUILD FAILED (NumPy fallbacks will be used)", file=sys.stderr)
+        sys.exit(1)  # fail image builds that expect the fastpath baked in
+    print(p)

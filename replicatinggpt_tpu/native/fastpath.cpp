@@ -1,0 +1,136 @@
+// Native host-side data path: the framework's C++ runtime layer.
+//
+// The reference has zero native components (SURVEY.md §2.0) — its host data
+// path is pure Python/PyTorch (get_batch GPT1.py:75-83, DataLoaderLite
+// GPT-2.py:187-213, tiktoken corpus encode GPT-2.py:192-196). On TPU the
+// device side is XLA-compiled, so the only place framework code can burn
+// host CPU (and stall the input pipeline feeding the chips) is exactly this
+// path. These kernels keep it off the Python interpreter:
+//
+//   rg_encode_lut     byte->id table lookup (char-level tokenization)
+//   rg_bpe_encode     greedy lowest-rank BPE merge loop over pre-split words
+//   rg_gather_batch   fused (B,T) x/y window gather for batch assembly
+//
+// Compiled on demand by build.py (g++ -O3 -shared -fPIC), bound via ctypes
+// (binding.py). Every entry point has a NumPy fallback with identical
+// output, bit-for-bit — tests/test_native.py asserts the parity.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// text: n raw bytes; lut: 256 entries mapping byte -> id (-1 = unmapped,
+// byte passes through as id 0 and the count of unmapped bytes is returned
+// so the caller can reject non-ASCII corpora and fall back).
+long rg_encode_lut(const uint8_t* text, long n, const int32_t* lut,
+                   int32_t* out) {
+  long bad = 0;
+  for (long i = 0; i < n; ++i) {
+    int32_t v = lut[text[i]];
+    if (v < 0) {
+      ++bad;
+      v = 0;
+    }
+    out[i] = v;
+  }
+  return bad;
+}
+
+// data: token stream of length n; offsets: B window starts (each in
+// [0, n-T-1]); writes x[b,t] = data[off_b + t], y[b,t] = data[off_b + t + 1].
+void rg_gather_batch(const int32_t* data, long n, const int64_t* offsets,
+                     int B, int T, int32_t* x, int32_t* y) {
+  (void)n;
+  for (int b = 0; b < B; ++b) {
+    const int32_t* src = data + offsets[b];
+    std::memcpy(x + (long)b * T, src, sizeof(int32_t) * T);
+    std::memcpy(y + (long)b * T, src + 1, sizeof(int32_t) * T);
+  }
+}
+
+namespace {
+
+// Merge-table cache: table_id -> ((left_id,right_id) -> (rank, new_id)).
+// table_id is an opaque token minted by the Python side, unique per
+// BpeMergeTable instance for the life of the process (binding.py) — never
+// a pointer, since the allocator can hand a new table a freed buffer's
+// address. g_mutex serializes everything: ctypes releases the GIL during
+// the call, so concurrent encodes would otherwise race on the cache.
+struct MergeCache {
+  std::mutex mutex;
+  std::unordered_map<int64_t,
+                     std::unordered_map<uint64_t,
+                                        std::pair<int32_t, int32_t>>> tables;
+};
+
+MergeCache g_cache;
+
+inline uint64_t pack(int32_t a, int32_t b) {
+  return (static_cast<uint64_t>(static_cast<uint32_t>(a)) << 32) |
+         static_cast<uint32_t>(b);
+}
+
+}  // namespace
+
+// Greedy BPE over one flattened batch of words.
+//
+//   units:      concatenated byte-ids of every word
+//   word_off:   n_words+1 offsets into units
+//   left/right/new_id: M merge rules (row index ascending == priority)
+//   table_id:   process-unique cache token for this rule set
+//   out:        capacity >= len(units); returns number of ids written
+//
+// Semantics identical to ByteBPETokenizer._bpe_word (tokenizers.py:168-181):
+// repeatedly merge the lowest-rank adjacent pair (leftmost on ties, which
+// the (rank, index) min in Python also picks) until no pair has a rank.
+long rg_bpe_encode(const int32_t* units, const int64_t* word_off,
+                   long n_words, const int32_t* left, const int32_t* right,
+                   const int32_t* new_id, long n_merges, int64_t table_id,
+                   int32_t* out) {
+  std::lock_guard<std::mutex> lock(g_cache.mutex);
+  auto& table = g_cache.tables[table_id];
+  if (table.empty() && n_merges > 0) {
+    table.reserve(static_cast<size_t>(n_merges) * 2);
+    for (long i = 0; i < n_merges; ++i) {
+      table.emplace(pack(left[i], right[i]),
+                    std::make_pair((int32_t)i, new_id[i]));
+    }
+  }
+
+  long written = 0;
+  std::vector<int32_t> buf;
+  for (long w = 0; w < n_words; ++w) {
+    const long lo = word_off[w], hi = word_off[w + 1];
+    buf.assign(units + lo, units + hi);
+    while (buf.size() > 1) {
+      int32_t best_rank = INT32_MAX, best_new = -1;
+      long best_i = -1;
+      for (long i = 0; i + 1 < (long)buf.size(); ++i) {
+        auto it = table.find(pack(buf[i], buf[i + 1]));
+        if (it != table.end() && it->second.first < best_rank) {
+          best_rank = it->second.first;
+          best_new = it->second.second;
+          best_i = i;
+        }
+      }
+      if (best_i < 0) break;
+      buf[best_i] = best_new;
+      buf.erase(buf.begin() + best_i + 1);
+    }
+    for (int32_t id : buf) out[written++] = id;
+  }
+  return written;
+}
+
+// Release a cached merge table (called from BpeMergeTable.__del__ so
+// dropped tokenizers don't leak their C++ map).
+void rg_bpe_free_table(int64_t table_id) {
+  std::lock_guard<std::mutex> lock(g_cache.mutex);
+  g_cache.tables.erase(table_id);
+}
+
+}  // extern "C"

@@ -66,6 +66,14 @@ class CharTokenizer:
         self.chars = list(chars)
         self.stoi = {c: i for i, c in enumerate(self.chars)}
         self.itos = {i: c for i, c in enumerate(self.chars)}
+        # byte->id LUT for the native fastpath; valid only for pure-ASCII
+        # vocabularies (one utf-8 byte per char)
+        self._lut = None
+        if all(len(c) == 1 and ord(c) < 128 for c in self.chars):
+            import numpy as np
+            self._lut = np.full(256, -1, np.int32)
+            for c, i in self.stoi.items():
+                self._lut[ord(c)] = i
 
     @classmethod
     def from_text(cls, text: str) -> "CharTokenizer":
@@ -77,6 +85,17 @@ class CharTokenizer:
 
     def encode(self, s: str) -> List[int]:
         return [self.stoi[c] for c in s]
+
+    def encode_np(self, s: str):
+        """Corpus-scale encode via the native LUT kernel (identical ids)."""
+        import numpy as np
+        if self._lut is not None and len(s) > 4096:
+            try:
+                from .native import encode_lut
+                return encode_lut(s.encode("utf-8"), self._lut)
+            except ValueError:
+                pass  # bytes outside alphabet: fall through for the KeyError
+        return np.asarray(self.encode(s), np.int32)
 
     def decode(self, ids: Sequence[int]) -> str:
         return "".join(self.itos[int(i)] for i in ids)
@@ -116,6 +135,7 @@ class ByteBPETokenizer:
         self.token_to_id = {t: i for i, t in enumerate(vocab)}
         self.id_to_token = {i: t for i, t in enumerate(vocab)}
         self._cache: Dict[str, List[int]] = {}
+        self._ntable = False  # built lazily; None = native unusable
 
     # --- training ----------------------------------------------------------
 
@@ -189,6 +209,57 @@ class ByteBPETokenizer:
         for w in self._pat.findall(s):
             out.extend(self._bpe_word(w))
         return out
+
+    def _native_merge_table(self):
+        """Merge rules re-keyed into token-id space for the C++ kernel.
+
+        Sound because id<->string is bijective over the ids the encoder can
+        produce (token_to_id keeps the *last* id for duplicate merged
+        strings — same dict semantics as ranks, tokenizers.py:111,116 — and
+        base byte ids equal the raw byte value since base symbols are the
+        only single-char vocab entries)."""
+        if self._ntable is False:
+            import numpy as np
+
+            from .native import BpeMergeTable, available
+            # the id-space kernel feeds raw utf-8 bytes as base token ids,
+            # which is only sound when vocab slot b holds byte-symbol b for
+            # all 256 base slots; a reordered/custom vocab (e.g. an edited
+            # bpe_*.json) must fall back to the string-keyed Python path
+            base_ok = all(
+                self.token_to_id.get(_BYTE_ENCODER[b]) == b
+                for b in range(256))
+            if not available() or not base_ok:
+                self._ntable = None
+            else:
+                pairs, rks, nids = [], [], []
+                for (a, b), r in self.ranks.items():
+                    merged = self.token_to_id.get(a + b)
+                    ia, ib = self.token_to_id.get(a), self.token_to_id.get(b)
+                    if merged is None or ia is None or ib is None:
+                        continue  # unreachable rule (not in this vocab)
+                    pairs.append((ia, ib))
+                    rks.append(r)
+                    nids.append(merged)
+                self._ntable = BpeMergeTable(
+                    np.asarray(pairs, np.int32).reshape(-1, 2),
+                    np.asarray(rks, np.int32), np.asarray(nids, np.int32))
+        return self._ntable
+
+    def encode_np(self, s: str):
+        """Corpus-scale encode via the native BPE kernel (identical ids)."""
+        import numpy as np
+        table = self._native_merge_table() if len(s) > 4096 else None
+        if table is not None:
+            from .native import bpe_encode_words
+            bufs = [w.encode("utf-8") for w in self._pat.findall(s)]
+            units = np.frombuffer(b"".join(bufs), np.uint8).astype(np.int32)
+            off = np.zeros(len(bufs) + 1, np.int64)
+            np.cumsum([len(b) for b in bufs], out=off[1:])
+            out = bpe_encode_words(units, off, table)
+            if out is not None:
+                return out
+        return np.asarray(self.encode(s), np.int32)
 
     def decode(self, ids: Sequence[int]) -> str:
         text = "".join(self.id_to_token[int(i)] for i in ids)

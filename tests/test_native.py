@@ -1,0 +1,104 @@
+"""Native C++ fastpath tests: the library builds in this image (g++ is
+available) and every kernel is bit-identical to its NumPy/Python fallback.
+The reference has no native components (SURVEY.md §2.0); this layer is the
+framework's host-side runtime, so parity with the Python semantics is the
+whole contract."""
+
+import numpy as np
+import pytest
+
+from replicatinggpt_tpu import native
+from replicatinggpt_tpu.tokenizers import ByteBPETokenizer, CharTokenizer
+
+
+def test_native_library_builds():
+    assert native.available(), (
+        "native fastpath failed to build; run "
+        "python -m replicatinggpt_tpu.native.build for the compiler error")
+
+
+def test_encode_lut_matches_python(tiny_corpus):
+    tok = CharTokenizer.from_text(tiny_corpus)
+    assert tok._lut is not None  # Shakespeare is ASCII
+    ids = tok.encode_np(tiny_corpus)
+    assert ids.dtype == np.int32
+    assert ids.tolist() == tok.encode(tiny_corpus)
+
+
+def test_encode_lut_rejects_unmapped_bytes(tiny_corpus):
+    tok = CharTokenizer.from_text(tiny_corpus)
+    with pytest.raises((ValueError, KeyError)):
+        native.encode_lut("é".encode("utf-8"), tok._lut)
+
+
+def test_non_ascii_vocab_falls_back(tiny_corpus):
+    tok = CharTokenizer.from_text(tiny_corpus + "é")
+    assert tok._lut is None
+    s = (tiny_corpus + "é")[:5000]
+    assert tok.encode_np(s).tolist() == tok.encode(s)
+
+
+def test_gather_batch_matches_numpy():
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 1000, size=10_000).astype(np.int32)
+    offsets = rng.integers(0, len(data) - 65, size=32)
+    x, y = native.gather_batch(data, offsets, 64)
+    idx = offsets[:, None] + np.arange(65)[None, :]
+    win = data[idx]
+    np.testing.assert_array_equal(x, win[:, :-1])
+    np.testing.assert_array_equal(y, win[:, 1:])
+
+
+def test_bpe_native_matches_python(tiny_corpus):
+    tok = ByteBPETokenizer.train(tiny_corpus[:20_000], vocab_size=350)
+    s = tiny_corpus[:12_000]
+    got = tok.encode_np(s)
+    assert got.tolist() == tok.encode(s)
+    # round-trip through decode for good measure
+    assert tok.decode(got.tolist()) == s
+
+
+def test_bpe_cache_not_confused_across_tokenizers(tiny_corpus):
+    # regression: the C++ merge cache was once keyed on the rule array's
+    # pointer; a second tokenizer whose arrays landed on a recycled buffer
+    # address silently reused the first tokenizer's merges
+    s = tiny_corpus[:12_000]
+    a = ByteBPETokenizer.train(tiny_corpus[:20_000], vocab_size=350)
+    _ = a.encode_np(s)  # populate the native cache
+    del a
+    b = ByteBPETokenizer.train(tiny_corpus[5_000:25_000], vocab_size=350)
+    assert b.encode_np(s).tolist() == b.encode(s)
+
+
+def test_bpe_custom_vocab_disables_native(tiny_corpus):
+    # a vocab whose base slots are not byte-symbol order makes the id-space
+    # kernel unsound; encode_np must fall back to the Python path
+    tok = ByteBPETokenizer.train(tiny_corpus[:20_000], vocab_size=300)
+    shuffled = list(tok.vocab)
+    shuffled[0], shuffled[1] = shuffled[1], shuffled[0]
+    weird = ByteBPETokenizer(tok.merges, vocab=shuffled)
+    assert weird._native_merge_table() is None
+    s = tiny_corpus[:6_000]
+    assert weird.encode_np(s).tolist() == weird.encode(s)
+
+
+def test_bpe_native_on_adversarial_text():
+    # repeated merges, unicode, whitespace runs, empty-ish words
+    base = "aaaa bbbb aaaabbbb  \n\t ab ab ab abab ! ?? 'tis l'éclair 123"
+    text = base * 200  # push over the 4096-char native threshold
+    tok = ByteBPETokenizer.train(text, vocab_size=300)
+    assert tok.encode_np(text).tolist() == tok.encode(text)
+
+
+def test_random_batcher_stream_unchanged_by_native(tiny_corpus):
+    # the seeded token stream must not depend on which gather path runs
+    from replicatinggpt_tpu.data.loader import RandomBatcher
+    tok = CharTokenizer.from_text(tiny_corpus)
+    data = tok.encode_np(tiny_corpus)
+    b = RandomBatcher(data, 4, 16, seed=7)
+    x, y = b.next_batch()
+    rng = np.random.default_rng(7)
+    ix = rng.integers(0, len(data) - 16, size=4)
+    np.testing.assert_array_equal(x, np.stack([data[i:i + 16] for i in ix]))
+    np.testing.assert_array_equal(
+        y, np.stack([data[i + 1:i + 17] for i in ix]))
