@@ -122,6 +122,9 @@ class TrainConfig:
     eval_interval: int = 200
     eval_iters: int = 200
     log_interval: int = 10
+    steps_per_dispatch: int = 1      # >1: lax.scan K optimizer steps per
+                                     # dispatch (amortizes host->device
+                                     # round trips; loss curve unchanged)
     seed: int = 1337                 # GPT1.py:10
     sampling: str = "random"         # 'random' (GPT1.py:75-83) |
                                      # 'sequential' (GPT-2.py:200-213)
@@ -271,6 +274,8 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--eval-interval", type=int, default=None)
     p.add_argument("--eval-iters", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--steps-per-dispatch", type=int, default=None,
+                   help="lax.scan K optimizer steps per device dispatch")
     # mesh overrides
     p.add_argument("--dp", type=int, default=None, help="mesh data axis size")
     p.add_argument("--sp", type=int, default=None, help="mesh seq axis size")
@@ -298,6 +303,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         ("batch_size", args.batch_size), ("lr", args.lr),
         ("max_iters", args.max_iters), ("eval_interval", args.eval_interval),
         ("eval_iters", args.eval_iters), ("seed", args.seed),
+        ("steps_per_dispatch", args.steps_per_dispatch),
     ) if v is not None}
     meshk = {k: v for k, v in (
         ("data", args.dp), ("seq", args.sp), ("model", args.tp),

@@ -8,8 +8,11 @@ score tiles blockwise from the saved logsumexp, producing dq in a q-major
 kernel and dk/dv in a kv-major kernel (no stored attention matrix anywhere).
 
 Layout notes (TPU): all tiles are (128, D) with D in {32, 64, 128, 256};
-score tiles are (128, 128) → MXU-native. LSE/delta are carried as (T,)
-rows per (batch*head) so their last dim stays lane-aligned at block 128.
+score tiles are (128, 128) → MXU-native. LSE/delta are per-row scalars,
+which Mosaic cannot tile as a bare (T,) lane — they are carried
+broadcast across a LANES-wide trailing dim ((BH, T, LANES) arrays,
+(block_q, LANES) tiles), the same layout the reference TPU flash kernel
+in jax.experimental.pallas.ops.tpu uses for its m/l stats.
 Causal masking skips fully-masked kv blocks entirely (the fori_loop upper
 bound is derived from the q-block index), so the kernel does ~half the
 FLOPs of the dense path on causal workloads.
@@ -32,6 +35,7 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 BLOCK = 128
+LANES = 128  # trailing width for per-row stats (Mosaic lane alignment)
 NEG_INF = -1e30
 
 
@@ -83,7 +87,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     acc, m, l = jax.lax.fori_loop(0, n_kv, body, (acc, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (acc / l).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l))[:, 0]
+    lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), (block_q, LANES))
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
@@ -105,11 +109,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
-            _vmem_spec((None, block_q), lambda i, j: (i, j)),
+            _vmem_spec((None, block_q, LANES), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T, LANES), jnp.float32),
         ],
         interpret=_interpret_mode(),
     )(qf, kf, vf)
@@ -125,8 +129,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     j = pl.program_id(1)
     q = q_ref[...].astype(jnp.float32)                   # (bq, D)
     do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...][:, None]                          # (bq, 1)
-    delta = delta_ref[...][:, None]
+    lse = lse_ref[...][:, :1]                            # (bq, 1) of (bq, LANES)
+    delta = delta_ref[...][:, :1]
     q_first = j * block_q
     if causal:
         n_kv = (q_first + block_q + block_k - 1) // block_k
@@ -171,8 +175,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(jb * block_q, block_q)][:, None]
-        delta = delta_ref[pl.ds(jb * block_q, block_q)][:, None]
+        lse = lse_ref[pl.ds(jb * block_q, block_q), :][:, :1]
+        delta = delta_ref[pl.ds(jb * block_q, block_q), :][:, :1]
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bq, bk)
@@ -207,7 +211,9 @@ def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
     B, H, T, D = q.shape
     BH = B * H
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
-                    axis=-1).reshape(BH, T)              # (BH, T)
+                    axis=-1).reshape(BH, T)
+    # stats ride a LANES-wide trailing dim (see module docstring)
+    delta = jnp.broadcast_to(delta[:, :, None], (BH, T, LANES))
     qf, kf, vf = (t.reshape(BH, T, D) for t in (q, k, v))
     gf = g.reshape(BH, T, D)
 
@@ -222,8 +228,8 @@ def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
             _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
             _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
             _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
-            _vmem_spec((None, block_q), lambda i, j: (i, j)),
-            _vmem_spec((None, block_q), lambda i, j: (i, j)),
+            _vmem_spec((None, block_q, LANES), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_q, LANES), lambda i, j: (i, j, 0)),
         ],
         out_specs=_vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
@@ -241,8 +247,8 @@ def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
             _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
             _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
             _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
-            _vmem_spec((None, T), lambda i, j: (i, 0)),
-            _vmem_spec((None, T), lambda i, j: (i, 0)),
+            _vmem_spec((None, T, LANES), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, T, LANES), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),

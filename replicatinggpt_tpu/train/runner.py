@@ -131,6 +131,30 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                               else ""))
     train_step = make_train_step(mcfg, tcfg, attention_fn=attention_fn,
                                  blocks_fn=blocks_fn)
+    train_scan = None
+    scan_k = 1
+    if tcfg.steps_per_dispatch > 1 and n_proc == 1:
+        # multi-host superbatch assembly (global arrays stacked across
+        # processes) is not wired up; single-host only for now.
+        # Chunks never cross an eval/checkpoint boundary, so a dispatch
+        # larger than those cadences could never run — clamp it. (Log
+        # cadence does NOT clamp: log lines inside a chunk are emitted
+        # from the stacked per-step losses after it completes.)
+        scan_k = tcfg.steps_per_dispatch
+        for interval in (tcfg.eval_interval, tcfg.checkpoint_every):
+            if interval:
+                scan_k = min(scan_k, interval)
+        if scan_k != tcfg.steps_per_dispatch:
+            logger.log(f"steps_per_dispatch clamped "
+                       f"{tcfg.steps_per_dispatch} -> {scan_k} to fit the "
+                       f"eval/checkpoint cadence")
+        if scan_k > 1:
+            from .steps import make_train_scan
+            train_scan = make_train_scan(mcfg, tcfg, scan_k,
+                                         attention_fn=attention_fn,
+                                         blocks_fn=blocks_fn)
+        else:
+            scan_k = 1
     eval_step = make_eval_step(mcfg, attention_fn=attention_fn,
                                blocks_fn=blocks_fn)
     if batch_sharding is not None:
@@ -175,8 +199,10 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     t0 = time.perf_counter()
     tokens_seen = 0
     logger.reset_timer()
+    tokens_since_log = 0
     try:
-        for it in range(start_step, tcfg.max_iters):
+        it = start_step
+        while it < tcfg.max_iters:
             if (tcfg.eval_interval and it % tcfg.eval_interval == 0):
                 losses = estimate_loss(state.params, eval_batchers, eval_step,
                                        tcfg.eval_iters, device_put=dput)
@@ -185,14 +211,39 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                 logger.reset_timer()
             # after the eval block so the trace captures train steps only
             profiler.step(it)
-            batch = next(batches)
-            state, metrics = train_step(state, batch)
-            tokens_seen += tokens_per_batch
-            if tcfg.log_interval and (it + 1) % tcfg.log_interval == 0:
-                logger.log_step(it, float(metrics["loss"]),
-                                tokens_per_batch * tcfg.log_interval, n_chips)
+            # a chunk never crosses an eval/checkpoint boundary, so those
+            # cadences behave exactly as in the single-step loop
+            chunk = 1
+            if train_scan is not None:
+                chunk = tcfg.max_iters - it
+                for interval in (tcfg.eval_interval, tcfg.checkpoint_every):
+                    if interval:
+                        chunk = min(chunk, interval - it % interval)
+            if train_scan is not None and chunk >= scan_k:
+                chunk = scan_k
+                import jax.numpy as jnp
+                xs, ys = zip(*(next(batches) for _ in range(chunk)))
+                state, metrics = train_scan(state,
+                                            (jnp.stack(xs), jnp.stack(ys)))
+            else:
+                chunk = 1
+                state, metrics = train_step(state, next(batches))
+            prev_it, it = it, it + chunk
+            tokens_seen += tokens_per_batch * chunk
+            tokens_since_log += tokens_per_batch * chunk
+            if tcfg.log_interval:
+                # most recent log boundary crossed by this chunk (one line
+                # per chunk even if it spans several boundaries)
+                b = (it // tcfg.log_interval) * tcfg.log_interval
+                if b > prev_it:
+                    losses_arr = metrics["loss"]
+                    loss_b = (losses_arr if chunk == 1
+                              else losses_arr[b - prev_it - 1])
+                    logger.log_step(b - 1, float(loss_b), tokens_since_log,
+                                    n_chips)
+                    tokens_since_log = 0
             if (checkpoint_manager is not None and tcfg.checkpoint_every
-                    and (it + 1) % tcfg.checkpoint_every == 0):
+                    and it % tcfg.checkpoint_every == 0):
                 checkpoint_manager.save(state, train_batcher)
     finally:
         profiler.close()

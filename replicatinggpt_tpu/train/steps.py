@@ -24,9 +24,41 @@ from .state import TrainState, make_optimizer
 def loss_fn(params, batch, cfg: ModelConfig, rng=None, train=False,
             attention_fn=None, blocks_fn=None):
     x, y = batch
+    # tokens may arrive as uint8/uint16 (narrow host->device transfers —
+    # the loaders pick the smallest dtype covering the vocab); widen on
+    # device where the cast is free
+    if x.dtype != jnp.int32:
+        x = x.astype(jnp.int32)
+    if y.dtype != jnp.int32:
+        y = y.astype(jnp.int32)
     _, loss = forward(params, x, cfg, targets=y, rng=rng, train=train,
                       attention_fn=attention_fn, blocks_fn=blocks_fn)
     return loss
+
+
+def _one_step(state: TrainState, batch, *, mcfg: ModelConfig, optimizer,
+              with_grad_norm: bool, attention_fn, blocks_fn
+              ) -> Tuple[TrainState, Dict[str, Any]]:
+    """The single optimizer step shared by make_train_step (jitted 1:1) and
+    make_train_scan (scanned K:1) — one body, so the two dispatch shapes
+    cannot drift apart semantically."""
+    rng = jax.random.fold_in(state.rng, state.step)
+    loss, grads = jax.value_and_grad(loss_fn)(
+        state.params, batch, mcfg, rng=rng,
+        train=(mcfg.dropout > 0 or mcfg.attn_dropout > 0),
+        attention_fn=attention_fn, blocks_fn=blocks_fn)
+    updates, opt_state = optimizer.update(grads, state.opt_state,
+                                          state.params)
+    params = jax.tree_util.tree_map(
+        lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+    new_state = TrainState(step=state.step + 1, params=params,
+                           opt_state=opt_state, rng=state.rng)
+    metrics = {"loss": loss}
+    if with_grad_norm:
+        metrics["grad_norm"] = jax.tree_util.tree_reduce(
+            lambda a, g: a + jnp.sum(jnp.square(g.astype(jnp.float32))),
+            grads, jnp.float32(0.0)) ** 0.5
+    return new_state, metrics
 
 
 def make_train_step(mcfg: ModelConfig, tcfg: TrainConfig,
@@ -39,28 +71,38 @@ def make_train_step(mcfg: ModelConfig, tcfg: TrainConfig,
     reduction to the metrics (off by default — it costs a full-tree
     reduction per step). ``attention_fn`` overrides the attention core —
     the sequence-parallel paths (ring / Ulysses) plug in here."""
-    optimizer = make_optimizer(tcfg)
-
-    def step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
-        rng = jax.random.fold_in(state.rng, state.step)
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, batch, mcfg, rng=rng,
-            train=(mcfg.dropout > 0 or mcfg.attn_dropout > 0),
-            attention_fn=attention_fn, blocks_fn=blocks_fn)
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
-        params = jax.tree_util.tree_map(
-            lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
-        new_state = TrainState(step=state.step + 1, params=params,
-                               opt_state=opt_state, rng=state.rng)
-        metrics = {"loss": loss}
-        if with_grad_norm:
-            metrics["grad_norm"] = jax.tree_util.tree_reduce(
-                lambda a, g: a + jnp.sum(jnp.square(g.astype(jnp.float32))),
-                grads, jnp.float32(0.0)) ** 0.5
-        return new_state, metrics
-
+    step = partial(_one_step, mcfg=mcfg, optimizer=make_optimizer(tcfg),
+                   with_grad_norm=with_grad_norm, attention_fn=attention_fn,
+                   blocks_fn=blocks_fn)
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_train_scan(mcfg: ModelConfig, tcfg: TrainConfig, k: int,
+                    donate: bool = True,
+                    with_grad_norm: bool = False,
+                    attention_fn=None, blocks_fn=None) -> Callable:
+    """K train steps per dispatch: ``(state, (K,B,T) batches) -> (state,
+    {'loss': (K,), ...})`` with an on-device ``lax.scan`` over the steps;
+    metrics come back stacked, one entry per step.
+
+    Why this exists: a single-step dispatch pays one host->device round trip
+    per optimizer step, which on a remote/tunneled TPU (or any small model
+    whose step time is comparable to dispatch latency) can dominate
+    wall-clock. Scanning K steps on device amortizes that overhead to 1/K
+    and lets the host assemble the next superbatch while the chip runs.
+    Shares ``_one_step`` with ``make_train_step`` (same per-step RNG fold on
+    ``state.step``), so loss curves are unchanged — asserted in
+    tests/test_train.py::test_train_scan_matches_single_steps."""
+    one = partial(_one_step, mcfg=mcfg, optimizer=make_optimizer(tcfg),
+                  with_grad_norm=with_grad_norm, attention_fn=attention_fn,
+                  blocks_fn=blocks_fn)
+
+    def run(state: TrainState, batches) -> Tuple[TrainState, Dict[str, Any]]:
+        xs, ys = batches  # (K, B, T) each
+        return jax.lax.scan(lambda s, b: one(s, b), state, (xs, ys),
+                            length=k)
+
+    return jax.jit(run, donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step(mcfg: ModelConfig, attention_fn=None,

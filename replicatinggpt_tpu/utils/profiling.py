@@ -58,6 +58,12 @@ class trace_window:
             win.step(it)        # starts/stops the trace at the boundaries
             ...
         win.close()             # in case the loop ended mid-window
+
+    ``step`` may be called with strides > 1 (the runner's multi-step scan
+    dispatches advance K steps at a time): the window opens at the first
+    call at-or-past ``start`` and closes at the first call at-or-past
+    ``stop_at`` after opening, then never reopens — a jumped-over window
+    still produces a trace of at least one dispatch.
     """
 
     def __init__(self, logdir: Optional[str], start: int = 10,
@@ -66,21 +72,24 @@ class trace_window:
         self.start = start
         self.stop_at = start + n_steps
         self._active = False
+        self._done = False
 
     def step(self, it: int) -> None:
-        if not self.logdir:
+        if not self.logdir or self._done:
             return
-        if not self._active and self.start <= it < self.stop_at:
-            jax.profiler.start_trace(self.logdir)
-            self._active = True
-        elif self._active and it >= self.stop_at:
+        if self._active and it >= self.stop_at:
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
+        elif not self._active and it >= self.start:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
 
     def close(self) -> None:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
 
 
 class StepTimer:

@@ -79,3 +79,19 @@ def test_runner_profile_dir(tmp_path):
           profile_dir=logdir, profile_start=1, profile_steps=2)
     assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
                      recursive=True)
+
+
+def test_trace_window_with_strided_steps(tmp_path):
+    # multi-step dispatch loops advance it by K; a window jumped over must
+    # still open (and close on the next call), producing a trace
+    logdir = str(tmp_path / "stride")
+    win = trace_window(logdir, start=10, n_steps=5)
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8)
+    for it in (0, 25, 50, 75):
+        win.step(it)
+        assert win._active == (it == 25)
+        x = f(x)
+    win.close()
+    assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                     recursive=True)
