@@ -31,7 +31,14 @@ def _build_mesh_if_needed(cfg):
     return make_mesh(cfg.mesh)
 
 
+def _apply_rng_impl(args) -> None:
+    if getattr(args, "rng_impl", None):
+        import jax
+        jax.config.update("jax_default_prng_impl", args.rng_impl)
+
+
 def cmd_train(args) -> int:
+    _apply_rng_impl(args)
     if args.coordinator or args.num_processes:
         from .parallel.distributed import initialize
         pi, pn = initialize(args.coordinator, args.num_processes,
@@ -49,10 +56,31 @@ def cmd_train(args) -> int:
         from .utils.profiling import start_server
         start_server(args.profile_port)
         print(f"profiler server on :{args.profile_port}", file=sys.stderr)
-    res = train(cfg, mesh=mesh, logger=logger, checkpoint_manager=ck,
-                resume=args.resume, profile_dir=args.profile_dir,
-                profile_start=args.profile_start,
-                profile_steps=args.profile_steps)
+    # graceful preemption: SIGTERM/SIGINT finish the in-flight dispatch,
+    # checkpoint, and exit 0 — resume later with --resume
+    import signal
+    import threading
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        if stop.is_set() and signum == signal.SIGINT:
+            # second Ctrl+C: the user wants out NOW (e.g. a wedged TPU
+            # tunnel where no further step will ever complete)
+            signal.signal(signal.SIGINT, signal.default_int_handler)
+            raise KeyboardInterrupt
+        stop.set()
+
+    prev_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev_handlers[sig] = signal.signal(sig, _on_signal)
+    try:
+        res = train(cfg, mesh=mesh, logger=logger, checkpoint_manager=ck,
+                    resume=args.resume, profile_dir=args.profile_dir,
+                    profile_start=args.profile_start,
+                    profile_steps=args.profile_steps, stop_event=stop)
+    finally:
+        for sig, h in prev_handlers.items():
+            signal.signal(sig, h)
     if args.sample_after:
         _sample(res.state.params, cfg, res.tokenizer, args.sample_tokens)
     if ck:
@@ -77,6 +105,7 @@ def _sample(params, cfg, tokenizer, n_tokens: int, prompt_text: str = None,
 
 
 def cmd_generate(args) -> int:
+    _apply_rng_impl(args)
     import jax
     cfg = config_from_args(args)
     from .data.dataset import load_corpus
@@ -124,6 +153,7 @@ def cmd_import_hf(args) -> int:
 
 
 def cmd_eval(args) -> int:
+    _apply_rng_impl(args)
     import jax
     cfg = config_from_args(args)
     from .data.dataset import TokenDataset, load_corpus
@@ -179,10 +209,17 @@ def main(argv=None) -> int:
     pt.add_argument("--profile-steps", type=int, default=5)
     pt.add_argument("--profile-port", type=int, default=0,
                     help="start a live profiler server on this port")
+    pt.add_argument("--rng-impl", default=None,
+                    choices=["threefry2x32", "rbg"],
+                    help="dropout PRNG; 'rbg' uses the TPU hardware "
+                         "generator (~15%% faster steps at dropout 0.2)")
     pt.set_defaults(fn=cmd_train)
 
     pg = sub.add_parser("generate", help="sample from a model")
     add_config_flags(pg)
+    pg.add_argument("--rng-impl", default=None,
+                    choices=["threefry2x32", "rbg"],
+                    help="must match the checkpoint's training run")
     pg.add_argument("--checkpoint-dir", default=None)
     pg.add_argument("--prompt", default=None)
     pg.add_argument("--sample-tokens", type=int, default=500)
@@ -198,6 +235,9 @@ def main(argv=None) -> int:
 
     pe = sub.add_parser("eval", help="estimate train/val loss")
     add_config_flags(pe)
+    pe.add_argument("--rng-impl", default=None,
+                    choices=["threefry2x32", "rbg"],
+                    help="must match the checkpoint's training run")
     pe.add_argument("--checkpoint-dir", default=None)
     pe.set_defaults(fn=cmd_eval)
 

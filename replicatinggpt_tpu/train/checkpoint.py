@@ -32,6 +32,11 @@ class CheckpointManager:
     def save(self, state: TrainState, batcher: Any = None,
              wait: bool = False) -> int:
         step = int(jax.device_get(state.step))
+        # idempotent per step: callers overlap (periodic save + graceful
+        # stop + end-of-run can all land on one step), and orbax raises
+        # StepAlreadyExistsError on a duplicate
+        if step in self.mngr.all_steps():
+            return step
         args = {"state": ocp.args.StandardSave(state)}
         if batcher is not None:
             args["data"] = ocp.args.JsonSave(batcher.state())
@@ -50,6 +55,31 @@ class CheckpointManager:
         pytree of NamedSharding matching the state) re-lays-out arrays onto
         a mesh at load time — resume on a different topology than the save.
         """
+        # PRNG impls have different key shapes (threefry (2,), rbg (4,)):
+        # a checkpoint written under one impl cannot be resumed under
+        # another, and the StandardRestore shape error is cryptic — check
+        # the stored rng shape up front and say what actually went wrong
+        try:
+            # item_metadata warns (absl) about items it lacks restore
+            # handlers for; it's only being used here to read shapes
+            import logging
+            absl_log = logging.getLogger("absl")
+            prev_level = absl_log.level
+            absl_log.setLevel(logging.ERROR)
+            try:
+                saved_rng = self.mngr.item_metadata(step)["state"]["rng"]
+            finally:
+                absl_log.setLevel(prev_level)
+        except Exception:
+            saved_rng = None
+        if (saved_rng is not None and hasattr(saved_rng, "shape")
+                and tuple(saved_rng.shape)
+                != tuple(state_template.rng.shape)):
+            raise ValueError(
+                f"checkpoint step {step} stores an rng key of shape "
+                f"{tuple(saved_rng.shape)} but this run uses "
+                f"{tuple(state_template.rng.shape)} — it was written under "
+                f"a different PRNG impl; rerun with the matching --rng-impl")
         target = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
             state_template)

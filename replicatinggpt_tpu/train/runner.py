@@ -49,7 +49,14 @@ def _resolve_vocab(cfg: Config, tokenizer) -> Config:
 def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
           checkpoint_manager=None, resume: bool = False,
           profile_dir: Optional[str] = None,
-          profile_start: int = 10, profile_steps: int = 5) -> TrainResult:
+          profile_start: int = 10, profile_steps: int = 5,
+          stop_event=None) -> TrainResult:
+    """``stop_event`` (a ``threading.Event``-like object) requests a
+    graceful stop: the loop finishes the in-flight dispatch, saves a
+    checkpoint (when a manager is present), and returns normally — the
+    preemption story for TPU VMs, where SIGTERM precedes eviction (the
+    CLI wires this to SIGTERM/SIGINT; the reference loses the entire run,
+    SURVEY.md §5 failure-detection row)."""
     logger = logger or StepLogger()
     text = load_corpus(cfg.dataset)
     tokenizer = get_tokenizer(cfg.tokenizer, corpus_text=text,
@@ -205,10 +212,41 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     t0 = time.perf_counter()
     tokens_seen = 0
     logger.reset_timer()
+    def _stop_requested(it: int) -> bool:
+        if stop_event is None:
+            return False
+        if n_proc == 1:
+            return stop_event.is_set()
+        # Multi-host: signal delivery is skewed across hosts, and acting on
+        # a process-local flag would have hosts leave the loop at different
+        # iterations — the collective checkpoint save then deadlocks. Agree
+        # on the coordinator's flag, but only at checkpoint boundaries (a
+        # blocking host collective per step would throttle the loop); with
+        # no checkpoint cadence there is nothing durable to gain by
+        # stopping early, so the signal is ignored (logged at setup).
+        if (tcfg.checkpoint_every and it > start_step
+                and it % tcfg.checkpoint_every == 0):
+            from jax.experimental import multihost_utils
+            return bool(multihost_utils.broadcast_one_to_all(
+                np.int32(stop_event.is_set())))
+        return False
+
+    if stop_event is not None and n_proc > 1 and not tcfg.checkpoint_every:
+        logger.log("note: graceful stop disabled (multi-host run without "
+                   "checkpoint_every; no agreed boundary to stop at)")
+
     tokens_since_log = 0
+    stopped_early = False
     try:
         it = start_step
         while it < tcfg.max_iters:
+            if _stop_requested(it):
+                stopped_early = True
+                logger.log(f"stop requested at step {it}; "
+                           "checkpointing and exiting")
+                if checkpoint_manager is not None:
+                    checkpoint_manager.save(state, train_batcher)
+                break
             if (tcfg.eval_interval and it % tcfg.eval_interval == 0):
                 losses = estimate_loss(state.params, eval_batchers, eval_step,
                                        tcfg.eval_iters, device_put=dput)
@@ -255,11 +293,15 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
         profiler.close()
     jax.block_until_ready(state.params)
     wall = time.perf_counter() - t0
+    end_step = int(jax.device_get(state.step))
+    # under a preemption stop, keep the epilogue cheap: a short eval, and
+    # the checkpoint was already written before leaving the loop
     final_eval = estimate_loss(state.params, eval_batchers, eval_step,
-                               tcfg.eval_iters, device_put=dput)
-    logger.log_eval(tcfg.max_iters, final_eval["train"], final_eval["val"])
-    history.append((tcfg.max_iters, final_eval["train"], final_eval["val"]))
-    if checkpoint_manager is not None:
+                               min(tcfg.eval_iters, 8) if stopped_early
+                               else tcfg.eval_iters, device_put=dput)
+    logger.log_eval(end_step, final_eval["train"], final_eval["val"])
+    history.append((end_step, final_eval["train"], final_eval["val"]))
+    if checkpoint_manager is not None and not stopped_early:
         checkpoint_manager.save(state, train_batcher)
     tps = tokens_seen / wall / n_chips if wall > 0 else 0.0
     logger.log(f"trained {tokens_seen:,} tokens in {wall:.1f}s "
