@@ -162,7 +162,7 @@ def cmd_eval(args) -> int:
     from .train.checkpoint import CheckpointManager
     from .train.runner import _resolve_vocab
     from .train.state import create_train_state
-    from .train.steps import estimate_loss, make_eval_step
+    from .train.steps import estimate_loss, make_eval_scan, make_eval_step
     text = load_corpus(cfg.dataset)
     tokenizer = get_tokenizer(cfg.tokenizer, corpus_text=text)
     cfg = _resolve_vocab(cfg, tokenizer)
@@ -179,7 +179,8 @@ def cmd_eval(args) -> int:
                             cfg.model.block_size, seed=2),
     }
     out = estimate_loss(state.params, batchers, make_eval_step(cfg.model),
-                        cfg.train.eval_iters)
+                        cfg.train.eval_iters,
+                        eval_scan=make_eval_scan(cfg.model))
     print(f"train loss {out['train']:.4f}, val loss = {out['val']:.4f}")
     return 0
 

@@ -138,6 +138,14 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                               else ""))
     train_step = make_train_step(mcfg, tcfg, attention_fn=attention_fn,
                                  blocks_fn=blocks_fn)
+    eval_scan = None
+    if mesh is None:
+        # single chip: the whole eval pass rides one dispatch per split
+        # (sharded runs keep the per-batch loop so the global-batch
+        # sharding applies; the scan stack has no sharding annotation)
+        from .steps import make_eval_scan
+        eval_scan = make_eval_scan(mcfg, attention_fn=attention_fn,
+                                   blocks_fn=blocks_fn)
     train_scan = None
     scan_k = 1
     if tcfg.steps_per_dispatch > 1 and n_proc == 1:
@@ -249,7 +257,8 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                 break
             if (tcfg.eval_interval and it % tcfg.eval_interval == 0):
                 losses = estimate_loss(state.params, eval_batchers, eval_step,
-                                       tcfg.eval_iters, device_put=dput)
+                                       tcfg.eval_iters, device_put=dput,
+                                       eval_scan=eval_scan)
                 logger.log_eval(it, losses["train"], losses["val"])
                 history.append((it, losses["train"], losses["val"]))
                 logger.reset_timer()
@@ -296,9 +305,14 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     end_step = int(jax.device_get(state.step))
     # under a preemption stop, keep the epilogue cheap: a short eval, and
     # the checkpoint was already written before leaving the loop
+    # under a stop, also skip eval_scan: its (8,B,T) shape was never
+    # compiled and a fresh XLA compile is exactly what the grace window
+    # cannot afford — 8 already-compiled eval_step dispatches are cheap
     final_eval = estimate_loss(state.params, eval_batchers, eval_step,
                                min(tcfg.eval_iters, 8) if stopped_early
-                               else tcfg.eval_iters, device_put=dput)
+                               else tcfg.eval_iters, device_put=dput,
+                               eval_scan=None if stopped_early
+                               else eval_scan)
     logger.log_eval(end_step, final_eval["train"], final_eval["val"])
     history.append((end_step, final_eval["train"], final_eval["val"]))
     if checkpoint_manager is not None and not stopped_early:

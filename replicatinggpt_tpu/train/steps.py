@@ -117,19 +117,55 @@ def make_eval_step(mcfg: ModelConfig, attention_fn=None,
     return eval_step
 
 
+def make_eval_scan(mcfg: ModelConfig, attention_fn=None,
+                   blocks_fn=None) -> Callable:
+    """Jitted K-batch eval: ``(params, (K,B,T) xs/ys) -> (K,) losses`` via
+    an on-device ``lax.scan`` — the whole estimate_loss pass in one
+    dispatch per split instead of eval_iters of them (each dispatch costs
+    ~30 ms over a tunneled TPU; the reference's eval is 400 separate
+    forwards, SURVEY.md §3.3)."""
+
+    @jax.jit
+    def eval_scan(params, batches) -> jnp.ndarray:
+        def body(carry, b):
+            return carry, loss_fn(params, b, mcfg, rng=None, train=False,
+                                  attention_fn=attention_fn,
+                                  blocks_fn=blocks_fn)
+        _, losses = jax.lax.scan(body, None, batches)
+        return losses
+
+    return eval_scan
+
+
 def estimate_loss(params, batchers: Dict[str, Any], eval_step: Callable,
-                  eval_iters: int, device_put: Callable = None
-                  ) -> Dict[str, float]:
+                  eval_iters: int, device_put: Callable = None,
+                  eval_scan: Callable = None) -> Dict[str, float]:
     """Mean loss over ``eval_iters`` fresh batches for each split —
     ``estimate_loss`` semantics (GPT1.py:85-98), including the quirk that
-    'train' loss is itself a random K-batch sample (SURVEY.md §8-Q8)."""
+    'train' loss is itself a random K-batch sample (SURVEY.md §8-Q8).
+
+    With ``eval_scan`` (from :func:`make_eval_scan`), each split is one
+    stacked dispatch; identical batches and per-batch losses either way
+    (tests/test_train.py::test_estimate_loss_scan_matches_loop)."""
+    import numpy as np
     out = {}
+    if eval_scan is not None:
+        assert device_put is None or device_put is jax.device_put, (
+            "eval_scan stacks batches with no sharding annotation; "
+            "sharded runs must use the per-batch loop with their "
+            "sharding-aware device_put")
     for split, batcher in batchers.items():
-        total = 0.0
-        for _ in range(eval_iters):
-            xb, yb = batcher.next_batch()
-            if device_put is not None:
-                xb, yb = device_put(xb), device_put(yb)
-            total += float(eval_step(params, (xb, yb)))
-        out[split] = total / eval_iters
+        if eval_scan is not None:
+            xs, ys = zip(*(batcher.next_batch()
+                           for _ in range(eval_iters)))
+            losses = eval_scan(params, (np.stack(xs), np.stack(ys)))
+            out[split] = float(jnp.mean(losses))
+        else:
+            total = 0.0
+            for _ in range(eval_iters):
+                xb, yb = batcher.next_batch()
+                if device_put is not None:
+                    xb, yb = device_put(xb), device_put(yb)
+                total += float(eval_step(params, (xb, yb)))
+            out[split] = total / eval_iters
     return out

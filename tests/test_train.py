@@ -135,3 +135,27 @@ def test_runner_steps_per_dispatch_same_result(tiny):
     r2 = train(base.replace(
         train=dataclasses.replace(base.train, steps_per_dispatch=10)))
     assert abs(r1.final_eval["val"] - r2.final_eval["val"]) < 2e-3
+
+
+def test_estimate_loss_scan_matches_loop(tiny):
+    """Scanned eval must see the same batches and produce the same mean
+    loss as the per-batch loop (float32 reduction tolerance only)."""
+    from replicatinggpt_tpu.data.loader import make_batcher
+    from replicatinggpt_tpu.train.steps import make_eval_scan
+
+    m, t = tiny.model, tiny.train
+    state = create_train_state(jax.random.PRNGKey(0), m, t)
+    data = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (5000,), 0,
+                                         m.vocab_size), np.int32)
+
+    def batchers(seed):
+        return {"train": make_batcher("random", data, 4, m.block_size,
+                                      seed=seed),
+                "val": make_batcher("random", data, 4, m.block_size,
+                                    seed=seed + 1)}
+
+    loop = estimate_loss(state.params, batchers(5), make_eval_step(m), 6)
+    scan = estimate_loss(state.params, batchers(5), make_eval_step(m), 6,
+                         eval_scan=make_eval_scan(m))
+    for split in ("train", "val"):
+        assert abs(loop[split] - scan[split]) < 1e-5
