@@ -148,9 +148,11 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                                    blocks_fn=blocks_fn)
     train_scan = None
     scan_k = 1
-    if tcfg.steps_per_dispatch > 1 and n_proc == 1:
-        # multi-host superbatch assembly (global arrays stacked across
-        # processes) is not wired up; single-host only for now.
+    if tcfg.steps_per_dispatch > 1 and n_proc == 1 and mesh is None:
+        # unsharded runs only: jnp.stack of the superbatch would drop the
+        # (B,T) batch sharding on mesh runs (and multi-host global-array
+        # assembly is not wired up); dispatch overhead also matters most
+        # on the single tunneled chip.
         # Chunks never cross an eval/checkpoint boundary, so a dispatch
         # larger than those cadences could never run — clamp it. (Log
         # cadence does NOT clamp: log lines inside a chunk are emitted
