@@ -1,0 +1,203 @@
+"""Runnable parity report: JAX backend vs the retained PyTorch-CPU path.
+
+SURVEY.md §7 build-plan item 7 names "parity report vs the retained PyTorch
+scripts" as a deliverable; BASELINE.json keeps the torch path as the CPU
+reference. This tool produces that report as markdown:
+
+1. forward parity — same injected weights, same inputs, both GPT-1 and
+   GPT-2 flavors (untied/relu, tied/gelu): max |logits diff|, loss diff;
+2. gradient parity — max relative grad diff over the whole tree;
+3. training-curve parity — N AdamW steps on the same seeded batch stream
+   through both backends (optax.adamw vs torch.optim.AdamW, decoupled
+   weight decay both sides): per-step loss deltas and final spread;
+4. the documented semantic deviations (SURVEY.md §8 fidelity decisions).
+
+Run: python -m replicatinggpt_tpu.parity_report [--out PARITY_REPORT.md]
+(CPU-forced; ~2 min.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import io
+import sys
+
+
+def _forward_and_grad_parity(report: io.StringIO) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import torch
+
+    from .config import ModelConfig
+    from .models.gpt import forward, init_params
+    from .reference_torch import RefGPT, params_to_torch
+
+    report.write("## 1-2. Forward / gradient parity (same weights, same "
+                 "inputs)\n\n")
+    report.write("| flavor | max |logits diff| | loss diff | max rel grad "
+                 "diff |\n|---|---|---|---|\n")
+    for tied, act, label in ((False, "relu", "GPT-1 (untied, ReLU)"),
+                             (True, "gelu", "GPT-2 (tied, GELU)")):
+        cfg = ModelConfig(vocab_size=65, block_size=32, n_layer=2, n_head=2,
+                          n_embd=32, dropout=0.0, attn_dropout=0.0,
+                          tied_head=tied, activation=act, dtype="float32")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        x = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                          65), np.int64)
+        y = np.asarray(jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+                                          65), np.int64)
+
+        jlogits, jloss = forward(params, jnp.asarray(x, jnp.int32), cfg,
+                                 targets=jnp.asarray(y, jnp.int32))
+
+        tm = params_to_torch(params, RefGPT(cfg))
+        tlogits, tloss = tm(torch.from_numpy(x), torch.from_numpy(y))
+
+        dl = float(np.abs(np.asarray(jlogits)
+                          - tlogits.detach().numpy()).max())
+        dloss = abs(float(jloss) - float(tloss))
+
+        # gradients
+        def jf(p):
+            _, l = forward(p, jnp.asarray(x, jnp.int32), cfg,
+                           targets=jnp.asarray(y, jnp.int32))
+            return l
+        jg = jax.grad(jf)(params)
+        tm.zero_grad()
+        tloss.backward()
+        from .reference_torch import torch_to_params
+        tg = {}
+        # reuse the name mapping by reading grads through a weight-shaped
+        # copy: swap .data with .grad, convert, swap back
+        for p in tm.parameters():
+            p.data, p.grad = p.grad, p.data
+        tg = torch_to_params(tm)
+        for p in tm.parameters():
+            p.data, p.grad = p.grad, p.data
+
+        rel = 0.0
+        for ja, ta in zip(jax.tree_util.tree_leaves(jg),
+                          jax.tree_util.tree_leaves(tg)):
+            ja, ta = np.asarray(ja, np.float64), np.asarray(ta, np.float64)
+            denom = np.maximum(np.abs(ta), 1e-6)
+            rel = max(rel, float((np.abs(ja - ta) / denom).max()))
+        report.write(f"| {label} | {dl:.2e} | {dloss:.2e} | {rel:.2e} |\n")
+    report.write("\n")
+
+
+def _training_curve_parity(report: io.StringIO, steps: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import torch
+
+    from .config import get_config
+    from .data.dataset import TokenDataset, load_corpus
+    from .data.loader import RandomBatcher
+    from .models.gpt import init_params
+    from .reference_torch import RefGPT, params_to_torch
+    from .train.steps import make_train_step
+    from .tokenizers import get_tokenizer
+
+    cfg = get_config("test-tiny")
+    mcfg = dataclasses.replace(cfg.model, dropout=0.0, attn_dropout=0.0)
+    tcfg = cfg.train
+    text = load_corpus(cfg.dataset)
+    tok = get_tokenizer("char", corpus_text=text)
+    ds = TokenDataset.from_text(text, tok, tcfg.val_fraction)
+
+    # identical batch stream for both backends
+    stream = list(RandomBatcher(ds.train, 8, mcfg.block_size, seed=7)
+                  .next_batch() for _ in range(steps))
+
+    # one init, transferred losslessly to torch — the curves start from
+    # bit-identical weights
+    from .train.state import TrainState, make_optimizer
+    params0 = init_params(jax.random.PRNGKey(0), mcfg)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params0,
+                       opt_state=make_optimizer(tcfg).init(params0),
+                       rng=jax.random.PRNGKey(1))
+    step = make_train_step(mcfg, tcfg, donate=False)
+    jl = []
+    for xb, yb in stream:
+        state, metrics = step(state, (jnp.asarray(xb), jnp.asarray(yb)))
+        jl.append(float(metrics["loss"]))
+
+    tm = params_to_torch(params0, RefGPT(mcfg))
+    opt = torch.optim.AdamW(tm.parameters(), lr=tcfg.lr,
+                            betas=tcfg.betas, eps=1e-8,
+                            weight_decay=tcfg.weight_decay)
+    tl = []
+    for xb, yb in stream:
+        opt.zero_grad(set_to_none=True)
+        _, loss = tm(torch.from_numpy(np.asarray(xb, np.int64)),
+                     torch.from_numpy(np.asarray(yb, np.int64)))
+        loss.backward()
+        opt.step()
+        tl.append(float(loss))
+
+    diffs = [abs(a - b) for a, b in zip(jl, tl)]
+    report.write(f"## 3. Training-curve parity ({steps} AdamW steps, "
+                 "same init, same batches, dropout off)\n\n")
+    report.write("| step | jax loss | torch loss | diff |\n|---|---|---|---|\n")
+    for i in (0, 1, steps // 2, steps - 1):
+        report.write(f"| {i} | {jl[i]:.6f} | {tl[i]:.6f} | "
+                     f"{diffs[i]:.2e} |\n")
+    report.write(f"\nmax per-step |diff| over the run: "
+                 f"{max(diffs):.2e}; final spread {diffs[-1]:.2e} "
+                 f"(float32 accumulation-order noise only).\n\n")
+
+
+DEVIATIONS = """## 4. Documented semantic deviations (SURVEY.md §8 policy)
+
+Replicated: loss-line formats, eval cadence/semantics, sampling
+disciplines, HF import mapping, seeds/batch disciplines. Fixed, not
+replicated (reference as committed crashes or diverges):
+
+- B1/B5 vocab-tokenizer mismatches -> vocab always covers the tokenizer.
+- B2 broken nltk branch -> dropped.
+- B3 undefined `decode` on the tiktoken path -> decode on every tokenizer.
+- B4 lr=0.5 literal -> the declared 2e-4 is actually used.
+- B6 dead sampling code -> alive (`sample/generate.py`, top-k 50 preset).
+- Q1 attention scaled by n_embd -> head_dim scaling.
+- Q2 declared-but-unapplied dropouts -> applied.
+- Q4 NANOGPT_SCALE_INIT tag ignored -> residual init std/sqrt(2L) real.
+- generate() beyond block_size: per-token window crop (uncacheable) ->
+  half-window refresh (KV-cache compatible; documented in sample/).
+"""
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="PARITY_REPORT.md")
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--platform", default="cpu")
+    args = p.parse_args(argv)
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    report = io.StringIO()
+    report.write("# PARITY REPORT — JAX/TPU backend vs PyTorch-CPU "
+                 "reference path\n\nGenerated by "
+                 "`python -m replicatinggpt_tpu.parity_report`. The torch "
+                 "side is `reference_torch.py` (the retained CPU reference "
+                 "named in BASELINE.json), weight-transferred losslessly "
+                 "from the same JAX init.\n\n")
+    _forward_and_grad_parity(report)
+    _training_curve_parity(report, args.steps)
+    report.write(DEVIATIONS)
+
+    text = report.getvalue()
+    with open(args.out, "w") as f:
+        f.write(text)
+    print(text)
+    print(f"written to {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

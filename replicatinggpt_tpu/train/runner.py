@@ -148,6 +148,9 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                                    blocks_fn=blocks_fn)
     train_scan = None
     scan_k = 1
+    if tcfg.steps_per_dispatch > 1 and (n_proc > 1 or mesh is not None):
+        logger.log("steps_per_dispatch ignored: superbatch stacking is not "
+                   "wired for sharded/multi-host runs")
     if tcfg.steps_per_dispatch > 1 and n_proc == 1 and mesh is None:
         # unsharded runs only: jnp.stack of the superbatch would drop the
         # (B,T) batch sharding on mesh runs (and multi-host global-array

@@ -1,0 +1,13 @@
+"""Smoke test for the parity-report tool (SURVEY.md §7 item 7)."""
+
+import os
+
+
+def test_parity_report_runs(tmp_path):
+    from replicatinggpt_tpu.parity_report import main
+    out = str(tmp_path / "report.md")
+    assert main(["--out", out, "--steps", "4", "--platform", ""]) == 0
+    text = open(out).read()
+    assert "Forward / gradient parity" in text
+    assert "Training-curve parity" in text
+    assert "deviations" in text
