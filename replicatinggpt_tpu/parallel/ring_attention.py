@@ -87,7 +87,16 @@ def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
         src = (idx - s) % n  # chunk id the rotating KV now holds
-        acc, m, l = block_update(acc, m, l, k_cur, v_cur, src)
+        # chunks from the future (src > idx) are fully causal-masked —
+        # their block_update is all wasted FLOPs. The predicate is
+        # per-device (axis_index), which XLA:TPU lowers to a real
+        # conditional, so each device does only its causal share and the
+        # ring's total compute matches flash-style block skipping.
+        acc, m, l = jax.lax.cond(
+            src <= idx,
+            lambda a, mm, ll: block_update(a, mm, ll, k_cur, v_cur, src),
+            lambda a, mm, ll: (a, mm, ll),
+            acc, m, l)
         return (acc, m, l, k_cur, v_cur), None
 
     (acc, _, l, _, _), _ = jax.lax.scan(

@@ -75,3 +75,44 @@ def test_uneven_T_rejected():
     q2, k2, v2 = _qkv(T=160)
     with pytest.raises(AssertionError):
         pallas_flash_attention(q2, k2, v2)
+
+
+def test_auto_impl_picks_flash_at_long_T(monkeypatch):
+    """'auto' routes to the flash core once the dense (T,T) weight
+    materialization stops being the right trade (measured crossover), and
+    stays dense at short T / when attention-weight dropout must apply."""
+    from replicatinggpt_tpu.config import ModelConfig
+    from replicatinggpt_tpu.models.gpt import forward, init_params
+    from replicatinggpt_tpu.ops import attention as attn_mod
+
+    calls = []
+    real = attn_mod.full_causal_attention
+
+    def spy(q, k, v, **kw):
+        calls.append(kw.get("impl"))
+        return real(q, k, v, **kw)
+
+    import replicatinggpt_tpu.models.gpt as gpt_mod
+    monkeypatch.setattr(gpt_mod, "full_causal_attention", spy)
+
+    def route_for(T, attn_dropout=0.0, train=False):
+        cfg = ModelConfig(vocab_size=65, block_size=T, n_layer=1, n_head=2,
+                          n_embd=64, dropout=0.0, attn_dropout=attn_dropout,
+                          attention_impl="auto", dtype="float32")
+        params = jax.eval_shape(lambda k: init_params(k, cfg),
+                                jax.random.PRNGKey(0))
+        rng = jax.random.PRNGKey(0) if train else None
+        calls.clear()
+        jax.make_jaxpr(
+            lambda p, x: forward(p, x, cfg, rng=rng, train=train)[0]
+        )(params, jnp.zeros((1, T), jnp.int32))
+        assert calls, "attention core was not invoked"
+        return calls[0]
+
+    assert route_for(256) == "einsum"
+    assert route_for(1024) == "flash"
+    # attention-weight dropout only exists on the dense path: gpt.py still
+    # requests flash (downstream full_causal_attention makes the fallback,
+    # one source of truth) but warns that the dense path will run
+    with pytest.warns(UserWarning, match="O\\(T\\^2\\)"):
+        assert route_for(1024, attn_dropout=0.2, train=True) == "flash"
