@@ -111,13 +111,17 @@ def make_batcher(kind: str, data: np.ndarray, batch_size: int,
     raise ValueError(f"unknown sampling kind {kind!r}")
 
 
-def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2
-             ) -> Iterator:
+def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2,
+             superbatch_sharding=None) -> Iterator:
     """Move batches to device on a background thread, ``depth`` ahead.
 
     ``sharding`` is an optional ``jax.sharding.Sharding`` for the global
     (B, T) batch (data/seq-parallel layouts); None keeps the default single
-    -device placement.
+    -device placement. A stream mixing single (B, T) batches and stacked
+    (K, B, T) superbatches (multi-step dispatch) routes 3-d items to
+    ``superbatch_sharding`` (P(None,'data','seq')) — required whenever
+    ``sharding`` is set and 3-d items appear, so the scan path never drops
+    the batch sharding.
     """
     import jax
 
@@ -136,21 +140,32 @@ def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2
                 continue
         return False
 
-    def producer():
+    def _place(a):
+        if sharding is None:
+            return jax.device_put(a)
+        # multi-process: each host contributes only its local rows
+        # (jax.make_array_from_process_local_data); single-process
+        # this is plain device_put with the sharding
         from ..parallel.distributed import global_batch
-        for b in batches:
-            if stop.is_set():
-                return
-            if sharding is not None:
-                # multi-process: each host contributes only its local rows
-                # (jax.make_array_from_process_local_data); single-process
-                # this is plain device_put with the sharding
-                b = tuple(global_batch(a, sharding) for a in b)
-            else:
-                b = tuple(jax.device_put(a) for a in b)
-            if not _put(b):
-                return
-        _put(None)
+        if a.ndim == 3:
+            assert superbatch_sharding is not None, (
+                "stacked (K,B,T) superbatch on a sharded run needs "
+                "superbatch_sharding")
+            return global_batch(a, superbatch_sharding, batch_axis=1)
+        return global_batch(a, sharding)
+
+    def producer():
+        try:
+            for b in batches:
+                if stop.is_set():
+                    return
+                b = tuple(_place(a) for a in b)
+                if not _put(b):
+                    return
+            _put(None)
+        except BaseException as e:  # noqa: BLE001 — surface in the consumer
+            # a dead producer must not leave the consumer blocked on q.get()
+            _put(e)
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
@@ -159,6 +174,8 @@ def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2
             b = q.get()
             if b is None:
                 return
+            if isinstance(b, BaseException):
+                raise b
             yield b
     finally:
         stop.set()

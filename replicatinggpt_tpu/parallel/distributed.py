@@ -77,20 +77,24 @@ def per_process_seed(seed: int) -> int:
     return seed * 1000003 + 16 * jax.process_index()
 
 
-def global_batch(local_rows: np.ndarray, sharding) -> jax.Array:
+def global_batch(local_rows: np.ndarray, sharding,
+                 batch_axis: int = 0) -> jax.Array:
     """Assemble the global array from this process's local rows.
 
-    ``local_rows``: (B/process_count, T) NumPy array; ``sharding``: the
-    NamedSharding of the global batch (P('data', 'seq')). Each process
-    contributes only its rows — the global batch never exists on any one
-    host. Single-process: equivalent to ``jax.device_put``.
+    ``local_rows``: NumPy array whose ``batch_axis`` dim holds this
+    process's B/process_count rows — (B_local, T) for a single batch, or
+    (K, B_local, T) with ``batch_axis=1`` for a stacked multi-step
+    superbatch. ``sharding``: the NamedSharding of the global array
+    (P('data','seq') / P(None,'data','seq')). Each process contributes only
+    its rows — the global batch never exists on any one host.
+    Single-process: equivalent to ``jax.device_put``.
     """
     if jax.process_count() == 1:
         return jax.device_put(local_rows, sharding)
-    global_shape = (local_rows.shape[0] * jax.process_count(),
-                    *local_rows.shape[1:])
+    global_shape = list(local_rows.shape)
+    global_shape[batch_axis] *= jax.process_count()
     return jax.make_array_from_process_local_data(
-        sharding, local_rows, global_shape)
+        sharding, local_rows, tuple(global_shape))
 
 
 def is_coordinator() -> bool:

@@ -67,6 +67,18 @@ def make_batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, batch_pspec())
 
 
+def superbatch_pspec() -> P:
+    """(K, B, T) stacked multi-step superbatch: the scan dim replicates,
+    batch rows and sequence keep the (data, seq) layout of a single batch —
+    so a K-step lax.scan dispatch sees each step's batch sharded exactly
+    like the single-step path."""
+    return P(None, "data", "seq")
+
+
+def make_superbatch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, superbatch_pspec())
+
+
 def _tp_spec(name: str, ndim: int) -> list:
     """Tensor-parallel placement for a leaf called ``name``."""
     spec = [None] * ndim

@@ -138,24 +138,22 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                               else ""))
     train_step = make_train_step(mcfg, tcfg, attention_fn=attention_fn,
                                  blocks_fn=blocks_fn)
-    eval_scan = None
-    if mesh is None:
-        # single chip: the whole eval pass rides one dispatch per split
-        # (sharded runs keep the per-batch loop so the global-batch
-        # sharding applies; the scan stack has no sharding annotation)
-        from .steps import make_eval_scan
-        eval_scan = make_eval_scan(mcfg, attention_fn=attention_fn,
-                                   blocks_fn=blocks_fn)
+    super_sharding = None
+    superbatch_put = None
+    if mesh is not None:
+        from ..parallel.distributed import global_batch
+        from ..parallel.mesh import make_superbatch_sharding
+        super_sharding = make_superbatch_sharding(mesh)
+        superbatch_put = (lambda a: global_batch(a, super_sharding,
+                                                 batch_axis=1))
+    # the whole eval pass rides one stacked dispatch per split; sharded runs
+    # keep the batch sharding via the P(None,'data','seq') superbatch layout
+    from .steps import make_eval_scan
+    eval_scan = make_eval_scan(mcfg, attention_fn=attention_fn,
+                               blocks_fn=blocks_fn)
     train_scan = None
     scan_k = 1
-    if tcfg.steps_per_dispatch > 1 and (n_proc > 1 or mesh is not None):
-        logger.log("steps_per_dispatch ignored: superbatch stacking is not "
-                   "wired for sharded/multi-host runs")
-    if tcfg.steps_per_dispatch > 1 and n_proc == 1 and mesh is None:
-        # unsharded runs only: jnp.stack of the superbatch would drop the
-        # (B,T) batch sharding on mesh runs (and multi-host global-array
-        # assembly is not wired up); dispatch overhead also matters most
-        # on the single tunneled chip.
+    if tcfg.steps_per_dispatch > 1:
         # Chunks never cross an eval/checkpoint boundary, so a dispatch
         # larger than those cadences could never run — clamp it. (Log
         # cadence does NOT clamp: log lines inside a chunk are emitted
@@ -209,7 +207,37 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
             else np.uint16 if mcfg.vocab_size <= 0xffff else np.int32)
     narrow = ((x.astype(wire), y.astype(wire))
               for x, y in iter(train_batcher))
-    batches = prefetch(narrow, sharding=batch_sharding)
+
+    def chunk_at(i: int) -> int:
+        """Steps the dispatch issued at iteration ``i`` advances: scan_k,
+        or 1 when an eval/checkpoint/max_iters boundary is closer. Pure in
+        ``i``, so the feed producer below and the consuming loop walk the
+        same schedule independently."""
+        if train_scan is None:
+            return 1
+        room = tcfg.max_iters - i
+        for interval in (tcfg.eval_interval, tcfg.checkpoint_every):
+            if interval:
+                room = min(room, interval - i % interval)
+        return scan_k if room >= scan_k else 1
+
+    def feed():
+        # host-side assembly of exactly what each dispatch consumes: a
+        # (B, T) batch, or a host-stacked (K, B, T) superbatch for scan
+        # dispatches (prefetch shards 3-d items with P(None,'data','seq'),
+        # so mesh runs keep their batch sharding through the scan)
+        i = start_step
+        while i < tcfg.max_iters:
+            c = chunk_at(i)
+            if c > 1:
+                xs, ys = zip(*(next(narrow) for _ in range(c)))
+                yield np.stack(xs), np.stack(ys)
+            else:
+                yield next(narrow)
+            i += c
+
+    batches = prefetch(feed(), sharding=batch_sharding,
+                       superbatch_sharding=super_sharding)
     import time
 
     from ..utils.profiling import trace_window
@@ -263,28 +291,20 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
             if (tcfg.eval_interval and it % tcfg.eval_interval == 0):
                 losses = estimate_loss(state.params, eval_batchers, eval_step,
                                        tcfg.eval_iters, device_put=dput,
-                                       eval_scan=eval_scan)
+                                       eval_scan=eval_scan,
+                                       superbatch_put=superbatch_put)
                 logger.log_eval(it, losses["train"], losses["val"])
                 history.append((it, losses["train"], losses["val"]))
                 logger.reset_timer()
             # after the eval block so the trace captures train steps only
             profiler.step(it)
             # a chunk never crosses an eval/checkpoint boundary, so those
-            # cadences behave exactly as in the single-step loop
-            chunk = 1
-            if train_scan is not None:
-                chunk = tcfg.max_iters - it
-                for interval in (tcfg.eval_interval, tcfg.checkpoint_every):
-                    if interval:
-                        chunk = min(chunk, interval - it % interval)
-            if train_scan is not None and chunk >= scan_k:
-                chunk = scan_k
-                import jax.numpy as jnp
-                xs, ys = zip(*(next(batches) for _ in range(chunk)))
-                state, metrics = train_scan(state,
-                                            (jnp.stack(xs), jnp.stack(ys)))
+            # cadences behave exactly as in the single-step loop; the feed
+            # producer assembled this dispatch's batch to the same schedule
+            chunk = chunk_at(it)
+            if chunk > 1:
+                state, metrics = train_scan(state, next(batches))
             else:
-                chunk = 1
                 state, metrics = train_step(state, next(batches))
             prev_it, it = it, it + chunk
             tokens_seen += tokens_per_batch * chunk
@@ -317,7 +337,8 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                                min(tcfg.eval_iters, 8) if stopped_early
                                else tcfg.eval_iters, device_put=dput,
                                eval_scan=None if stopped_early
-                               else eval_scan)
+                               else eval_scan,
+                               superbatch_put=superbatch_put)
     logger.log_eval(end_step, final_eval["train"], final_eval["val"])
     history.append((end_step, final_eval["train"], final_eval["val"]))
     if checkpoint_manager is not None and not stopped_early:

@@ -139,26 +139,32 @@ def make_eval_scan(mcfg: ModelConfig, attention_fn=None,
 
 def estimate_loss(params, batchers: Dict[str, Any], eval_step: Callable,
                   eval_iters: int, device_put: Callable = None,
-                  eval_scan: Callable = None) -> Dict[str, float]:
+                  eval_scan: Callable = None,
+                  superbatch_put: Callable = None) -> Dict[str, float]:
     """Mean loss over ``eval_iters`` fresh batches for each split —
     ``estimate_loss`` semantics (GPT1.py:85-98), including the quirk that
     'train' loss is itself a random K-batch sample (SURVEY.md §8-Q8).
 
     With ``eval_scan`` (from :func:`make_eval_scan`), each split is one
     stacked dispatch; identical batches and per-batch losses either way
-    (tests/test_train.py::test_estimate_loss_scan_matches_loop)."""
+    (tests/test_train.py::test_estimate_loss_scan_matches_loop). Sharded
+    runs pass ``superbatch_put`` to place the stacked (K, B, T) arrays with
+    the P(None,'data','seq') superbatch sharding (multi-host: per-process
+    rows assembled via make_array_from_process_local_data)."""
     import numpy as np
     out = {}
-    if eval_scan is not None:
+    if eval_scan is not None and superbatch_put is None:
         assert device_put is None or device_put is jax.device_put, (
-            "eval_scan stacks batches with no sharding annotation; "
-            "sharded runs must use the per-batch loop with their "
-            "sharding-aware device_put")
+            "eval_scan on a sharded run needs superbatch_put to keep the "
+            "batch sharding on the stacked (K,B,T) arrays")
     for split, batcher in batchers.items():
         if eval_scan is not None:
             xs, ys = zip(*(batcher.next_batch()
                            for _ in range(eval_iters)))
-            losses = eval_scan(params, (np.stack(xs), np.stack(ys)))
+            stacked = (np.stack(xs), np.stack(ys))
+            if superbatch_put is not None:
+                stacked = tuple(superbatch_put(a) for a in stacked)
+            losses = eval_scan(params, stacked)
             out[split] = float(jnp.mean(losses))
         else:
             total = 0.0

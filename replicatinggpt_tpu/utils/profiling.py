@@ -104,9 +104,15 @@ class StepTimer:
 
     def lap(self, *block_on: Any) -> float:
         """End the current lap, blocking on ``block_on`` first. Returns
-        the lap time and immediately starts the next lap."""
+        the lap time and immediately starts the next lap.
+
+        Blocking is a real device->host fetch (``jax.device_get``), not
+        ``block_until_ready``: some tunneled PJRT backends (axon) return
+        from block_until_ready before device execution finishes, which
+        makes latency laps impossibly fast. A fetch cannot lie.
+        """
         if block_on:
-            jax.block_until_ready(block_on)
+            jax.device_get(block_on)
         now = time.perf_counter()
         assert self._t0 is not None, "call start() before lap()"
         dt = now - self._t0

@@ -82,3 +82,19 @@ def test_prefetch_yields_device_arrays():
     x, y = next(it)
     assert isinstance(x, jax.Array)
     assert x.shape == (2, 5)
+
+
+def test_prefetch_propagates_producer_errors():
+    import pytest
+    """An exception in the prefetch producer thread must surface in the
+    consumer, not leave it blocked forever on the queue."""
+    from replicatinggpt_tpu.data.loader import prefetch
+
+    def bad():
+        yield (np.zeros((2, 4), np.int32), np.zeros((2, 4), np.int32))
+        raise ValueError("producer blew up")
+
+    it = prefetch(bad())
+    next(it)
+    with pytest.raises(ValueError, match="producer blew up"):
+        next(it)
