@@ -148,9 +148,13 @@ def peak_flops_per_sec(device_kind: str) -> float | None:
     return None
 
 
+def _baseline_key(mcfg, batch_size: int) -> str:
+    return (f"char_gpt_L{mcfg.n_layer}_H{mcfg.n_head}_C{mcfg.n_embd}"
+            f"_T{mcfg.block_size}_B{batch_size}")
+
+
 def torch_cpu_baseline(mcfg, batch_size: int, remeasure: bool) -> float:
-    key = (f"char_gpt_L{mcfg.n_layer}_H{mcfg.n_head}_C{mcfg.n_embd}"
-           f"_T{mcfg.block_size}_B{batch_size}")
+    key = _baseline_key(mcfg, batch_size)
     cache = {}
     if os.path.exists(CACHE_PATH):
         try:
@@ -330,7 +334,7 @@ def bench_train(args) -> None:
         if os.path.exists(CACHE_PATH):
             try:
                 with open(CACHE_PATH) as f:
-                    base = list(json.load(f).values())[0]
+                    base = json.load(f).get(_baseline_key(mcfg, B), 0.0)
             except Exception:
                 base = 0.0
     else:

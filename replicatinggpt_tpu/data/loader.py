@@ -141,6 +141,8 @@ def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2,
         return False
 
     def _place(a):
+        if not isinstance(a, np.ndarray):
+            return a  # pass-through metadata (e.g. batcher-cursor snapshots)
         if sharding is None:
             return jax.device_put(a)
         # multi-process: each host contributes only its local rows

@@ -34,8 +34,12 @@ class CheckpointManager:
         step = int(jax.device_get(state.step))
         # idempotent per step: callers overlap (periodic save + graceful
         # stop + end-of-run can all land on one step), and orbax raises
-        # StepAlreadyExistsError on a duplicate
+        # StepAlreadyExistsError on a duplicate. A duplicate may still be
+        # in flight from the original async save — a wait=True caller is
+        # asking for durability, so block on it either way.
         if step in self.mngr.all_steps():
+            if wait:
+                self.mngr.wait_until_finished()
             return step
         args = {"state": ocp.args.StandardSave(state)}
         if batcher is not None:

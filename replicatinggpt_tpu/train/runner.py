@@ -193,7 +193,16 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
         if restore_batcher is None:
             logger.log("multi-host resume: random-batcher RNG state not "
                        "restored; streams re-seeded per process")
-        restored = checkpoint_manager.restore_latest(state, restore_batcher)
+        # restore straight into the mesh layout: every leaf of the live
+        # state already carries its NamedSharding (shard_train_state), so
+        # orbax lays each array out shard-by-shard — an FSDP-sized model
+        # never materializes replicated (which would blow HBM)
+        restore_shardings = None
+        if mesh is not None:
+            restore_shardings = jax.tree_util.tree_map(
+                lambda x: x.sharding, state)
+        restored = checkpoint_manager.restore_latest(
+            state, restore_batcher, shardings=restore_shardings)
         if restored is not None:
             state = restored
             start_step = int(jax.device_get(state.step))
@@ -225,19 +234,42 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
         # host-side assembly of exactly what each dispatch consumes: a
         # (B, T) batch, or a host-stacked (K, B, T) superbatch for scan
         # dispatches (prefetch shards 3-d items with P(None,'data','seq'),
-        # so mesh runs keep their batch sharding through the scan)
+        # so mesh runs keep their batch sharding through the scan).
+        # Each item carries the batcher-state snapshot taken right after
+        # its batches were drawn: the prefetch producer runs ahead of the
+        # consumed step, so a mid-run checkpoint must save the cursor
+        # as-of-consumption, not the live (raced-ahead) batcher state.
         i = start_step
         while i < tcfg.max_iters:
             c = chunk_at(i)
             if c > 1:
                 xs, ys = zip(*(next(narrow) for _ in range(c)))
-                yield np.stack(xs), np.stack(ys)
+                item = (np.stack(xs), np.stack(ys))
             else:
-                yield next(narrow)
+                item = next(narrow)
+            yield (*item, train_batcher.state())
             i += c
 
-    batches = prefetch(feed(), sharding=batch_sharding,
-                       superbatch_sharding=super_sharding)
+    class _ConsumedCursor:
+        """Batcher-shaped view holding the snapshot matching the consumed
+        step — what checkpoints must persist (see feed())."""
+
+        def __init__(self, snap):
+            self.snap = snap
+
+        def state(self):
+            return self.snap
+
+    cursor = _ConsumedCursor(train_batcher.state())
+    batches_raw = prefetch(feed(), sharding=batch_sharding,
+                           superbatch_sharding=super_sharding)
+
+    def batches_iter():
+        for *batch, snap in batches_raw:
+            cursor.snap = snap
+            yield tuple(batch)
+
+    batches = batches_iter()
     import time
 
     from ..utils.profiling import trace_window
@@ -286,7 +318,7 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                 logger.log(f"stop requested at step {it}; "
                            "checkpointing and exiting")
                 if checkpoint_manager is not None:
-                    checkpoint_manager.save(state, train_batcher)
+                    checkpoint_manager.save(state, cursor)
                 break
             if (tcfg.eval_interval and it % tcfg.eval_interval == 0):
                 losses = estimate_loss(state.params, eval_batchers, eval_step,
@@ -322,7 +354,7 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                     tokens_since_log = 0
             if (checkpoint_manager is not None and tcfg.checkpoint_every
                     and it % tcfg.checkpoint_every == 0):
-                checkpoint_manager.save(state, train_batcher)
+                checkpoint_manager.save(state, cursor)
     finally:
         profiler.close()
     jax.block_until_ready(state.params)
@@ -342,7 +374,7 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     logger.log_eval(end_step, final_eval["train"], final_eval["val"])
     history.append((end_step, final_eval["train"], final_eval["val"]))
     if checkpoint_manager is not None and not stopped_early:
-        checkpoint_manager.save(state, train_batcher)
+        checkpoint_manager.save(state, cursor)
     tps = tokens_seen / wall / n_chips if wall > 0 else 0.0
     logger.log(f"trained {tokens_seen:,} tokens in {wall:.1f}s "
                f"({tps:,.0f} tok/s/chip)")

@@ -1,0 +1,107 @@
+"""Subprocess worker for the REAL multi-process distributed tests.
+
+Each instance is one `jax.distributed` process (CPU backend, gloo
+cross-process collectives). It runs the full training runner — global-batch
+assembly via make_array_from_process_local_data, DP grad psum under GSPMD,
+multi-host logging gate, checkpoint-boundary stop agreement — and the
+coordinator dumps a JSON summary (end step, per-leaf param sums of squares,
+eval history) for the parent test to compare against a single-process run.
+
+Sequential sampling is forced so the assembled global token stream is
+bit-identical for any process count (SequentialBatcher's sharded-cursor
+contract), making final params directly comparable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--port", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--max-iters", type=int, default=20)
+    p.add_argument("--steps-per-dispatch", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--stop-on-proc", type=int, default=-1,
+                   help="process whose stop_event reads set from step 0 "
+                        "(-1: no stop_event at all)")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if args.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{args.port}",
+            num_processes=args.num_processes,
+            process_id=args.process_id)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+
+    import numpy as np
+
+    from replicatinggpt_tpu.config import MeshConfig, get_config
+    from replicatinggpt_tpu.parallel.mesh import make_mesh
+    from replicatinggpt_tpu.train.runner import train
+
+    cfg = get_config("test-tiny")
+    cfg = cfg.replace(
+        train=dataclasses.replace(
+            cfg.train, max_iters=args.max_iters, eval_interval=10,
+            eval_iters=2, log_interval=0, batch_size=8,
+            sampling="sequential",
+            steps_per_dispatch=args.steps_per_dispatch,
+            checkpoint_every=args.checkpoint_every),
+        mesh=MeshConfig(data=jax.device_count()),
+        dataset=os.path.join(repo, "datasets", "shakespeare.txt"))
+    mesh = make_mesh(cfg.mesh)
+
+    class _Flag:
+        def __init__(self, value: bool):
+            self._v = value
+
+        def is_set(self) -> bool:
+            return self._v
+
+    stop_event = None
+    if args.stop_on_proc >= 0:
+        stop_event = _Flag(args.stop_on_proc == jax.process_index())
+
+    ckm = None
+    if args.checkpoint_dir:
+        from replicatinggpt_tpu.train.checkpoint import CheckpointManager
+        ckm = CheckpointManager(args.checkpoint_dir)
+
+    res = train(cfg, mesh=mesh, checkpoint_manager=ckm,
+                resume=args.resume, stop_event=stop_event)
+    end_step = int(jax.device_get(res.state.step))
+    param_sq = [float(np.square(np.asarray(jax.device_get(leaf),
+                                           np.float64)).sum())
+                for leaf in jax.tree_util.tree_leaves(res.state.params)]
+    if ckm is not None:
+        ckm.wait()
+        checkpoint_steps = [int(s) for s in ckm.mngr.all_steps()]
+        ckm.close()
+    else:
+        checkpoint_steps = []
+    if jax.process_index() == 0:
+        with open(args.out, "w") as f:
+            json.dump({"end_step": end_step,
+                       "param_sq": param_sq,
+                       "checkpoint_steps": checkpoint_steps,
+                       "history": res.history}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -180,3 +180,78 @@ def test_restore_rejects_mismatched_rng_impl(tmp_path):
     template = state._replace(rng=jnp.zeros((4,), jnp.uint32))
     with pytest.raises(ValueError, match="PRNG impl"):
         ck.restore_latest(template)
+
+
+def test_sharded_resume_restores_mesh_layout(tmp_path):
+    """FSDP-mesh run: checkpoint at step 5, resume to 10 — restored leaves
+    must carry their mesh shardings (an FSDP model must never restore
+    replicated) and the continued run must be bit-identical to an
+    uninterrupted 10-step run."""
+    from replicatinggpt_tpu.config import MeshConfig, get_config
+    from replicatinggpt_tpu.parallel.mesh import make_mesh, state_pspecs
+    from replicatinggpt_tpu.train.runner import train
+
+    cfg = get_config("test-tiny")
+    mesh_cfg = MeshConfig(data=8, fsdp=True)
+    base = cfg.replace(
+        train=dataclasses.replace(cfg.train, max_iters=10, eval_interval=0,
+                                  eval_iters=2, log_interval=0, batch_size=8,
+                                  checkpoint_every=5),
+        mesh=mesh_cfg, dataset="datasets/shakespeare.txt")
+    mesh = make_mesh(mesh_cfg)
+    full = train(base, mesh=mesh)
+
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    first = base.replace(train=dataclasses.replace(base.train, max_iters=5))
+    train(first, mesh=mesh, checkpoint_manager=ck)
+    ck.wait()
+    resumed = train(base, mesh=mesh, checkpoint_manager=ck, resume=True)
+    assert int(jax.device_get(resumed.state.step)) == 10
+
+    # every restored param kept its FSDP layout (state_pspecs is the
+    # oracle; equivalence, not spec equality — jax normalizes size-1 axes
+    # and trailing Nones when reporting a live array's sharding)
+    from jax.sharding import NamedSharding
+    specs = state_pspecs(resumed.state, mesh_cfg).params
+    mismatched = []
+    for (path, leaf), (_, spec) in zip(
+            jax.tree_util.tree_flatten_with_path(resumed.state.params)[0],
+            jax.tree_util.tree_flatten_with_path(specs)[0]):
+        want = NamedSharding(mesh, spec)
+        if not leaf.sharding.is_equivalent_to(want, leaf.ndim):
+            mismatched.append((jax.tree_util.keystr(path),
+                               leaf.sharding.spec, spec))
+    assert not mismatched, mismatched
+    # FSDP actually sharded something (guard against a vacuous pass)
+    assert any("data" in tuple(l.sharding.spec)
+               for l in jax.tree_util.tree_leaves(resumed.state.params))
+
+    _trees_equal(full.state.params, resumed.state.params)
+    ck.close()
+
+
+def test_midrun_checkpoint_cursor_not_skewed_by_prefetch(tmp_path):
+    """The prefetch producer draws scan_k x depth batches ahead of the
+    consumed step; a mid-run checkpoint must save the cursor as-of the
+    checkpointed step (not the raced-ahead live batcher), so resume
+    continues on the exact token stream."""
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.train.runner import train
+
+    cfg = get_config("test-tiny")
+    base = cfg.replace(
+        train=dataclasses.replace(cfg.train, max_iters=8, eval_interval=0,
+                                  eval_iters=2, log_interval=0, batch_size=8,
+                                  sampling="sequential",
+                                  steps_per_dispatch=4,
+                                  checkpoint_every=4),
+        dataset="datasets/shakespeare.txt")
+    full = train(base)
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    part = base.replace(train=dataclasses.replace(base.train, max_iters=4))
+    train(part, checkpoint_manager=ck)
+    ck.wait()
+    resumed = train(base, checkpoint_manager=ck, resume=True)
+    assert int(jax.device_get(resumed.state.step)) == 8
+    _trees_equal(full.state.params, resumed.state.params)
+    ck.close()

@@ -85,9 +85,10 @@ def test_prefetch_yields_device_arrays():
 
 
 def test_prefetch_propagates_producer_errors():
-    import pytest
     """An exception in the prefetch producer thread must surface in the
     consumer, not leave it blocked forever on the queue."""
+    import pytest
+
     from replicatinggpt_tpu.data.loader import prefetch
 
     def bad():

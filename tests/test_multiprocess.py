@@ -1,0 +1,116 @@
+"""REAL multi-process distributed execution (the proof the single-process
+glue tests in test_distributed.py cannot give): two `jax.distributed`
+CPU processes run the full training runner — global-batch assembly,
+cross-process DP psum, multi-host superbatch dispatch, and the
+checkpoint-boundary stop agreement — and must match a single-process run
+on the same global token stream.
+
+The reference has no distributed story at all (SURVEY.md §2.2); these
+tests pin the framework's DCN-glue claim with actual multi-process
+execution (subprocesses, not a pod — same code path as a v4-32 slice,
+gloo instead of DCN underneath).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "dist_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run(nprocs: int, outdir: str, tag: str, extra=()):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # 1 CPU device per process
+    procs, out = [], os.path.join(outdir, f"out_{tag}.json")
+    for i in range(nprocs):
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, "--process-id", str(i),
+             "--num-processes", str(nprocs), "--port", str(port),
+             "--out", out, *extra],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    logs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, l in zip(procs, logs):
+        assert p.returncode == 0, f"worker rc={p.returncode}:\n{l[-4000:]}"
+    with open(out) as f:
+        return json.load(f), logs
+
+
+@pytest.fixture(scope="module")
+def single_process_reference(tmp_path_factory):
+    out, _ = _run(1, str(tmp_path_factory.mktemp("ref")), "ref")
+    return out
+
+
+def test_two_process_dp_matches_single_process(single_process_reference,
+                                               tmp_path):
+    got, _ = _run(2, str(tmp_path), "dp2")
+    ref = single_process_reference
+    assert got["end_step"] == ref["end_step"] == 20
+    # same global token stream + same init => same trained params, up to
+    # cross-process reduction-order float drift
+    np.testing.assert_allclose(got["param_sq"], ref["param_sq"], rtol=1e-4)
+
+
+def test_two_process_multistep_dispatch_matches_single_process(
+        single_process_reference, tmp_path):
+    """steps_per_dispatch>1 across processes: the (K,B,T) superbatch is
+    assembled from per-process rows (batch_axis=1) — trained params must
+    still match the single-step single-process run."""
+    got, _ = _run(2, str(tmp_path), "dp2k5", ["--steps-per-dispatch", "5"])
+    ref = single_process_reference
+    assert got["end_step"] == ref["end_step"] == 20
+    np.testing.assert_allclose(got["param_sq"], ref["param_sq"], rtol=1e-4)
+
+
+def test_stop_on_noncoordinator_is_ignored(tmp_path):
+    """Only the coordinator's flag decides (skewed signal delivery must not
+    desynchronize the hosts): a stop_event set on process 1 alone runs to
+    completion on both."""
+    got, _ = _run(2, str(tmp_path), "stop1",
+                  ["--stop-on-proc", "1", "--checkpoint-every", "5",
+                   "--checkpoint-dir", str(tmp_path / "ck1")])
+    assert got["end_step"] == 20
+
+
+def test_stop_on_coordinator_stops_both_at_boundary(tmp_path):
+    """Coordinator's stop_event: both processes agree at the first
+    checkpoint boundary, save there, and exit cleanly (no deadlock in the
+    collective save)."""
+    got, _ = _run(2, str(tmp_path), "stop0",
+                  ["--stop-on-proc", "0", "--checkpoint-every", "5",
+                   "--checkpoint-dir", str(tmp_path / "ck0")])
+    assert got["end_step"] == 5
+    assert 5 in got["checkpoint_steps"]
+
+
+def test_two_process_checkpoint_resume(tmp_path):
+    """Collective checkpoint at step 5 of a 10-step run, then a fresh
+    2-process run resumes from it and finishes with the same params as an
+    uninterrupted 2-process run."""
+    ck = str(tmp_path / "ck")
+    full, _ = _run(2, str(tmp_path), "full", ["--max-iters", "10"])
+    _run(2, str(tmp_path), "part",
+         ["--max-iters", "5", "--checkpoint-every", "5",
+          "--checkpoint-dir", ck])
+    resumed, _ = _run(2, str(tmp_path), "resumed",
+                      ["--max-iters", "10", "--checkpoint-dir", ck,
+                       "--resume"])
+    assert resumed["end_step"] == 10
+    np.testing.assert_allclose(resumed["param_sq"], full["param_sq"],
+                               rtol=1e-6)
