@@ -94,7 +94,12 @@ def gather_batch(data: np.ndarray, offsets: np.ndarray,
     data = np.ascontiguousarray(data, np.int32)
     offsets = np.ascontiguousarray(offsets, np.int64)
     B = len(offsets)
-    assert offsets.max(initial=0) + T + 1 <= len(data)
+    # hard bounds check, not assert: under python -O an assert is stripped
+    # and the native path would memcpy past the end of the data buffer
+    if B and (offsets.min() < 0 or offsets.max() + T + 1 > len(data)):
+        raise ValueError(
+            f"offsets out of range: window [{int(offsets.min())}, "
+            f"{int(offsets.max()) + T + 1}) exceeds data of {len(data)}")
     lib = _load()
     if lib is not None:
         x = np.empty((B, T), np.int32)

@@ -207,13 +207,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
-    q, k, v, o, lse = residuals
+    q, k, v, o, lse = residuals  # lse: (BH, T) — see _flash_fwd_rule
     B, H, T, D = q.shape
     BH = B * H
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
                     axis=-1).reshape(BH, T)
-    # stats ride a LANES-wide trailing dim (see module docstring)
+    # stats ride a LANES-wide trailing dim (see module docstring) — but
+    # only transiently, materialized here just before the kernels; the
+    # per-layer residual that lives across the whole backward pass is the
+    # compact (BH, T) form (128x less HBM)
     delta = jnp.broadcast_to(delta[:, :, None], (BH, T, LANES))
+    lse = jnp.broadcast_to(lse[:, :, None], (BH, T, LANES))
     qf, kf, vf = (t.reshape(BH, T, D) for t in (q, k, v))
     gf = g.reshape(BH, T, D)
 
@@ -290,7 +294,11 @@ def _flash(q, k, v, scale, causal, block_q, block_k):
 
 def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+    # keep the residual compact: the kernel emits lse LANES-broadcast
+    # ((BH,T,LANES), a Mosaic tiling requirement), but storing that per
+    # layer until the backward pass wastes 128x the HBM — save (BH, T)
+    # and rebroadcast in _flash_bwd
+    return o, (q, k, v, o, lse[..., 0])
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, residuals, g):

@@ -136,6 +136,21 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                            + (" (attention-weight dropout not applied on the"
                               " seq-parallel path)" if mcfg.attn_dropout > 0
                               else ""))
+    if (mesh is not None
+            and mcfg.attention_impl in ("auto", "ring", "ulysses")
+            and attention_fn is None and blocks_fn is None):
+        # pallas_call has no GSPMD partitioning rule: inside a sharded jit
+        # program the flash kernel may fail to lower (or silently
+        # replicate) — 'auto' must not pick it when a mesh is active and
+        # no seq-parallel wrapper owns the attention. Long context on a
+        # mesh belongs to ring/Ulysses (seq axis > 1) anyway; an explicit
+        # attention_impl='flash' is honored as the user's own call.
+        import dataclasses as dc
+        prev_impl = mcfg.attention_impl
+        mcfg = dc.replace(mcfg, attention_impl="einsum")
+        logger.log(f"attention_impl {prev_impl!r} -> 'einsum': mesh run "
+                   "without a seq-parallel attention wrapper (the Pallas "
+                   "kernel has no GSPMD partitioning rule)")
     train_step = make_train_step(mcfg, tcfg, attention_fn=attention_fn,
                                  blocks_fn=blocks_fn)
     super_sharding = None

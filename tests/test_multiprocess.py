@@ -44,7 +44,12 @@ def _run(nprocs: int, outdir: str, tag: str, extra=()):
              "--out", out, *extra],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True))
-    logs = [p.communicate(timeout=300)[0] for p in procs]
+    try:
+        logs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:  # a deadlocked gloo worker must not outlive the test
+            if p.poll() is None:
+                p.kill()
     for p, l in zip(procs, logs):
         assert p.returncode == 0, f"worker rc={p.returncode}:\n{l[-4000:]}"
     with open(out) as f:

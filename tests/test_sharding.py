@@ -224,3 +224,26 @@ def test_runner_mesh_multi_step_dispatch_matches_single(tcfg):
     h2 = np.asarray([[tr, va] for _, tr, va in r2.history])
     assert h1.shape == h2.shape
     np.testing.assert_allclose(h1, h2, rtol=2e-4)
+
+
+def test_runner_gates_flash_auto_on_mesh(tcfg):
+    """'auto' must not resolve to the Pallas flash kernel inside a sharded
+    jit program (no GSPMD partitioning rule) — the runner rewrites it to
+    'einsum' on mesh runs without a seq-parallel attention wrapper."""
+    import io
+
+    from replicatinggpt_tpu.train.runner import train
+    from replicatinggpt_tpu.utils.logging import StepLogger
+
+    cfg = get_config("test-tiny")
+    cfg = cfg.replace(
+        train=dataclasses.replace(cfg.train, max_iters=2, eval_interval=0,
+                                  eval_iters=1, log_interval=0,
+                                  batch_size=8),
+        mesh=MeshConfig(data=4),
+        dataset="datasets/shakespeare.txt")
+    assert cfg.model.attention_impl == "auto"
+    stream = io.StringIO()
+    mesh = make_mesh(cfg.mesh)
+    train(cfg, mesh=mesh, logger=StepLogger(stream=stream))
+    assert "'auto' -> 'einsum'" in stream.getvalue()
