@@ -157,16 +157,9 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
             # materialization stops fitting. Only the T threshold lives
             # here; kernel-envelope and dropout fallbacks belong to
             # full_causal_attention/_pallas_supported (one source of
-            # truth — flash cannot apply attention-weight dropout, so it
-            # falls back to dense there).
+            # truth — attention-weight dropout runs in-kernel on the
+            # Pallas path, and degrades to dense einsum elsewhere).
             T = q.shape[2]
-            if T >= 1024 and train and cfg.attn_dropout > 0:
-                import warnings
-                warnings.warn(
-                    f"attention_impl='auto' at T={T}: attn_dropout>0 "
-                    "forces the dense O(T^2)-memory attention path; set "
-                    "attn_dropout=0 to train long context with the flash "
-                    "kernel")
             impl = "flash" if T >= 1024 else "einsum"
         attn = full_causal_attention(
             q, k, v, dropout_rate=cfg.attn_dropout, rng=r_attn, train=train,

@@ -40,11 +40,19 @@ def full_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                           train: bool = False,
                           impl: str = "einsum") -> jnp.ndarray:
     """Causal self-attention over a full sequence. q,k,v: (B, H, T, D)."""
-    if impl == "flash" and not (train and dropout_rate > 0.0):
-        # Flash path has no attention-weight dropout; callers fall back to
-        # einsum when training with attn dropout (semantics preserved).
-        from .flash_attention import flash_attention
-        return flash_attention(q, k, v, scale=scale, causal=True)
+    if impl == "flash":
+        from .flash_attention import flash_attention, supports_dropout
+        training_dropout = train and dropout_rate > 0.0 and rng is not None
+        if not training_dropout:
+            return flash_attention(q, k, v, scale=scale, causal=True)
+        if supports_dropout(q):
+            # in-kernel attention-weight dropout (Pallas): the dense path's
+            # _softmax_dropout semantics without the (T,T) materialization
+            return flash_attention(q, k, v, scale=scale, causal=True,
+                                   dropout_rate=dropout_rate,
+                                   dropout_rng=rng)
+        # non-Pallas backends: fall through to einsum (which can apply
+        # dropout on materialized weights) — semantics preserved
     *_, T, D = q.shape
     if scale is None:
         scale = D ** -0.5

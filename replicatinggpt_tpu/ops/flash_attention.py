@@ -34,13 +34,28 @@ def _pallas_supported(q) -> bool:
     return D in (32, 64, 128, 256) and T % 128 == 0 and T >= 128
 
 
+def supports_dropout(q) -> bool:
+    """Attention-weight dropout is implemented in the Pallas kernel only
+    (counter-based in-kernel mask); the XLA-SDPA fallback has no hook for
+    it — callers route dropout-training to the einsum path elsewhere."""
+    return _pallas_supported(q)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     scale: Optional[float] = None,
-                    causal: bool = True) -> jnp.ndarray:
+                    causal: bool = True,
+                    dropout_rate: float = 0.0,
+                    dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
     """q, k, v: (B, H, T, D). Returns (B, H, T, D)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if _pallas_supported(q):
         from .flash_pallas import pallas_flash_attention
-        return pallas_flash_attention(q, k, v, scale=scale, causal=causal)
+        return pallas_flash_attention(q, k, v, scale=scale, causal=causal,
+                                      dropout_rate=dropout_rate,
+                                      dropout_rng=dropout_rng)
+    if dropout_rate > 0.0:
+        raise ValueError(
+            "attention-weight dropout needs the Pallas kernel (TPU, "
+            "lane-aligned shapes); use the einsum path here")
     return _xla_sdpa(q, k, v, scale, causal)

@@ -44,12 +44,56 @@ def _vmem_spec(block_shape, index_map):
     return pl.BlockSpec(block_shape, index_map, **kw)
 
 
+def _smem_spec():
+    kw = {"memory_space": pltpu.SMEM} if pltpu is not None else {}
+    return pl.BlockSpec(**kw)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel dropout bits
+#
+# Counter-based hash instead of pltpu.prng_*: the mask for tile
+# (bh, q-block, k-block) must be regenerated bit-identically by three
+# different kernels (fwd, bwd-dq, bwd-dkv) whose loop structures differ,
+# and must also run under the CPU interpreter (prng_seed has no CPU
+# lowering). Two murmur3 fmix32 rounds chained over (seed^bh, qpos, kpos)
+# give full avalanche per element at a handful of VPU integer ops — noise
+# quality is plenty for dropout, and tests pin the keep-rate statistics.
+# ---------------------------------------------------------------------------
+
+def _fmix32(x: jnp.ndarray) -> jnp.ndarray:
+    # murmur3 finalizer; uint32 arithmetic wraps mod 2^32
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _dropout_mult(seed, bh, q_first, k_first, block_q, block_k, rate):
+    """(block_q, block_k) float32 tile of {0, 1/(1-rate)} — inverted
+    dropout on attention weights, deterministic in (seed, bh, q, k)."""
+    qpos = (jnp.asarray(q_first).astype(jnp.uint32)
+            + jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 0))
+    kpos = (jnp.asarray(k_first).astype(jnp.uint32)
+            + jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 1))
+    h = _fmix32(jnp.asarray(seed).astype(jnp.uint32)
+                ^ (jnp.asarray(bh).astype(jnp.uint32)
+                   * jnp.uint32(0x9E3779B9)))
+    y = _fmix32(_fmix32(h ^ qpos) ^ kpos)
+    threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
+    return jnp.where(y > threshold, jnp.float32(1.0 / (1.0 - rate)),
+                     jnp.float32(0.0))
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                seq_len, block_q, block_k):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                causal, seq_len, block_q, block_k, dropout_rate):
+    i = pl.program_id(0)
     j = pl.program_id(1)
     q = q_ref[...].astype(jnp.float32) * scale          # (bq, D)
     D = q.shape[-1]
@@ -76,9 +120,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
+        # the softmax normalizer l is dropout-free (dense-path semantics:
+        # dropout applies to the normalized weights); only the V
+        # accumulation sees the inverted-dropout multiplier
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            p_v = p * _dropout_mult(seed_ref[0], i, q_first, kb * block_k,
+                                    block_q, block_k, dropout_rate)
+        else:
+            p_v = p
         acc_new = acc * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p_v, v, preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
     acc = jnp.zeros((block_q, D), jnp.float32)
@@ -90,7 +142,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), (block_q, LANES))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd(q, k, v, seed, scale, causal, block_q, block_k,
+               dropout_rate):
     B, H, T, D = q.shape
     BH = B * H
     qf = q.reshape(BH, T, D)
@@ -98,11 +151,13 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     vf = v.reshape(BH, T, D)
     grid = (BH, T // block_q)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               seq_len=T, block_q=block_q, block_k=block_k)
+                               seq_len=T, block_q=block_q, block_k=block_k,
+                               dropout_rate=dropout_rate)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            _smem_spec(),
             _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
             _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
             _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
@@ -116,7 +171,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((BH, T, LANES), jnp.float32),
         ],
         interpret=_interpret_mode(),
-    )(qf, kf, vf)
+    )(seed, qf, kf, vf)
     return o.reshape(B, H, T, D), lse
 
 
@@ -124,8 +179,10 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, seq_len, block_q, block_k):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, scale, causal, seq_len, block_q,
+                   block_k, dropout_rate):
+    i = pl.program_id(0)
     j = pl.program_id(1)
     q = q_ref[...].astype(jnp.float32)                   # (bq, D)
     do = do_ref[...].astype(jnp.float32)
@@ -153,6 +210,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # d(softmax): ds_ij = p_ij (z_ij dp_ij - delta_i); delta (the
+            # do.o rowsum) already absorbs the dropout mask z from forward
+            dp = dp * _dropout_mult(seed_ref[0], i, q_first, kb * block_k,
+                                    block_q, block_k, dropout_rate)
         ds = p * (dp - delta) * scale
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -161,9 +223,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, seq_len, block_q,
-                    block_k):
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale, causal, seq_len,
+                    block_q, block_k, dropout_rate):
+    i = pl.program_id(0)
     kb = pl.program_id(1)
     k = k_ref[...].astype(jnp.float32)                   # (bk, D)
     v = v_ref[...].astype(jnp.float32)
@@ -187,12 +250,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if dropout_rate > 0.0:
+            # same (seed, bh, qpos, kpos) stream as the forward kernel —
+            # tile coords are absolute, so the kv-major loop regenerates
+            # the exact fwd mask
+            z = _dropout_mult(seed_ref[0], i, jb * block_q, k_first,
+                              block_q, block_k, dropout_rate)
+        else:
+            z = None
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p * z if z is not None else p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bk, D)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bq, bk)
+        if z is not None:
+            dp = dp * z
         ds = p * (dp - delta) * scale
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -206,8 +279,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
-    q, k, v, o, lse = residuals  # lse: (BH, T) — see _flash_fwd_rule
+def _flash_bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
+    q, k, v, seed, o, lse = residuals  # lse: (BH, T) — see _flash_fwd_rule
     B, H, T, D = q.shape
     BH = B * H
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
@@ -223,11 +296,12 @@ def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, seq_len=T,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(BH, T // block_q),
         in_specs=[
+            _smem_spec(),
             _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
             _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
             _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
@@ -238,15 +312,16 @@ def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
         out_specs=_vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
         interpret=_interpret_mode(),
-    )(qf, kf, vf, gf, lse, delta)
+    )(seed, qf, kf, vf, gf, lse, delta)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal, seq_len=T,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, T // block_k),
         in_specs=[
+            _smem_spec(),
             _vmem_spec((None, T, D), lambda i, j: (i, 0, 0)),
             _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
             _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
@@ -263,10 +338,10 @@ def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
         ],
         interpret=_interpret_mode(),
-    )(qf, kf, vf, gf, lse, delta)
+    )(seed, qf, kf, vf, gf, lse, delta)
 
     shape = (B, H, T, D)
-    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape), None
 
 
 # ---------------------------------------------------------------------------
@@ -286,23 +361,28 @@ def set_interpret(flag: bool) -> None:
     _INTERPRET = flag
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seed, scale, causal, block_q, block_k, dropout_rate):
+    o, _ = _flash_fwd(q, k, v, seed, scale, causal, block_q, block_k,
+                      dropout_rate)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, seed, scale, causal, block_q, block_k,
+                    dropout_rate):
+    o, lse = _flash_fwd(q, k, v, seed, scale, causal, block_q, block_k,
+                        dropout_rate)
     # keep the residual compact: the kernel emits lse LANES-broadcast
     # ((BH,T,LANES), a Mosaic tiling requirement), but storing that per
     # layer until the backward pass wastes 128x the HBM — save (BH, T)
     # and rebroadcast in _flash_bwd
-    return o, (q, k, v, o, lse[..., 0])
+    return o, (q, k, v, seed, o, lse[..., 0])
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, residuals, g):
-    return _flash_bwd(scale, causal, block_q, block_k, residuals, g)
+def _flash_bwd_rule(scale, causal, block_q, block_k, dropout_rate,
+                    residuals, g):
+    return _flash_bwd(scale, causal, block_q, block_k, dropout_rate,
+                      residuals, g)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -312,13 +392,34 @@ def pallas_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                            scale: Optional[float] = None,
                            causal: bool = True,
                            block_q: int = BLOCK,
-                           block_k: int = BLOCK) -> jnp.ndarray:
+                           block_k: int = BLOCK,
+                           dropout_rate: float = 0.0,
+                           dropout_rng: Optional[jax.Array] = None
+                           ) -> jnp.ndarray:
     """Flash attention. q,k,v: (B, H, T, D); T must be a multiple of the
-    block sizes (callers pad or fall back to the einsum path otherwise)."""
+    block sizes (callers pad or fall back to the einsum path otherwise).
+
+    ``dropout_rate`` > 0 (with ``dropout_rng``) applies inverted dropout to
+    the normalized attention weights inside the kernel — the capability the
+    dense path gets from _softmax_dropout (GPT1.py:117 semantics) without
+    materializing the (T, T) weight matrix. The mask derives from a
+    counter-based hash of (rng-derived seed, head, q-pos, k-pos), so the
+    backward kernels regenerate it exactly.
+    """
     B, H, T, D = q.shape
     if scale is None:
         scale = D ** -0.5
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     assert T % block_q == 0 and T % block_k == 0, (T, block_q, block_k)
-    return _flash(q, k, v, float(scale), bool(causal), block_q, block_k)
+    rate = float(dropout_rate)
+    if rate > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 requires dropout_rng")
+    if dropout_rng is not None and rate > 0.0:
+        seed = jax.random.randint(dropout_rng, (1,), 0, 2**31 - 1,
+                                  dtype=jnp.int32)
+    else:
+        rate = 0.0
+        seed = jnp.zeros((1,), jnp.int32)
+    return _flash(q, k, v, seed, float(scale), bool(causal), block_q,
+                  block_k, rate)

@@ -111,8 +111,90 @@ def test_auto_impl_picks_flash_at_long_T(monkeypatch):
 
     assert route_for(256) == "einsum"
     assert route_for(1024) == "flash"
-    # attention-weight dropout only exists on the dense path: gpt.py still
-    # requests flash (downstream full_causal_attention makes the fallback,
-    # one source of truth) but warns that the dense path will run
-    with pytest.warns(UserWarning, match="O\\(T\\^2\\)"):
-        assert route_for(1024, attn_dropout=0.2, train=True) == "flash"
+    # dropout training still routes to flash: the kernel applies
+    # attention-weight dropout in-kernel on TPU, and full_causal_attention
+    # degrades to einsum elsewhere (one source of truth, no warning)
+    assert route_for(1024, attn_dropout=0.2, train=True) == "flash"
+
+
+# ---------------------------------------------------------------------------
+# in-kernel attention-weight dropout (counter-based mask; interpret mode)
+# ---------------------------------------------------------------------------
+
+def test_dropout_keep_rate_statistics():
+    """q=0 makes attention weights uniform over the causal prefix; with
+    v=1 each output entry is (#kept / #allowed) / (1-rate), so the global
+    mean estimates 1 and recovers the empirical keep rate."""
+    B, H, T, D = 2, 2, 256, 32
+    rate = 0.5
+    q = jnp.zeros((B, H, T, D), jnp.float32)
+    k = jnp.zeros((B, H, T, D), jnp.float32)  # s=0 -> uniform weights
+    v = jnp.ones((B, H, T, D), jnp.float32)
+    out = pallas_flash_attention(q, k, v, causal=True,
+                                 dropout_rate=rate,
+                                 dropout_rng=jax.random.PRNGKey(42))
+    rows = np.asarray(out)[..., 0]                     # (B, H, T)
+    n_allowed = np.arange(1, T + 1, dtype=np.float64)  # causal prefix sizes
+    keeps = rows * n_allowed * (1.0 - rate)            # #kept per row
+    keep_frac = keeps.sum() / (B * H * n_allowed.sum())
+    assert abs(keep_frac - (1.0 - rate)) < 0.01, keep_frac
+    # inverted dropout is unbiased: mean output ~ dropout-off output (=1)
+    assert abs(rows.mean() - 1.0) < 0.02, rows.mean()
+
+
+def test_dropout_deterministic_in_rng():
+    q, k, v = _qkv(B=1, H=2, T=128, D=32)
+    kw = dict(causal=True, dropout_rate=0.3)
+    a = pallas_flash_attention(q, k, v, dropout_rng=jax.random.PRNGKey(7),
+                               **kw)
+    b = pallas_flash_attention(q, k, v, dropout_rng=jax.random.PRNGKey(7),
+                               **kw)
+    c = pallas_flash_attention(q, k, v, dropout_rng=jax.random.PRNGKey(8),
+                               **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-3
+
+
+def test_dropout_bwd_matches_finite_difference():
+    """The backward kernels regenerate the forward mask exactly: the
+    custom VJP of the (deterministic, fixed-seed) dropout kernel must
+    match finite differences."""
+    B, H, T, D = 1, 1, 128, 32
+    q, k, v = _qkv(B=B, H=H, T=T, D=D, seed=3)
+    w = jax.random.normal(jax.random.PRNGKey(9), (B, H, T, D))
+    rng = jax.random.PRNGKey(11)
+
+    def loss(q, k, v):
+        out = pallas_flash_attention(q, k, v, causal=True, dropout_rate=0.25,
+                                     dropout_rng=rng)
+        return jnp.sum(out * w)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    rng_dir = jax.random.split(jax.random.PRNGKey(13), 3)
+    eps = 1e-2
+    for arg, (g, rd) in enumerate(zip(grads, rng_dir)):
+        d = jax.random.normal(rd, g.shape)
+        d = d / jnp.linalg.norm(d)
+        args = [q, k, v]
+        ap = list(args); ap[arg] = args[arg] + eps * d
+        am = list(args); am[arg] = args[arg] - eps * d
+        fd = (loss(*ap) - loss(*am)) / (2 * eps)
+        ad = jnp.sum(g * d)
+        np.testing.assert_allclose(float(ad), float(fd), rtol=2e-2,
+                                   atol=2e-3)
+
+
+def test_dropout_training_routes_to_einsum_off_tpu():
+    """full_causal_attention(impl='flash') while training with dropout on a
+    backend without the Pallas kernel must silently use the einsum path
+    with identical semantics (same rng -> same mask)."""
+    if jax.default_backend() == "tpu":
+        pytest.skip("on TPU the flash path applies in-kernel dropout "
+                    "(different mask stream than the einsum path)")
+    q, k, v = _qkv(B=1, H=2, T=128, D=32)
+    rng = jax.random.PRNGKey(5)
+    a = full_causal_attention(q, k, v, dropout_rate=0.2, rng=rng,
+                              train=True, impl="flash")
+    b = full_causal_attention(q, k, v, dropout_rate=0.2, rng=rng,
+                              train=True, impl="einsum")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
