@@ -18,10 +18,14 @@ Composition: inside the shard_map region the 'seq' axis name is in scope,
 so the per-block attention core is the ring-attention local body — seq
 parallelism composes with PP natively (a 1-sized seq axis degrades to the
 plain causal core). The 'data' axis partitions microbatch rows as usual.
-The 'model' axis is *replicated* through this region in the current
-implementation (kernels are all-gathered on entry; TP-inside-PP would need
-hand-written Megatron collectives here — future work, documented
-limitation).
+The 'model' axis runs real Megatron TP inside the region (:func:`_block_tp`):
+column-parallel QKV/MLP-up, row-parallel attn-out/MLP-down with explicit
+``psum`` over 'model', biases added post-reduction. One layout wrinkle: a
+contiguous shard of the fused (C, 3C) [q|k|v] kernel's last dim crosses
+projection boundaries, so the kernel is reshaped host-side to (L, C, 3, C)
+and sharded on the per-projection dim — each device then holds the same
+head-slice of q, k, and v (heads stay whole: requires n_head % tp == 0,
+else TP falls back to replicated kernels for that run).
 
 Bubble math: utilization = M / (M + P - 1); pick microbatches >= 4*P to
 keep the bubble under ~25%.
@@ -39,15 +43,60 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..config import MeshConfig, ModelConfig
 
 
+def _block_tp(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
+              *, rng: Optional[jax.Array], train: bool, attention_fn,
+              tp_axis: str = "model") -> jnp.ndarray:
+    """Megatron tensor-parallel transformer block for shard_map regions.
+
+    Mirrors models.gpt._block, but kernels arrive as raw local shards:
+    qkv (C, 3, C/tp) column-parallel (per-projection dim pre-reshaped by
+    pipeline_blocks), mlp_up (C, 4C/tp) column-parallel, attn_out (C/tp, C)
+    and mlp_down (4C/tp, C) row-parallel with an explicit psum over
+    ``tp_axis``. Row-parallel biases are added after the reduction (adding
+    per-shard then summing would count them tp times). Activations stay
+    replicated over 'model', so dropout masks (same rng on every model
+    shard) remain consistent.
+    """
+    from ..models.gpt import (_activation, _dropout, _layer_norm,
+                              _merge_heads, _split_heads)
+
+    cd = x.dtype
+    tp = jax.lax.axis_size(tp_axis)
+    r_attn, r_drop1, r_drop2 = (jax.random.split(rng, 3)
+                                if rng is not None else (None, None, None))
+    del r_attn  # attention-weight dropout is not applied on this path
+    h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_eps)
+    C = h.shape[-1]
+    qkv_k = lp["qkv_kernel"].astype(cd)      # (C, 3, C/tp) local
+    qkv_b = lp["qkv_bias"].astype(cd)        # (3, C/tp) local
+    qkv = h @ qkv_k.reshape(C, -1) + qkv_b.reshape(-1)
+    q, k, v = jnp.split(qkv, 3, axis=-1)     # each (B, T, C/tp)
+    q, k, v = (_split_heads(t, cfg.n_head // tp) for t in (q, k, v))
+    attn = attention_fn(q, k, v)
+    attn = _merge_heads(attn)                # (B, T, C/tp): this shard's heads
+    attn = attn @ lp["attn_out_kernel"].astype(cd)        # partial (B, T, C)
+    attn = (jax.lax.psum(attn, tp_axis)
+            + lp["attn_out_bias"].astype(cd))
+    x = x + _dropout(attn, cfg.dropout, r_drop1, train)
+    h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_eps)
+    h = _activation(h @ lp["mlp_up_kernel"].astype(cd)
+                    + lp["mlp_up_bias"].astype(cd), cfg.activation)
+    h = h @ lp["mlp_down_kernel"].astype(cd)              # partial (B, T, C)
+    h = jax.lax.psum(h, tp_axis) + lp["mlp_down_bias"].astype(cd)
+    return x + _dropout(h, cfg.dropout, r_drop2, train)
+
+
 def _pp_local(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
               rng: Optional[jax.Array], *, cfg: ModelConfig, train: bool,
-              n_stages: int, axis_name: str = "pipe") -> jnp.ndarray:
+              n_stages: int, tp_sharded: bool,
+              axis_name: str = "pipe") -> jnp.ndarray:
     """Per-device pipeline schedule.
 
     x: (M, Bm, T_local, C) — all microbatches (replicated over 'pipe';
     only stage 0 reads them). blocks: local leaves with leading
-    n_layer/n_stages. Returns (M, Bm, T_local, C) finished activations
-    (identical on every stage after the final broadcast).
+    n_layer/n_stages ('model'-sharded kernels when tp_sharded). Returns
+    (M, Bm, T_local, C) finished activations (identical on every stage
+    after the final broadcast).
     """
     from ..models.gpt import _block
     from .ring_attention import _ring_local
@@ -61,7 +110,9 @@ def _pp_local(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
     if rng is not None:
         # the rng enters replicated; decorrelate dropout masks across the
         # data/seq shards (each device draws masks over its *local* shape,
-        # so an unfolded key would repeat the same mask on every shard)
+        # so an unfolded key would repeat the same mask on every shard).
+        # NOT folded over 'model': activations are replicated across model
+        # shards, so their dropout masks must agree.
         shard_id = (jax.lax.axis_index("data") * jax.lax.axis_size("seq")
                     + jax.lax.axis_index("seq"))
         rng = jax.random.fold_in(rng, shard_id)
@@ -75,8 +126,13 @@ def _pp_local(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
                 g_layer = stage * Lp + l_local
                 r = jax.random.fold_in(jax.random.fold_in(rng, g_layer),
                                        m_idx)
-            return _block(carry, lp, cfg, rng=r, train=train,
-                          attention_fn=attn_local), None
+            if tp_sharded:
+                out = _block_tp(carry, lp, cfg, rng=r, train=train,
+                                attention_fn=attn_local)
+            else:
+                out = _block(carry, lp, cfg, rng=r, train=train,
+                             attention_fn=attn_local)
+            return out, None
 
         h, _ = jax.lax.scan(body, h, (blocks, jnp.arange(Lp)))
         return h
@@ -126,13 +182,47 @@ def pipeline_blocks(x: jnp.ndarray, blocks, cfg: ModelConfig, *,
 
     xm = x.reshape(M, B // M, T, C)
     x_spec = P(None, "data", "seq", None)
-    blocks_spec = jax.tree_util.tree_map(
-        lambda leaf: P(*(("pipe",) + (None,) * (leaf.ndim - 1))), blocks)
+    tp = mesh.shape.get("model", 1)
+    tp_sharded = (tp > 1 and cfg.n_head % tp == 0 and cfg.n_embd % tp == 0
+                  and (4 * cfg.n_embd) % tp == 0)
+    if tp > 1 and not tp_sharded:
+        import warnings
+        warnings.warn(
+            f"pipeline TP disabled: n_head={cfg.n_head}/n_embd={cfg.n_embd} "
+            f"not divisible by model axis {tp}; kernels replicate through "
+            f"the pipeline region (2x+ HBM per stage, idle model-axis "
+            f"devices)")
+    if tp_sharded:
+        # keep Megatron TP live inside the region: kernels enter sharded
+        # over 'model' instead of being all-gathered. The fused [q|k|v]
+        # last dim can't be contiguously column-sharded (a 3C/tp slice
+        # crosses projection boundaries), so it is reshaped to a
+        # per-projection dim first — each shard then holds the same head
+        # slice of q, k and v.
+        L = blocks["qkv_kernel"].shape[0]
+        blocks = dict(blocks)
+        blocks["qkv_kernel"] = blocks["qkv_kernel"].reshape(L, C, 3, C)
+        blocks["qkv_bias"] = blocks["qkv_bias"].reshape(L, 3, C)
+        tp_specs = {
+            "qkv_kernel": P("pipe", None, None, "model"),
+            "qkv_bias": P("pipe", None, "model"),
+            "mlp_up_kernel": P("pipe", None, "model"),
+            "mlp_up_bias": P("pipe", "model"),
+            "attn_out_kernel": P("pipe", "model", None),
+            "mlp_down_kernel": P("pipe", "model", None),
+        }
+        blocks_spec = {
+            name: tp_specs.get(
+                name, P(*(("pipe",) + (None,) * (leaf.ndim - 1))))
+            for name, leaf in blocks.items()}
+    else:
+        blocks_spec = jax.tree_util.tree_map(
+            lambda leaf: P(*(("pipe",) + (None,) * (leaf.ndim - 1))), blocks)
     rng_spec = None if rng is None else P()
 
     fn = jax.shard_map(
         functools.partial(_pp_local, cfg=cfg, train=train,
-                          n_stages=n_stages),
+                          n_stages=n_stages, tp_sharded=tp_sharded),
         mesh=mesh,
         in_specs=(x_spec, blocks_spec, rng_spec),
         out_specs=x_spec,

@@ -99,8 +99,13 @@ def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             acc, m, l)
         return (acc, m, l, k_cur, v_cur), None
 
-    (acc, _, l, _, _), _ = jax.lax.scan(
-        step, (acc, m, l, k, v), jnp.arange(1, n))
+    if n > 1:
+        # n == 1 (e.g. a degenerate seq axis inside the pipeline region)
+        # must skip the rotation scan entirely: a zero-trip scan carries a
+        # size-0 xs array whose cotangent trips XLA sharding-override
+        # assertions under shard_map transpose — and it is dead code anyway
+        (acc, _, l, _, _), _ = jax.lax.scan(
+            step, (acc, m, l, k, v), jnp.arange(1, n))
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 

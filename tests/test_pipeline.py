@@ -26,6 +26,8 @@ def _mcfg(**kw):
     ((1, 1, 1, 4), 4),   # pure PP
     ((2, 1, 1, 4), 2),   # PP x DP
     ((1, 2, 1, 4), 4),   # PP x SP (ring attention inside the region)
+    ((1, 1, 2, 4), 4),   # PP x TP (Megatron block inside the region)
+    ((2, 1, 2, 2), 2),   # PP x TP x DP
 ])
 def test_pipeline_forward_matches_dense(axes, micro):
     data, seq, model, pipe = axes
@@ -85,3 +87,48 @@ def test_pipeline_params_sharded_by_stage():
     qkv_spec = specs["params"]["blocks"]["qkv_kernel"]
     assert qkv_spec[0] == "pipe", qkv_spec
     assert specs["params"]["wte"][0] != "pipe"
+
+
+def test_pipeline_tp_grads_match_dense():
+    """TP-inside-PP backward: psum/identity transposes through the Megatron
+    block must give the same parameter gradients as the dense stack."""
+    mcfg = _mcfg()
+    mesh_cfg = MeshConfig(data=1, seq=1, model=2, pipe=4, microbatches=4)
+    mesh = make_mesh(mesh_cfg)
+    params = init_params(jax.random.PRNGKey(0), mcfg)
+    rng = np.random.default_rng(2)
+    idx = jnp.asarray(rng.integers(0, 64, (8, 32), dtype=np.int32))
+    tgt = jnp.asarray(np.roll(np.asarray(idx), -1, axis=1))
+
+    def loss_dense(p):
+        return forward(p, idx, mcfg, targets=tgt)[1]
+
+    blocks_fn = make_pipeline_blocks_fn(mesh, mesh_cfg)
+
+    def loss_pp(p):
+        return forward(p, idx, mcfg, targets=tgt, blocks_fn=blocks_fn)[1]
+
+    gd = jax.grad(loss_dense)(params)
+    gp = jax.grad(loss_pp)(params)
+    for path_leaf, (pl_, leaf) in zip(
+            jax.tree_util.tree_flatten_with_path(gd)[0],
+            jax.tree_util.tree_flatten_with_path(gp)[0]):
+        np.testing.assert_allclose(
+            np.asarray(path_leaf[1]), np.asarray(leaf), atol=2e-4, rtol=2e-4,
+            err_msg=jax.tree_util.keystr(pl_))
+
+
+def test_pipeline_tp_falls_back_when_heads_indivisible():
+    """n_head % tp != 0: kernels replicate through the region (old
+    behavior) instead of mis-sharding heads."""
+    mcfg = _mcfg(n_head=3, n_embd=48)
+    mesh_cfg = MeshConfig(data=1, seq=1, model=2, pipe=4, microbatches=4)
+    mesh = make_mesh(mesh_cfg)
+    params = init_params(jax.random.PRNGKey(0), mcfg)
+    rng = np.random.default_rng(3)
+    idx = jnp.asarray(rng.integers(0, 64, (8, 32), dtype=np.int32))
+    want, _ = forward(params, idx, mcfg)
+    got, _ = forward(params, idx, mcfg,
+                     blocks_fn=make_pipeline_blocks_fn(mesh, mesh_cfg))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
