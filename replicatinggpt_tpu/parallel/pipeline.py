@@ -183,8 +183,7 @@ def pipeline_blocks(x: jnp.ndarray, blocks, cfg: ModelConfig, *,
     xm = x.reshape(M, B // M, T, C)
     x_spec = P(None, "data", "seq", None)
     tp = mesh.shape.get("model", 1)
-    tp_sharded = (tp > 1 and cfg.n_head % tp == 0 and cfg.n_embd % tp == 0
-                  and (4 * cfg.n_embd) % tp == 0)
+    tp_sharded = tp > 1 and cfg.n_head % tp == 0 and cfg.n_embd % tp == 0
     if tp > 1 and not tp_sharded:
         import warnings
         warnings.warn(
@@ -198,7 +197,12 @@ def pipeline_blocks(x: jnp.ndarray, blocks, cfg: ModelConfig, *,
         # last dim can't be contiguously column-sharded (a 3C/tp slice
         # crosses projection boundaries), so it is reshaped to a
         # per-projection dim first — each shard then holds the same head
-        # slice of q, k and v.
+        # slice of q, k and v. Known trade-off: the at-rest spec
+        # (mesh.py, contiguous 3C shard) differs from this region layout,
+        # so XLA reshards the QKV weights across 'model' each step —
+        # O(12 d^2/tp) per layer, small next to activations but not free;
+        # a per-projection at-rest layout would remove it at the cost of
+        # changing the checkpoint/HF-import pytree shape.
         L = blocks["qkv_kernel"].shape[0]
         blocks = dict(blocks)
         blocks["qkv_kernel"] = blocks["qkv_kernel"].reshape(L, C, 3, C)

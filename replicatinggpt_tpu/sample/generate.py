@@ -57,15 +57,21 @@ def _sample_token(rng: jax.Array, logits: jnp.ndarray,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("prompt_len", "n_new", "cfg", "gcfg"))
-def _decode_segment(params, prompt: jnp.ndarray, prompt_len: int, n_new: int,
+@partial(jax.jit, static_argnames=("n_new", "cfg", "gcfg"))
+def _decode_segment(params, prompt: jnp.ndarray, prompt_len, n_new: int,
                     rng: jax.Array, cfg: ModelConfig, gcfg: GenerateConfig
                     ) -> jnp.ndarray:
-    """One compiled prefill+decode scan: teacher-force ``prompt_len`` tokens,
-    then sample ``n_new``. Requires prompt_len + n_new <= block_size + 1."""
-    B = prompt.shape[0]
+    """One compiled prefill+decode scan: teacher-force ``prompt_len`` tokens
+    (a TRACED scalar — the prompt array may be right-padded to a bucketed
+    width, so true length does not force a recompile), then sample. Runs
+    ``P_pad - 1 + n_new`` steps and slices the ``n_new`` tokens following
+    position ``prompt_len - 1``; requires P_pad + n_new <= block_size + 1.
+    Compiled shapes are keyed on (P_pad, n_new) buckets only — see
+    ``generate`` for the bucketing policy."""
+    B, P_pad = prompt.shape
     cache = init_kv_cache(cfg, B)
-    total_steps = prompt_len - 1 + n_new
+    total_steps = P_pad - 1 + n_new
+    prompt_len = jnp.asarray(prompt_len, jnp.int32)
 
     def body(carry, step_idx):
         tok, cache, rng = carry
@@ -73,13 +79,19 @@ def _decode_segment(params, prompt: jnp.ndarray, prompt_len: int, n_new: int,
         rng, sub = jax.random.split(rng)
         sampled = _sample_token(sub, logits, gcfg)
         in_prompt = step_idx + 1 < prompt_len
-        forced = prompt[:, jnp.minimum(step_idx + 1, prompt.shape[1] - 1)]
+        forced = prompt[:, jnp.minimum(step_idx + 1, P_pad - 1)]
         next_tok = jnp.where(in_prompt, forced, sampled)
         return (next_tok, cache, rng), next_tok
 
     (_, _, _), toks = jax.lax.scan(
         body, (prompt[:, 0], cache, rng), jnp.arange(total_steps))
-    return toks.T[:, prompt_len - 1:]  # (B, n_new), generated tail only
+    # generated tail: n_new tokens starting right after the true prompt
+    return jax.lax.dynamic_slice_in_dim(toks.T, prompt_len - 1, n_new,
+                                        axis=1)
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
 
 
 def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
@@ -90,6 +102,15 @@ def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
     prompt: (B, P) int32, 1 <= P <= block_size (the reference's "zero
     context" start, GPT1.py:235, is a single 0 token). Returns
     (B, max_new_tokens) int32.
+
+    Compile stability: segment shapes are bucketed so a long sample costs
+    a fixed small set of XLA programs instead of one per segment —
+    (a) the prompt is right-padded to a power-of-two width with the true
+    length passed traced, (b) the first segment's decode count rounds up
+    to a power of two (capped by cache room), and (c) every window-refresh
+    segment uses the single shape (block_size//2, block_size//2 + 1), with
+    the final segment's surplus tokens truncated (surplus decode steps are
+    bounded by block_size//2 per sample — cheap next to a recompile).
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
@@ -97,19 +118,42 @@ def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
     assert prompt.ndim == 2 and prompt.shape[1] >= 1
     assert prompt.shape[1] <= cfg.block_size, "prompt longer than block_size"
     S = cfg.block_size
-    window = jnp.asarray(prompt)
+    B, P0 = prompt.shape
     chunks = []
     remaining = gcfg.max_new_tokens
+    if remaining <= 0:
+        return jnp.zeros((B, 0), jnp.int32)
+    # gcfg is a static jit arg of _decode_segment; normalize the length
+    # field out of it so requesting a different max_new_tokens cannot
+    # recompile the segments (only sampling params belong in the key)
+    import dataclasses as _dc
+    gcfg = _dc.replace(gcfg, max_new_tokens=0)
+
+    # first segment: bucketed prompt pad + bucketed decode count
+    P_pad = min(_pow2_at_least(P0), S)
+    padded = (prompt if P_pad == P0 else jnp.pad(
+        prompt, ((0, 0), (0, P_pad - P0))))
+    room = S - P_pad + 1
+    n1 = min(_pow2_at_least(remaining), room)
+    rng, sub = jax.random.split(rng)
+    toks = _decode_segment(params, padded, P0, n1, sub, cfg, gcfg)
+    take = min(n1, remaining)
+    chunks.append(toks[:, :take])
+    remaining -= take
+    window = jnp.concatenate([prompt, toks[:, :take]], axis=1)
+
+    # refresh segments: one fixed shape (S//2 prompt, S//2+1 new)
+    Pw, n_mid = S // 2, S // 2 + 1
     while remaining > 0:
-        P = window.shape[1]
-        n = min(remaining, S - P + 1)
-        if n <= 0:  # cache exhausted: refresh with the trailing half-window
-            window = window[:, -(S // 2):]
-            continue
+        window = window[:, -Pw:]
+        # the loop is only entered after a full first segment, which always
+        # leaves P0 + (S - P_pad + 1) > Pw true tokens — padding here would
+        # teacher-force fabricated context, so fail loudly instead
+        assert window.shape[1] == Pw, window.shape
         rng, sub = jax.random.split(rng)
-        toks = _decode_segment(params, window, P, n, sub, cfg, gcfg)
-        chunks.append(toks)
-        remaining -= n
-        if remaining > 0:
-            window = jnp.concatenate([window, toks], axis=1)[:, -(S // 2):]
+        toks = _decode_segment(params, window, Pw, n_mid, sub, cfg, gcfg)
+        take = min(n_mid, remaining)
+        chunks.append(toks[:, :take])
+        remaining -= take
+        window = jnp.concatenate([window, toks[:, :take]], axis=1)
     return jnp.concatenate(chunks, axis=1)

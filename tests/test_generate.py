@@ -94,3 +94,26 @@ def test_temperature_extremes(params):
     greedy = generate(params, prompt, CFG,
                       GenerateConfig(max_new_tokens=6, greedy=True))
     np.testing.assert_array_equal(np.asarray(cold), np.asarray(greedy))
+
+
+def test_generate_compile_stability(params):
+    """A long sample must cost a fixed small set of compiled segment
+    shapes (bucketed prompt pad + fixed refresh shape), and repeat runs
+    with different lengths/prompts within the same buckets must add NO new
+    compiles — the recompile-per-segment failure mode stays dead."""
+    cfg = CFG
+    from replicatinggpt_tpu.sample import generate
+    from replicatinggpt_tpu.sample.generate import _decode_segment
+
+    _decode_segment.clear_cache()
+    gcfg = GenerateConfig(max_new_tokens=3 * cfg.block_size, top_k=10)
+    out = generate(params, jnp.zeros((1, 1), jnp.int32), cfg, gcfg,
+                   rng=jax.random.PRNGKey(0))
+    assert out.shape == (1, 3 * cfg.block_size)
+    n_first = _decode_segment._cache_size()
+    assert n_first <= 2, n_first
+    # same buckets, different length/rng: zero fresh compiles
+    gcfg2 = GenerateConfig(max_new_tokens=3 * cfg.block_size - 17, top_k=10)
+    generate(params, jnp.zeros((1, 1), jnp.int32), cfg, gcfg2,
+             rng=jax.random.PRNGKey(1))
+    assert _decode_segment._cache_size() == n_first
