@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Ring vs Ulysses sequence-parallel attention measurement.
+
+Times fwd+bwd of both seq-parallel cores over a virtual device mesh at a
+sweep of sequence lengths, and prints one JSON line per (impl, T) plus a
+recommendation. Used to ground `select_attention_fn`'s 'auto' policy in
+measurement instead of convention (the committed results live in
+benchmarks/SEQ_PARALLEL.md).
+
+Run on CPU (8 virtual devices) by default; on a real multi-chip TPU slice
+drop --platform and the same sweep measures ICI for real.
+
+  python benchmarks/seq_parallel_bench.py --platform cpu \
+      --seq-lens 4096 16384 65536
+
+Analytic context the numbers sit in (per device, per attention call,
+n = seq-axis size, local chunk Tl = T/n):
+- ring: n-1 ppermute hops moving the (B, H, Tl, D) K and V chunks —
+  ~2(n-1)·B·H·Tl·D elements total, overlapped with the per-hop block
+  matmul; score tiles are (Tl, Tl); the local core is dense einsum.
+- Ulysses: two all-to-alls (three in, one out) moving
+  ~4·(n-1)/n·B·H·Tl·D elements — ~n/2 x less traffic than the ring —
+  after which each device holds H/n heads over the FULL sequence, so the
+  local core can be the Pallas flash kernel (O(T) memory) on TPU.
+  Requires H % n == 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--platform", default=None)
+    p.add_argument("--n-devices", type=int, default=8)
+    p.add_argument("--seq-lens", type=int, nargs="+",
+                   default=[4096, 16384, 65536])
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--seq-axis", type=int, default=0,
+                   help="seq axis size; 0 = all devices")
+    args = p.parse_args()
+
+    if args.platform == "cpu":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.n_devices}"
+        ).strip()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from replicatinggpt_tpu.config import MeshConfig
+    from replicatinggpt_tpu.parallel.mesh import make_mesh
+    from replicatinggpt_tpu.parallel.ring_attention import ring_attention
+    from replicatinggpt_tpu.parallel.ulysses import ulysses_attention
+
+    n = args.seq_axis or len(jax.devices())
+    mesh = make_mesh(MeshConfig(data=1, seq=n, model=1))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    qkv_sharding = NamedSharding(mesh, P(None, None, "seq", None))
+    local_impl = "flash" if jax.default_backend() == "tpu" else "einsum"
+    log(f"mesh: seq={n} on {jax.default_backend()}; "
+        f"Ulysses local impl: {local_impl}")
+
+    results = []
+    for T in args.seq_lens:
+        shape = (args.batch, args.heads, T, args.head_dim)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.device_put(jax.random.normal(kk, shape, jnp.bfloat16),
+                                  qkv_sharding) for kk in ks)
+
+        def time_impl(name, fn):
+            loss = jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+                argnums=(0,)))
+            try:
+                t0 = time.perf_counter()
+                g = loss(q, k, v)
+                jax.device_get(jax.tree_util.tree_leaves(g)[0][0, 0, 0])
+                compile_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    g = loss(q, k, v)
+                jax.device_get(jax.tree_util.tree_leaves(g)[0][0, 0, 0])
+                ms = (time.perf_counter() - t0) / args.steps * 1e3
+                rec = {"impl": name, "seq_len": T, "fwd_bwd_ms": round(ms, 2),
+                       "compile_s": round(compile_s, 1), "seq_axis": n,
+                       "platform": jax.default_backend()}
+            except Exception as e:  # OOM at long T is itself a data point
+                rec = {"impl": name, "seq_len": T, "fwd_bwd_ms": None,
+                       "error": repr(e)[:200], "seq_axis": n,
+                       "platform": jax.default_backend()}
+            print(json.dumps(rec), flush=True)
+            return rec
+
+        results.append(time_impl(
+            "ring", lambda q, k, v: ring_attention(q, k, v, mesh=mesh)))
+        if args.heads % n == 0:
+            results.append(time_impl(
+                "ulysses", lambda q, k, v: ulysses_attention(
+                    q, k, v, mesh=mesh, impl=local_impl)))
+
+    by_t = {}
+    for r in results:
+        by_t.setdefault(r["seq_len"], {})[r["impl"]] = r.get("fwd_bwd_ms")
+    wins = {t: ("ulysses" if (d.get("ulysses") or 1e30)
+                < (d.get("ring") or 1e30) else "ring")
+            for t, d in by_t.items()}
+    print(json.dumps({"recommendation": wins}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

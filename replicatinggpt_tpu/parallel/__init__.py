@@ -17,20 +17,31 @@ def select_attention_fn(mcfg, mesh_cfg, mesh):
     Returns None — use the local einsum/flash core, GSPMD handles any
     sharding (including gathering a seq-sharded KV) — unless the mesh
     shards the sequence axis AND the configured impl opts into an explicit
-    seq-parallel core: 'ulysses' selects the all-to-all path, 'ring'/'auto'
-    the ppermute ring. An explicit 'einsum' or 'flash' is respected as-is
-    (einsum is the only core with attention-weight dropout).
+    seq-parallel core. 'ulysses' / 'ring' select their path directly;
+    'auto' is measurement-driven (benchmarks/seq_parallel_bench.py →
+    benchmarks/SEQ_PARALLEL.md): Ulysses whenever the head count divides
+    by the seq axis — 1.7-2.2x faster fwd+bwd on the 8-way virtual mesh at
+    T∈{4k,8k}, ~n/2x less collective traffic analytically, and its local
+    core sees the full sequence so the Pallas flash kernel applies — ring
+    otherwise (no head-divisibility constraint). An explicit 'einsum' or
+    'flash' is respected as-is.
     """
     if mesh is None or mesh_cfg.seq <= 1:
         return None
-    if mcfg.attention_impl == "ulysses":
+    impl = mcfg.attention_impl
+    if impl == "auto":
+        # Ulysses shards local heads over 'seq'; heads may already be
+        # sharded over 'model' (TP), so the constraint is on local heads
+        local_heads = mcfg.n_head // max(mesh_cfg.model, 1)
+        impl = "ulysses" if local_heads % mesh_cfg.seq == 0 else "ring"
+    if impl == "ulysses":
         # inside the Ulysses region each device sees the full sequence;
         # use the flash kernel there on TPU (einsum elsewhere — the pallas
         # interpreter is too slow to be a win off-TPU)
         import jax
         local = "flash" if jax.default_backend() == "tpu" else "einsum"
         return make_ulysses_attention_fn(mesh, impl=local)
-    if mcfg.attention_impl in ("auto", "ring"):
+    if impl == "ring":
         return make_ring_attention_fn(mesh)
     return None
 

@@ -28,9 +28,10 @@ for ``models.gpt.forward`` — a ``jax.shard_map`` region over the mesh whose
 'seq' axis carries the ring. It drops into the otherwise-GSPMD training
 step; XLA stitches the sharding transitions.
 
-Note: like the flash path, the ring core has no attention-weight dropout
-(GPT1.py:117); callers training with ``attn_dropout > 0`` should disable it
-or accept the deviation (recorded in PARITY.md).
+Note: the ring core has no attention-weight dropout (GPT1.py:117); callers
+training with ``attn_dropout > 0`` should disable it or accept the
+deviation (recorded in PARITY.md). (The single-chip flash path lost this
+limitation in round 2 — it applies dropout in-kernel, flash_pallas.py.)
 """
 
 from __future__ import annotations
