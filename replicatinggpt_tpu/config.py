@@ -52,7 +52,24 @@ class ModelConfig:
     attention_impl: str = "auto"  # 'auto' | 'einsum' | 'flash' | 'ring' |
                                   # 'ulysses' (seq-parallel all-to-all)
     remat: bool = False           # jax.checkpoint each block (HBM <-> FLOPs)
-    scan_layers: bool = True      # lax.scan over stacked layer params
+    scan_layers: Optional[bool] = None
+    # lax.scan over stacked layer params. None = auto: on TPU, unroll
+    # shallow stacks (n_layer <= 16) — measured on v5e, unrolling the
+    # 6-layer char-GPT cuts step time 25.9 -> 19.7 ms (+31% throughput)
+    # because scan blocks XLA's cross-layer fusion/overlap; scan deep
+    # stacks, where compile time and code size dominate. On CPU scan
+    # always (unrolling measured strictly worse there: +60% compile AND
+    # +28% step time). Params stay stacked (L, ...) either way, so
+    # shardings/checkpoints are unaffected.
+
+    @property
+    def use_layer_scan(self) -> bool:
+        if self.scan_layers is not None:
+            return self.scan_layers
+        if self.n_layer > 16:
+            return True
+        import jax
+        return jax.default_backend() != "tpu"
 
     @property
     def head_dim(self) -> int:

@@ -195,7 +195,7 @@ def _run_blocks(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
                       attention_fn=attention_fn), None
 
     layer_ids = jnp.arange(L)
-    if cfg.scan_layers:
+    if cfg.use_layer_scan:
         x, _ = jax.lax.scan(body, x, (blocks, layer_ids))
         return x
     for i in range(L):
@@ -297,8 +297,19 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
         h = h @ lp["mlp_down_kernel"].astype(cd) + lp["mlp_down_bias"].astype(cd)
         return (h_mid + h,), (k_cache, v_cache)
 
-    (x,), (new_k, new_v) = jax.lax.scan(
-        body, (x,), (params["blocks"], cache["k"], cache["v"]))
+    if cfg.use_layer_scan:
+        (x,), (new_k, new_v) = jax.lax.scan(
+            body, (x,), (params["blocks"], cache["k"], cache["v"]))
+    else:
+        # shallow stacks: unrolled layers fuse/overlap better (same
+        # measured rationale as _run_blocks); caches restack to (L, ...)
+        ks, vs = [], []
+        for i in range(cfg.n_layer):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            (x,), (k_i, v_i) = body((x,), (lp, cache["k"][i], cache["v"][i]))
+            ks.append(k_i)
+            vs.append(v_i)
+        new_k, new_v = jnp.stack(ks), jnp.stack(vs)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                     cfg.layernorm_eps)
     head = (params["wte"].astype(cd).T if cfg.tied_head
