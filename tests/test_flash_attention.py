@@ -109,7 +109,8 @@ def test_auto_impl_picks_flash_at_long_T(monkeypatch):
         assert calls, "attention core was not invoked"
         return calls[0]
 
-    assert route_for(256) == "einsum"
+    assert route_for(128) == "einsum"
+    assert route_for(256) == "flash"  # measured crossover, v5e auto-tiles
     assert route_for(1024) == "flash"
     # dropout training still routes to flash: the kernel applies
     # attention-weight dropout in-kernel on TPU, and full_causal_attention
@@ -198,3 +199,27 @@ def test_dropout_training_routes_to_einsum_off_tpu():
     b = full_causal_attention(q, k, v, dropout_rate=0.2, rng=rng,
                               train=True, impl="einsum")
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_auto_tile_512_parity_and_grads():
+    """T=1024 auto-selects 512-wide tiles (_auto_block); the causal
+    n_kv bound, the dkv first_q skip, and the dropout tiling must hold
+    at that size, not just the 128/256 tiles the other tests use."""
+    from replicatinggpt_tpu.ops.flash_pallas import _auto_block
+    assert _auto_block(1024) == 512
+    q, k, v = _qkv(B=1, H=1, T=1024, D=64, seed=5)
+    ref = full_causal_attention(q, k, v)
+    got = pallas_flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+    gf = jax.grad(lambda q: jnp.sum(pallas_flash_attention(q, k, v) ** 2))(q)
+    gr = jax.grad(lambda q: jnp.sum(full_causal_attention(q, k, v) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), atol=5e-5,
+                               rtol=5e-5)
+    # dropout mask is position-keyed, so tile size must not change it
+    rng = jax.random.PRNGKey(3)
+    a = pallas_flash_attention(q, k, v, dropout_rate=0.3, dropout_rng=rng)
+    b = pallas_flash_attention(q, k, v, dropout_rate=0.3, dropout_rng=rng,
+                               block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5,
+                               rtol=2e-5)
