@@ -201,6 +201,96 @@ def test_dropout_training_routes_to_einsum_off_tpu():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+# ---------------------------------------------------------------------------
+# K/V-streaming kernels (VMEM-unbounded T): parity vs the resident kernels
+# ---------------------------------------------------------------------------
+
+def test_stream_causal_matches_einsum():
+    """Causal stream uses the triangular scalar-prefetch grid; block 128 at
+    T=512 exercises multi-tile rows and the init/finalize carry."""
+    q, k, v = _qkv(B=1, H=2, T=512, D=32)
+    ref = full_causal_attention(q, k, v)
+    got = pallas_flash_attention(q, k, v, causal=True, stream=True,
+                                 block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_stream_noncausal_matches_einsum():
+    q, k, v = _qkv(B=1, H=2, T=256, D=32)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, axis=-1), v)
+    got = pallas_flash_attention(q, k, v, causal=False, stream=True,
+                                 block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_stream_rectangular_causal_unequal_blocks():
+    """causal + block_q != block_k routes to the rectangular streamed grid
+    (triangular needs square tiles); its pl.when skip/finalize logic must
+    hold."""
+    q, k, v = _qkv(B=1, H=1, T=512, D=32)
+    ref = full_causal_attention(q, k, v)
+    got = pallas_flash_attention(q, k, v, causal=True, stream=True,
+                                 block_q=256, block_k=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_stream_grads_match_einsum():
+    q, k, v = _qkv(B=1, H=2, T=256, D=32)
+
+    def loss_stream(q, k, v):
+        return jnp.sum(pallas_flash_attention(q, k, v, stream=True,
+                                              block_q=128, block_k=128) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_causal_attention(q, k, v) ** 2)
+
+    gf = jax.grad(loss_stream, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5,
+                                   rtol=5e-5)
+
+
+def test_stream_dropout_matches_resident():
+    """The kernel families share their tile math and the counter-based
+    dropout mask keys off absolute positions, so streamed output must be
+    BIT-identical to the resident kernels' — fwd and grads (the module
+    docstring's bit-identity claim is asserted here)."""
+    q, k, v = _qkv(B=1, H=2, T=256, D=32)
+    rng = jax.random.PRNGKey(7)
+    kw = dict(dropout_rate=0.3, dropout_rng=rng, block_q=128, block_k=128)
+    a = pallas_flash_attention(q, k, v, stream=True, **kw)
+    b = pallas_flash_attention(q, k, v, stream=False, **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ga = jax.grad(lambda q: jnp.sum(
+        pallas_flash_attention(q, k, v, stream=True, **kw) ** 2))(q)
+    gb = jax.grad(lambda q: jnp.sum(
+        pallas_flash_attention(q, k, v, stream=False, **kw) ** 2))(q)
+    np.testing.assert_array_equal(np.asarray(ga), np.asarray(gb))
+
+
+def test_stream_auto_threshold():
+    from replicatinggpt_tpu.ops.flash_pallas import (STREAM_KV_BYTES,
+                                                     _should_stream)
+    # D=64 bf16: K+V bytes = 2*T*64*2 = 256*T -> threshold at T=16384
+    assert not _should_stream(16384, 64, 2)
+    assert _should_stream(16384 + 128, 64, 2)
+    assert _should_stream(STREAM_KV_BYTES, 1, 1)
+
+
+def test_tri_tile_map():
+    from replicatinggpt_tpu.ops.flash_pallas import _tri_tile_map
+    qm = _tri_tile_map(3, kv_major=False)
+    assert qm.tolist() == [[0, 1, 1, 2, 2, 2], [0, 0, 1, 0, 1, 2]]
+    km = _tri_tile_map(3, kv_major=True)
+    assert km.tolist() == [[0, 0, 0, 1, 1, 2], [0, 1, 2, 1, 2, 2]]
+
+
 def test_auto_tile_512_parity_and_grads():
     """T=1024 auto-selects 512-wide tiles (_auto_block); the causal
     n_kv bound, the dkv first_q skip, and the dropout tiling must hold
