@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..ops.attention import cached_attention, full_causal_attention
+from ..ops.attention import (cached_attention, full_causal_attention,
+                             uint8_inverted_dropout)
 
 Params = Dict[str, Any]
 
@@ -108,10 +109,12 @@ def _layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
 
 def _dropout(x: jnp.ndarray, rate: float, rng: Optional[jax.Array],
              train: bool) -> jnp.ndarray:
+    # Residual/MLP dropout (GPT1.py:147). uint8-bits inverted dropout,
+    # 1/256-quantized rate shared with every other dropout site — see
+    # ops.attention.quantize_dropout_rate.
     if not train or rate <= 0.0 or rng is None:
         return x
-    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
-    return jnp.where(keep, x / (1.0 - rate), 0.0)
+    return uint8_inverted_dropout(x, rate, rng)
 
 
 def _activation(x: jnp.ndarray, kind: str) -> jnp.ndarray:

@@ -90,8 +90,14 @@ def _fmix32(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _dropout_mult(seed, bh, q_first, k_first, block_q, block_k, rate):
-    """(block_q, block_k) float32 tile of {0, 1/(1-rate)} — inverted
-    dropout on attention weights, deterministic in (seed, bh, q, k)."""
+    """(block_q, block_k) float32 tile of {0, 1/(1-q)} — inverted
+    dropout on attention weights, deterministic in (seed, bh, q, k).
+
+    The rate quantizes to the same 1/256 granularity as every other
+    dropout site (ops.attention.quantize_dropout_rate), so the flash
+    path applies the identical effective rate as the einsum path the
+    'auto' router may pick instead."""
+    from .attention import quantize_dropout_rate
     qpos = (jnp.asarray(q_first).astype(jnp.uint32)
             + jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 0))
     kpos = (jnp.asarray(k_first).astype(jnp.uint32)
@@ -100,8 +106,9 @@ def _dropout_mult(seed, bh, q_first, k_first, block_q, block_k, rate):
                 ^ (jnp.asarray(bh).astype(jnp.uint32)
                    * jnp.uint32(0x9E3779B9)))
     y = _fmix32(_fmix32(h ^ qpos) ^ kpos)
-    threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
-    return jnp.where(y > threshold, jnp.float32(1.0 / (1.0 - rate)),
+    q = quantize_dropout_rate(rate)
+    threshold = jnp.uint32(int(q * 256) * 2**24)  # q * 2^32, exact
+    return jnp.where(y > threshold, jnp.float32(1.0 / (1.0 - q)),
                      jnp.float32(0.0))
 
 

@@ -73,6 +73,31 @@ def test_dropout_rng_determinism():
     np.testing.assert_array_equal(np.asarray(d), np.asarray(e))
 
 
+def test_dropout_keep_rate_and_unbiasedness():
+    """_dropout draws uint8 bits and thresholds at round(rate*256): the
+    empirical keep rate must match the quantized rate and the inverted
+    scaling must keep the estimator exactly unbiased."""
+    from replicatinggpt_tpu.models.gpt import _dropout
+
+    rate = 0.2
+    t = int(round(rate * 256))
+    q = t / 256.0
+    x = jnp.ones((512, 512), jnp.float32)
+    y = np.asarray(_dropout(x, rate, jax.random.PRNGKey(0), train=True))
+    keep_frac = (y != 0).mean()
+    assert abs(keep_frac - (1.0 - q)) < 0.005, keep_frac
+    # kept entries carry exactly the quantized inverse-keep scale
+    np.testing.assert_allclose(y[y != 0], 1.0 / (1.0 - q), rtol=1e-6)
+    assert abs(y.mean() - 1.0) < 0.01, y.mean()
+    # rate 0 / eval are identity
+    np.testing.assert_array_equal(
+        np.asarray(_dropout(x, 0.0, jax.random.PRNGKey(0), True)),
+        np.asarray(x))
+    np.testing.assert_array_equal(
+        np.asarray(_dropout(x, rate, jax.random.PRNGKey(0), False)),
+        np.asarray(x))
+
+
 def test_tied_vs_untied_head():
     tied = init_params(jax.random.PRNGKey(0), TINY)
     assert "lm_head" not in tied  # GPT-2.py:104 tying
