@@ -82,23 +82,30 @@ def cmd_train(args) -> int:
         for sig, h in prev_handlers.items():
             signal.signal(sig, h)
     if args.sample_after:
-        _sample(res.state.params, cfg, res.tokenizer, args.sample_tokens)
+        _sample(res.state.params, cfg, res.tokenizer, args.sample_tokens,
+                mesh=mesh)
     if ck:
         ck.wait()
     return 0
 
 
 def _sample(params, cfg, tokenizer, n_tokens: int, prompt_text: str = None,
-            top_k: int = 0, temperature: float = 1.0) -> None:
+            top_k: int = 0, temperature: float = 1.0, mesh=None) -> None:
     import jax.numpy as jnp
     import numpy as np
-    from .sample import GenerateConfig, generate
+    from .sample import GenerateConfig, generate, shard_for_decode
     if prompt_text:
         prompt = np.asarray([tokenizer.encode(prompt_text)], np.int32)
     else:
         # the reference's zero-context start (GPT1.py:235)
         prompt = np.zeros((1, 1), np.int32)
-    toks = generate(params, jnp.asarray(prompt), cfg.model,
+    prompt = jnp.asarray(prompt)
+    if mesh is not None:
+        # TP-sharded decode: Megatron specs over 'model', replicated over
+        # 'data' (see sample.generate.shard_for_decode)
+        params, prompt = shard_for_decode(params, prompt, cfg.model, mesh,
+                                          cfg.mesh)
+    toks = generate(params, prompt, cfg.model,
                     GenerateConfig(max_new_tokens=n_tokens, top_k=top_k,
                                    temperature=temperature))
     print(tokenizer.decode(np.asarray(toks)[0].tolist()))
@@ -128,7 +135,7 @@ def cmd_generate(args) -> int:
             state = restored
     _sample(state.params, cfg, tokenizer, args.sample_tokens,
             prompt_text=args.prompt, top_k=args.top_k,
-            temperature=args.temperature)
+            temperature=args.temperature, mesh=_build_mesh_if_needed(cfg))
     return 0
 
 

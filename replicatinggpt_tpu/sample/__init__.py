@@ -1,3 +1,3 @@
-from .generate import generate, GenerateConfig
+from .generate import generate, GenerateConfig, shard_for_decode
 
-__all__ = ["generate", "GenerateConfig"]
+__all__ = ["generate", "GenerateConfig", "shard_for_decode"]

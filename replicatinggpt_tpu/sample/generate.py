@@ -94,6 +94,41 @@ def _pow2_at_least(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
 
 
+def shard_for_decode(params, prompt: jnp.ndarray, cfg: ModelConfig,
+                     mesh, mesh_cfg):
+    """Lay out params and prompt for sharded decoding on ``mesh``.
+
+    Decode-time layout differs from training: params use the Megatron TP
+    specs over 'model' but replicate over 'data' (FSDP's gather-per-use
+    trades latency for memory in exactly the wrong direction for
+    single-token steps) and the pipe axis is ignored (no microbatching at
+    decode). The prompt batch shards over 'data' when divisible, else
+    replicates. The KV cache needs no explicit spec: it is created inside
+    the jitted segment from TP-sharded k/v projections, so GSPMD
+    propagates the head sharding to it.
+
+    The result feeds straight into ``generate`` — the same jitted
+    ``_decode_segment`` runs sharded, with XLA inserting the TP
+    collectives (psum after row-parallel projections, gather for the
+    sharded-vocab logits at the sampling step).
+    """
+    import dataclasses as _dc
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import param_pspecs
+
+    decode_cfg = _dc.replace(mesh_cfg, fsdp=False, pipe=1)
+    specs = param_pspecs(cfg, decode_cfg)
+    params = jax.device_put(params, jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs))
+    B = prompt.shape[0]
+    bspec = P("data") if B % mesh_cfg.data == 0 else P(None)
+    prompt = jax.device_put(jnp.asarray(prompt, jnp.int32),
+                            NamedSharding(mesh, P(*bspec, None)))
+    return params, prompt
+
+
 def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
              gcfg: GenerateConfig = GenerateConfig(),
              rng: Optional[jax.Array] = None) -> jnp.ndarray:
@@ -102,6 +137,10 @@ def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
     prompt: (B, P) int32, 1 <= P <= block_size (the reference's "zero
     context" start, GPT1.py:235, is a single 0 token). Returns
     (B, max_new_tokens) int32.
+
+    Sharded decoding: pass params/prompt through ``shard_for_decode``
+    first; everything below is sharding-agnostic (jit + GSPMD propagate
+    the layouts through the scan).
 
     Compile stability: segment shapes are bucketed so a long sample costs
     a fixed small set of XLA programs instead of one per segment —
