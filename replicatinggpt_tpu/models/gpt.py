@@ -147,7 +147,10 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k, v = (_split_heads(t, cfg.n_head) for t in (q, k, v))
     if attention_fn is not None:
-        attn = attention_fn(q, k, v)
+        # seq-parallel cores (ring/Ulysses) apply attention-weight
+        # dropout themselves from the per-block rng (per-device streams
+        # derived inside their shard_map regions)
+        attn = attention_fn(q, k, v, rng=r_attn, train=train)
     else:
         impl = cfg.attention_impl
         if impl in ("auto", "ring", "ulysses"):

@@ -40,9 +40,11 @@ def select_attention_fn(mcfg, mesh_cfg, mesh):
         # interpreter is too slow to be a win off-TPU)
         import jax
         local = "flash" if jax.default_backend() == "tpu" else "einsum"
-        return make_ulysses_attention_fn(mesh, impl=local)
+        return make_ulysses_attention_fn(mesh, impl=local,
+                                         dropout_rate=mcfg.attn_dropout)
     if impl == "ring":
-        return make_ring_attention_fn(mesh)
+        return make_ring_attention_fn(mesh,
+                                      dropout_rate=mcfg.attn_dropout)
     return None
 
 

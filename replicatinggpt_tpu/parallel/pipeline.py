@@ -64,7 +64,11 @@ def _block_tp(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
     tp = jax.lax.axis_size(tp_axis)
     r_attn, r_drop1, r_drop2 = (jax.random.split(rng, 3)
                                 if rng is not None else (None, None, None))
-    del r_attn  # attention-weight dropout is not applied on this path
+    if r_attn is not None:
+        # heads are sharded over 'model' here (unlike the activations,
+        # whose dropout keys must agree across model shards) — each head
+        # shard needs its own attention-mask stream
+        r_attn = jax.random.fold_in(r_attn, jax.lax.axis_index(tp_axis))
     h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_eps)
     C = h.shape[-1]
     qkv_k = lp["qkv_kernel"].astype(cd)      # (C, 3, C/tp) local
@@ -72,7 +76,7 @@ def _block_tp(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
     qkv = h @ qkv_k.reshape(C, -1) + qkv_b.reshape(-1)
     q, k, v = jnp.split(qkv, 3, axis=-1)     # each (B, T, C/tp)
     q, k, v = (_split_heads(t, cfg.n_head // tp) for t in (q, k, v))
-    attn = attention_fn(q, k, v)
+    attn = attention_fn(q, k, v, rng=r_attn, train=train)
     attn = _merge_heads(attn)                # (B, T, C/tp): this shard's heads
     attn = attn @ lp["attn_out_kernel"].astype(cd)        # partial (B, T, C)
     attn = (jax.lax.psum(attn, tp_axis)
@@ -105,7 +109,11 @@ def _pp_local(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
     M = x.shape[0]
     Lp = cfg.n_layer // n_stages
     perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
-    attn_local = functools.partial(_ring_local, axis_name="seq", scale=None)
+    # the in-scope ring core applies attention-weight dropout from the
+    # per-layer rng (pre-folded by (data, seq) shard below — the ring
+    # folds its own seq/hop/chunk indices, and _block_tp folds 'model')
+    attn_local = functools.partial(_ring_local, axis_name="seq", scale=None,
+                                   dropout_rate=cfg.attn_dropout)
 
     if rng is not None:
         # the rng enters replicated; decorrelate dropout masks across the

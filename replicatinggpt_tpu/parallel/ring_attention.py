@@ -28,10 +28,16 @@ for ``models.gpt.forward`` — a ``jax.shard_map`` region over the mesh whose
 'seq' axis carries the ring. It drops into the otherwise-GSPMD training
 step; XLA stitches the sharding transitions.
 
-Note: the ring core has no attention-weight dropout (GPT1.py:117); callers
-training with ``attn_dropout > 0`` should disable it or accept the
-deviation (recorded in PARITY.md). (The single-chip flash path lost this
-limitation in round 2 — it applies dropout in-kernel, flash_pallas.py.)
+Attention-weight dropout (GPT1.py:117) applies inside the ring with the
+framework's shared uint8/1-in-256-quantized scheme: the mask multiplies
+the unnormalized p *after* the running normalizer l accumulates it (the
+same normalized-weights semantics as the dense path and the flash
+kernel's in-kernel mask), keyed per (device, hop, q-chunk) so every
+(q, k) block — computed on exactly one device — draws an independent
+stream. Per-hop score memory is bounded by ``q_chunk``: queries process
+in chunks of at most that many rows (a lax.map, sequential), so nothing
+bigger than a (B, H, q_chunk, T_local) tile exists no matter how large
+the per-device sequence shard is.
 """
 
 from __future__ import annotations
@@ -43,45 +49,98 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import NEG_INF
+from ..ops.attention import NEG_INF, uint8_inverted_dropout
+
+# per-hop q-chunk row bound: peak score-tile memory is
+# B * H * Q_CHUNK * T_local * 4 bytes instead of B * H * T_local^2 * 4
+Q_CHUNK = 2048
 
 
 def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-                axis_name: str, scale: Optional[float]) -> jnp.ndarray:
-    """Per-device ring attention body. q/k/v: local (B, H, T_local, D)."""
+                axis_name: str, scale: Optional[float],
+                dropout_rate: float = 0.0,
+                rng: Optional[jax.Array] = None, train: bool = False,
+                q_chunk: int = Q_CHUNK) -> jnp.ndarray:
+    """Per-device ring attention body. q/k/v: local (B, H, T_local, D).
+
+    ``rng`` must already be decorrelated across every sharded axis except
+    ``axis_name`` (the ring folds in its own seq-axis index, hop and
+    q-chunk); callers whose batch/heads are sharded fold those axis
+    indices in first (ring_attention does this for the GSPMD wrapper).
+    """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, Tl, D = q.shape
     if scale is None:
         scale = D ** -0.5
+    dropping = train and dropout_rate > 0.0 and rng is not None
+    key = (jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+           if dropping else None)
+    # largest divisor of Tl that fits the chunk bound, so the per-hop
+    # score-tile guarantee holds for every shard size (not only exact
+    # multiples); trace-time loop, worst case q_chunk iterations
+    qc = next(d for d in range(min(q_chunk, Tl), 0, -1) if Tl % d == 0)
+    nc = Tl // qc
 
     qf = q.astype(jnp.float32) * scale
-    qpos = idx * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
-    perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def block_update(acc, m, l, k_cur, v_cur, src):
-        """Online-softmax accumulation of one (Tl, Tl) score block against
-        the KV chunk originating on device ``src``."""
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qf,
+    def chunk_update(q_c, acc, m, l, k_cur, v_cur, src, c_idx, hop_key):
+        """Online-softmax update of one (qc, Tl) score tile: this
+        device's q rows [c_idx*qc, ...) against the KV chunk originating
+        on device ``src``."""
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q_c,
                             k_cur.astype(jnp.float32),
                             preferred_element_type=jnp.float32)
-        kpos = src * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
+        qpos = (idx * Tl + c_idx * qc
+                + jax.lax.broadcasted_iota(jnp.int32, (qc, Tl), 0))
+        kpos = src * Tl + jax.lax.broadcasted_iota(jnp.int32, (qc, Tl), 1)
         logits = jnp.where(kpos <= qpos, logits, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
         p = jnp.exp(logits - m_new)
         alpha = jnp.exp(m - m_new)
+        # l is dropout-free (dropout applies to the normalized weights);
+        # only the V accumulation sees the inverted-dropout multiplier —
+        # flash-kernel semantics (flash_pallas._fwd_tile)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if hop_key is not None:
+            p = uint8_inverted_dropout(
+                p, dropout_rate, jax.random.fold_in(hop_key, c_idx))
         acc_new = acc * alpha + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
+
+    def block_update(acc, m, l, k_cur, v_cur, src, hop):
+        hop_key = jax.random.fold_in(key, hop) if dropping else None
+        if nc == 1:
+            return chunk_update(qf, acc, m, l, k_cur, v_cur, src,
+                                jnp.int32(0), hop_key)
+
+        def per_chunk(xs):
+            q_c, acc_c, m_c, l_c, c_idx = xs
+            return chunk_update(q_c, acc_c, m_c, l_c, k_cur, v_cur, src,
+                                c_idx, hop_key)
+
+        def split(t):  # (B, H, Tl, X) -> (nc, B, H, qc, X)
+            return jnp.moveaxis(
+                t.reshape(B, H, nc, qc, t.shape[-1]), 2, 0)
+
+        def join(t):
+            return jnp.moveaxis(t, 0, 2).reshape(B, H, Tl, t.shape[-1])
+
+        acc_n, m_n, l_n = jax.lax.map(
+            per_chunk, (split(qf), split(acc), split(m), split(l),
+                        jnp.arange(nc)))
+        return join(acc_n), join(m_n), join(l_n)
 
     # step 0 is the resident diagonal block — no rotation needed for it, and
     # peeling it keeps the scan at n-1 rotations (no dead final ppermute)
     acc0 = jnp.zeros((B, H, Tl, D), jnp.float32)
     m0 = jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
-    acc, m, l = block_update(acc0, m0, l0, k, v, idx)
+    acc, m, l = block_update(acc0, m0, l0, k, v, idx, jnp.int32(0))
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
 
     def step(carry, s):
         acc, m, l, k_cur, v_cur = carry
@@ -95,7 +154,7 @@ def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         # ring's total compute matches flash-style block skipping.
         acc, m, l = jax.lax.cond(
             src <= idx,
-            lambda a, mm, ll: block_update(a, mm, ll, k_cur, v_cur, src),
+            lambda a, mm, ll: block_update(a, mm, ll, k_cur, v_cur, src, s),
             lambda a, mm, ll: (a, mm, ll),
             acc, m, l)
         return (acc, m, l, k_cur, v_cur), None
@@ -112,24 +171,47 @@ def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    mesh: Mesh, scale: Optional[float] = None,
-                   seq_axis: str = "seq") -> jnp.ndarray:
+                   seq_axis: str = "seq", dropout_rate: float = 0.0,
+                   rng: Optional[jax.Array] = None,
+                   train: bool = False) -> jnp.ndarray:
     """Causal ring attention over a sharded sequence.
 
     q, k, v: global (B, H, T, D) with T sharded over ``seq_axis`` (and
     optionally B over 'data', H over 'model'). Returns (B, H, T, D) with the
     same sharding. T must divide evenly by the seq axis size.
+
+    With ``dropout_rate`` > 0 (and ``rng``, while ``train``), inverted
+    attention-weight dropout applies inside the ring. The replicated key
+    is decorrelated per (data, model) shard here — batch elements and
+    heads live on different devices and must not share mask streams —
+    and per (seq device, hop, q-chunk) inside ``_ring_local``.
     """
     spec = P("data", "model", seq_axis, None)
-    fn = jax.shard_map(
-        functools.partial(_ring_local, axis_name=seq_axis, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+    if not (train and dropout_rate > 0.0 and rng is not None):
+        fn = jax.shard_map(
+            functools.partial(_ring_local, axis_name=seq_axis, scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+
+    def body(q, k, v, key):
+        shard = (jax.lax.axis_index("data") * jax.lax.axis_size("model")
+                 + jax.lax.axis_index("model"))
+        return _ring_local(q, k, v, axis_name=seq_axis, scale=scale,
+                           dropout_rate=dropout_rate,
+                           rng=jax.random.fold_in(key, shard), train=True)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, P()),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v, rng)
 
 
-def make_ring_attention_fn(mesh: Mesh, scale: Optional[float] = None):
+def make_ring_attention_fn(mesh: Mesh, scale: Optional[float] = None,
+                           dropout_rate: float = 0.0):
     """attention_fn for ``models.gpt.forward`` / ``train.steps`` — plugs the
     sharded ring core into the per-block attention slot."""
-    def attention_fn(q, k, v):
-        return ring_attention(q, k, v, mesh=mesh, scale=scale)
+    def attention_fn(q, k, v, rng=None, train=False):
+        return ring_attention(q, k, v, mesh=mesh, scale=scale,
+                              dropout_rate=dropout_rate, rng=rng,
+                              train=train)
     return attention_fn

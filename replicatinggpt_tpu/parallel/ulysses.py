@@ -31,9 +31,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.attention import full_causal_attention
 
 
-def _ulysses_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-                   axis_name: str, scale: Optional[float],
-                   impl: str) -> jnp.ndarray:
+def _ulysses_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   key: Optional[jax.Array] = None, *,
+                   axis_name: str, scale: Optional[float], impl: str,
+                   dropout_rate: float = 0.0) -> jnp.ndarray:
     n = jax.lax.axis_size(axis_name)
     H = q.shape[1]
     assert H % n == 0, (
@@ -43,34 +44,55 @@ def _ulysses_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
                             split_axis=1, concat_axis=2, tiled=True)
     qh, kh, vh = a2a(q), a2a(k), a2a(v)
-    # full sequence locally -> plain causal mask is globally correct
-    out = full_causal_attention(qh, kh, vh, scale=scale, impl=impl)
+    if key is not None:
+        # every device holds a distinct (batch, head-group) after the
+        # all-to-all and emits only its own output shard, so masks
+        # decorrelate over all three sharded axes
+        shard = ((jax.lax.axis_index("data") * jax.lax.axis_size("model")
+                  + jax.lax.axis_index("model")) * n
+                 + jax.lax.axis_index(axis_name))
+        key = jax.random.fold_in(key, shard)
+    # full sequence locally -> plain causal mask is globally correct;
+    # dropout runs in the local core (in-kernel on the flash path)
+    out = full_causal_attention(qh, kh, vh, scale=scale, impl=impl,
+                                dropout_rate=dropout_rate, rng=key,
+                                train=key is not None)
     return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=2,
                               concat_axis=1, tiled=True)
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                       mesh: Mesh, scale: Optional[float] = None,
-                      seq_axis: str = "seq",
-                      impl: str = "einsum") -> jnp.ndarray:
+                      seq_axis: str = "seq", impl: str = "einsum",
+                      dropout_rate: float = 0.0,
+                      rng: Optional[jax.Array] = None,
+                      train: bool = False) -> jnp.ndarray:
     """Causal attention over a 'seq'-sharded sequence via head all-to-all.
 
     q, k, v: global (B, H, T, D), T sharded over ``seq_axis`` (B over
     'data', H over 'model'). Same contract as
-    ``ring_attention.ring_attention``.
+    ``ring_attention.ring_attention``, including in-core attention-weight
+    dropout when ``dropout_rate`` > 0 with ``rng`` while training.
     """
     spec = P("data", "model", seq_axis, None)
-    fn = jax.shard_map(
-        functools.partial(_ulysses_local, axis_name=seq_axis, scale=scale,
-                          impl=impl),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+    local = functools.partial(_ulysses_local, axis_name=seq_axis,
+                              scale=scale, impl=impl,
+                              dropout_rate=dropout_rate)
+    if not (train and dropout_rate > 0.0 and rng is not None):
+        fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+        return fn(q, k, v)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, P()),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v, rng)
 
 
 def make_ulysses_attention_fn(mesh: Mesh, scale: Optional[float] = None,
-                              impl: str = "einsum"):
+                              impl: str = "einsum",
+                              dropout_rate: float = 0.0):
     """attention_fn for ``models.gpt.forward`` / ``train.steps``."""
-    def attention_fn(q, k, v):
-        return ulysses_attention(q, k, v, mesh=mesh, scale=scale, impl=impl)
+    def attention_fn(q, k, v, rng=None, train=False):
+        return ulysses_attention(q, k, v, mesh=mesh, scale=scale, impl=impl,
+                                 dropout_rate=dropout_rate, rng=rng,
+                                 train=train)
     return attention_fn
