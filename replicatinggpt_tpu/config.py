@@ -301,6 +301,12 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches (default 2 per stage)")
     p.add_argument("--fsdp", action="store_true", default=None)
+    p.add_argument("--lr-schedule", default=None,
+                   choices=["constant", "cosine"])
+    p.add_argument("--warmup-iters", type=int, default=None)
+    p.add_argument("--min-lr", type=float, default=None)
+    p.add_argument("--grad-clip", type=float, default=None)
+    p.add_argument("--log-interval", type=int, default=None)
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--dataset", default=None)
 
@@ -321,6 +327,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
         ("max_iters", args.max_iters), ("eval_interval", args.eval_interval),
         ("eval_iters", args.eval_iters), ("seed", args.seed),
         ("steps_per_dispatch", args.steps_per_dispatch),
+        ("lr_schedule", args.lr_schedule),
+        ("warmup_iters", args.warmup_iters), ("min_lr", args.min_lr),
+        ("grad_clip", args.grad_clip), ("log_interval", args.log_interval),
     ) if v is not None}
     meshk = {k: v for k, v in (
         ("data", args.dp), ("seq", args.sp), ("model", args.tp),

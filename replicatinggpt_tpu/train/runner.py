@@ -34,6 +34,24 @@ class TrainResult:
     tokens_per_sec_per_chip: float
 
 
+def _make_lr_reader(tcfg):
+    """step -> learning rate for the log line, or None when the schedule
+    is a bare constant with no warmup (the reference's fixed-lr loop,
+    GPT1.py:218 — an lr column there would be noise). Any real schedule
+    (cosine, or constant with warmup) logs its current value. Built once
+    per run: the schedule closure is reconstructed here, not per log
+    boundary."""
+    from .state import lr_schedule_fn
+    sched = lr_schedule_fn(tcfg)
+    if not callable(sched):
+        return lambda step: None
+    return lambda step: float(sched(step))
+
+
+def _current_lr(tcfg, step: int) -> Optional[float]:
+    return _make_lr_reader(tcfg)(step)
+
+
 def _resolve_vocab(cfg: Config, tokenizer) -> Config:
     """Make model vocab consistent with the tokenizer (fixes SURVEY.md
     §8-B1/B5, where reference vocab/tokenizer mismatches crashed training).
@@ -324,6 +342,7 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                    "checkpoint_every; no agreed boundary to stop at)")
 
     tokens_since_log = 0
+    lr_at = _make_lr_reader(tcfg)
     stopped_early = False
     try:
         it = start_step
@@ -365,7 +384,7 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                     loss_b = (losses_arr if chunk == 1
                               else losses_arr[b - prev_it - 1])
                     logger.log_step(b - 1, float(loss_b), tokens_since_log,
-                                    n_chips)
+                                    n_chips, lr=lr_at(b - 1))
                     tokens_since_log = 0
             if (checkpoint_manager is not None and tcfg.checkpoint_every
                     and it % tcfg.checkpoint_every == 0):
