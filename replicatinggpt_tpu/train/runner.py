@@ -48,10 +48,6 @@ def _make_lr_reader(tcfg):
     return lambda step: float(sched(step))
 
 
-def _current_lr(tcfg, step: int) -> Optional[float]:
-    return _make_lr_reader(tcfg)(step)
-
-
 def _resolve_vocab(cfg: Config, tokenizer) -> Config:
     """Make model vocab consistent with the tokenizer (fixes SURVEY.md
     §8-B1/B5, where reference vocab/tokenizer mismatches crashed training).

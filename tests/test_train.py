@@ -87,9 +87,9 @@ def test_lr_schedule_warmup_cosine():
     assert float(sched(100)) < 1e-3 / 2
     # the runner's log-line helper surfaces the scheduled value (and stays
     # None for the reference-style constant-lr loop)
-    from replicatinggpt_tpu.train.runner import _current_lr
-    assert _current_lr(t, 10) == pytest.approx(1e-3)
-    assert _current_lr(get_config("test-tiny").train, 10) is None
+    from replicatinggpt_tpu.train.runner import _make_lr_reader
+    assert _make_lr_reader(t)(10) == pytest.approx(1e-3)
+    assert _make_lr_reader(get_config("test-tiny").train)(10) is None
 
 
 def test_train_scan_matches_single_steps(tiny):
