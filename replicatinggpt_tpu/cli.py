@@ -15,7 +15,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .config import add_config_flags, config_from_args, get_config
+from .config import add_config_flags, config_from_args
 
 
 def _build_mesh_if_needed(cfg):

@@ -16,7 +16,7 @@ parameter layout, and can be device_put with TP/FSDP shardings at load time.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Mapping, Optional, Tuple
+from typing import Any, Dict, Mapping, Tuple
 
 import numpy as np
 

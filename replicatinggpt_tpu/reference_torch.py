@@ -18,7 +18,6 @@ Weights are stored in the same (in, out) kernel layout as the JAX pytree
 
 from __future__ import annotations
 
-import math
 import time
 from typing import Dict, Optional, Tuple
 

@@ -11,7 +11,7 @@ batches per split) but compiled, so the 400-forwards-per-eval cost
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Dict, Iterable, Tuple
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
