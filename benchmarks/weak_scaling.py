@@ -17,7 +17,9 @@ and `n` must not exceed `jax.device_count()`.
 
 Usage:
     python benchmarks/weak_scaling.py --devices 1,2,4,8 --platform cpu
-    python benchmarks/weak_scaling.py --devices 4 --steps 30   # on TPU
+    python benchmarks/weak_scaling.py --devices 4 --steps 30 --platform ''
+    # (--platform '' = real devices; the default 'cpu' forces the
+    #  virtual mesh even on a TPU VM)
 
 Prints one JSON line: {"metric": "weak_scaling_efficiency", ...} with
 the per-n table embedded.
@@ -97,7 +99,9 @@ def main() -> None:
     args = p.parse_args()
 
     rows = []
-    for n in [int(x) for x in args.devices.split(",")]:
+    skipped = []
+    requested = [int(x) for x in args.devices.split(",")]
+    for n in requested:
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _CHILD, str(n), args.platform,
@@ -109,10 +113,12 @@ def main() -> None:
         except subprocess.TimeoutExpired:
             print(f"n={n} timed out after {args.timeout:.0f}s; skipping",
                   file=sys.stderr)
+            skipped.append(n)
             continue
         if r.returncode != 0:
             print(f"n={n} failed:\n{r.stderr.strip()[-800:]}",
                   file=sys.stderr)
+            skipped.append(n)
             continue
         row = json.loads(r.stdout.strip().splitlines()[-1])
         rows.append(row)
@@ -133,6 +139,11 @@ def main() -> None:
         "platform": args.platform or "default",
         "table": rows,
     }
+    if skipped:
+        # the efficiency above is normalized against the smallest size
+        # that RAN; make missing sizes impossible to miss in the artifact
+        out["skipped_sizes"] = skipped
+        out["requested_sizes"] = requested
     if args.platform == "cpu":
         # n virtual devices timeshare one host's cores, so per-chip
         # throughput divides by ~n — the efficiency number here validates

@@ -981,6 +981,308 @@ def _auto_block(T: int) -> int:
     return BLOCK
 
 
+# ---------------------------------------------------------------------------
+# chunk attention: (o, lse) with global-position offsets — the ring
+# attention hop core (parallel/ring_attention.py)
+#
+# One (q-chunk, kv-chunk) block attention where q holds global positions
+# [q_offset, q_offset+Tq) and k/v [k_offset, k_offset+Tk). Returns the
+# chunk-local softmax output AND its logsumexp, both differentiable, so
+# callers can merge chunks with the online-softmax recurrence in plain
+# JAX (the VJP of the merge needs d(lse), hence the custom rule below).
+#
+# Backward trick: for o = softmax(s) @ v and lse = logsumexp(s),
+# upstream (do, dlse) gives ds = p * (dot(do, v) - delta + dlse) with
+# delta = rowsum(do * o) — i.e. exactly the standard flash backward with
+# delta replaced by (delta - dlse). The existing dq/dkv kernels are
+# reused unmodified with that substitution.
+# ---------------------------------------------------------------------------
+
+
+def _flash_prologue(D, scale, dropout_rate, dropout_rng):
+    """Shared entry prologue: head-dim scale default, dropout validation,
+    and the in-kernel seed derivation — one source of truth for every
+    flash entry point (single-chip attention and the ring chunk op)."""
+    if scale is None:
+        scale = D ** -0.5
+    rate = float(dropout_rate)
+    if rate > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 requires dropout_rng")
+    if dropout_rng is not None and rate > 0.0:
+        seed = jax.random.randint(dropout_rng, (1,), 0, 2**31 - 1,
+                                  dtype=jnp.int32)
+    else:
+        rate = 0.0
+        seed = jnp.zeros((1,), jnp.int32)
+    return float(scale), rate, seed
+
+
+def _block_for(T, override):
+    b = min(override if override is not None else _auto_block(T), T)
+    assert T % b == 0, (T, b)
+    return b
+
+
+def pallas_flash_chunk(q, k, v, *, scale=None, causal=True,
+                       q_offset=0, k_offset=0,
+                       block_q=None, block_k=None,
+                       dropout_rate: float = 0.0,
+                       dropout_rng=None, bh_offset=0):
+    """Chunk attention with stats: returns (o, lse).
+
+    q: (B, H, Tq, D); k, v: (B, H, Tk, D). Causal masking compares
+    global positions (q_offset + row) >= (k_offset + col); the offsets
+    may be Python ints or traced int32 scalars (e.g. derived from
+    ``jax.lax.axis_index`` in a ring), so one compiled kernel serves
+    every hop. lse is (B, H, Tq) float32 (logsumexp over this chunk's
+    keys only; -inf rows are possible when causal masks an entire row —
+    callers merging chunks handle that in the recurrence).
+    Differentiable in q, k, v including through lse. ``bh_offset``
+    decorrelates the in-kernel dropout stream when the (batch, head)
+    dims are themselves shards of a larger array.
+    """
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale, rate, seed = _flash_prologue(D, scale, dropout_rate, dropout_rng)
+    block_q = _block_for(Tq, block_q)
+    block_k = _block_for(Tk, block_k)
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(k_offset, jnp.int32),
+                      jnp.asarray(bh_offset, jnp.int32)])
+    o, lse = _flash_chunk(q, k, v, seed, offs, scale, bool(causal),
+                          block_q, block_k, rate)
+    return o, lse
+
+
+def _chunk_fwd_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
+                      lse_ref, *, scale, causal, seq_len_k, block_q,
+                      block_k, dropout_rate):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * scale
+    D = q.shape[-1]
+    q_first = off_ref[0] + j * block_q
+    n_kv = seq_len_k // block_k
+    if causal:
+        # skip fully-masked kv tiles: tile kb contributes iff its first
+        # key position <= this q block's last position (dynamic bound —
+        # the offsets live in SMEM). Negative/zero bounds make the loop
+        # a no-op (fully masked hop; lse stays -inf).
+        n_kv = jnp.clip(
+            (q_first + block_q - 1 - off_ref[1]) // block_k + 1, 0, n_kv)
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        return _fwd_tile(q, k, v, acc, m, l, causal=causal,
+                         q_first=q_first,
+                         k_first=off_ref[1] + kb * block_k,
+                         block_q=block_q, block_k=block_k,
+                         seed=seed_ref[0], bh=off_ref[2] + i,
+                         dropout_rate=dropout_rate)
+
+    acc = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_kv, body, (acc, m0, l0))
+    lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)),
+                    NEG_INF)
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[...] = jnp.broadcast_to(lse, (block_q, LANES))
+
+
+def _chunk_bwd_dq_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
+                         lse_ref, deltap_ref, dq_ref, *, scale, causal,
+                         seq_len_k, block_q, block_k, dropout_rate):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, :1]
+    deltap = deltap_ref[...][:, :1]
+    q_first = off_ref[0] + j * block_q
+    n_kv = seq_len_k // block_k
+    if causal:
+        n_kv = jnp.clip(
+            (q_first + block_q - 1 - off_ref[1]) // block_k + 1, 0, n_kv)
+
+    def body(kb, dq):
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        return dq + _dq_tile(q, k, v, do, lse, deltap, scale=scale,
+                             causal=causal, q_first=q_first,
+                             k_first=off_ref[1] + kb * block_k,
+                             block_q=block_q, block_k=block_k,
+                             seed=seed_ref[0], bh=off_ref[2] + i,
+                             dropout_rate=dropout_rate)
+
+    dq_ref[...] = jax.lax.fori_loop(0, n_kv, body,
+                                    jnp.zeros_like(q)).astype(dq_ref.dtype)
+
+
+def _chunk_bwd_dkv_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
+                          lse_ref, deltap_ref, dk_ref, dv_ref, *, scale,
+                          causal, seq_len_q, block_q, block_k,
+                          dropout_rate):
+    i = pl.program_id(0)
+    kb = pl.program_id(1)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    k_first = off_ref[1] + kb * block_k
+    n_q = seq_len_q // block_q
+    if causal:
+        # first q tile whose last row can see this kv tile's first key
+        jb0 = jnp.clip((k_first - off_ref[0]) // block_q, 0, n_q)
+    else:
+        jb0 = 0
+
+    def body(jb, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(jb * block_q, block_q), :][:, :1]
+        deltap = deltap_ref[pl.ds(jb * block_q, block_q), :][:, :1]
+        dk_c, dv_c = _dkv_tile(q, k, v, do, lse, deltap, scale=scale,
+                               causal=causal,
+                               q_first=off_ref[0] + jb * block_q,
+                               k_first=k_first,
+                               block_q=block_q, block_k=block_k,
+                               seed=seed_ref[0], bh=off_ref[2] + i,
+                               dropout_rate=dropout_rate)
+        return dk + dk_c, dv + dv_c
+
+    dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(jb0, n_q, body, (dk0, jnp.zeros_like(dk0)))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _chunk_fwd(q, k, v, seed, offs, scale, causal, block_q, block_k,
+               dropout_rate):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    BH = B * H
+    qf = q.reshape(BH, Tq, D)
+    kf = k.reshape(BH, Tk, D)
+    vf = v.reshape(BH, Tk, D)
+    kernel = functools.partial(
+        _chunk_fwd_kernel, scale=scale, causal=causal, seq_len_k=Tk,
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, Tq // block_q),
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, Tk, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, Tk, D), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_q, LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tq, LANES), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+    )(seed, offs, qf, kf, vf)
+    return o.reshape(B, H, Tq, D), lse[..., 0].reshape(B, H, Tq)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_chunk(q, k, v, seed, offs, scale, causal, block_q, block_k,
+                 dropout_rate):
+    return _chunk_fwd(q, k, v, seed, offs, scale, causal, block_q, block_k,
+                      dropout_rate)
+
+
+def _flash_chunk_fwd_rule(q, k, v, seed, offs, scale, causal, block_q,
+                          block_k, dropout_rate):
+    o, lse = _chunk_fwd(q, k, v, seed, offs, scale, causal, block_q,
+                        block_k, dropout_rate)
+    return (o, lse), (q, k, v, seed, offs, o, lse)
+
+
+def _flash_chunk_bwd_rule(scale, causal, block_q, block_k, dropout_rate,
+                          residuals, g):
+    q, k, v, seed, offs, o, lse = residuals
+    do, dlse = g
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    BH = B * H
+    # delta' = rowsum(do * o) - dlse: folds the lse cotangent into the
+    # standard flash backward (ds = p * (dp - delta'))
+    deltap = (jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                      axis=-1) - dlse.astype(jnp.float32)).reshape(BH, Tq)
+    # rows fully masked in this chunk have lse = -inf and p = exp(s - lse)
+    # would be inf * 0; clamp lse for the recompute (p rows are all-masked
+    # anyway, so any finite value yields p = exp(NEG_INF - c) = 0)
+    lse_c = jnp.maximum(lse, NEG_INF / 2).reshape(BH, Tq)
+    deltap = jnp.broadcast_to(deltap[:, :, None], (BH, Tq, LANES))
+    lse_b = jnp.broadcast_to(lse_c[:, :, None], (BH, Tq, LANES))
+    qf = q.reshape(BH, Tq, D)
+    kf = k.reshape(BH, Tk, D)
+    vf = v.reshape(BH, Tk, D)
+    gf = do.reshape(BH, Tq, D)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _chunk_bwd_dq_kernel, scale=scale, causal=causal, seq_len_k=Tk,
+            block_q=block_q, block_k=block_k, dropout_rate=dropout_rate),
+        grid=(BH, Tq // block_q),
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, Tk, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, Tk, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_q, LANES), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_q, LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=_vmem_spec((None, block_q, D), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        interpret=_interpret_mode(),
+    )(seed, offs, qf, kf, vf, gf, lse_b, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _chunk_bwd_dkv_kernel, scale=scale, causal=causal, seq_len_q=Tq,
+            block_q=block_q, block_k=block_k, dropout_rate=dropout_rate),
+        grid=(BH, Tk // block_k),
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            _vmem_spec((None, Tq, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, Tq, D), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, Tq, LANES), lambda i, j: (i, 0, 0)),
+            _vmem_spec((None, Tq, LANES), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), q.dtype),
+        ],
+        interpret=_interpret_mode(),
+    )(seed, offs, qf, kf, vf, gf, lse_b, deltap)
+
+    shape_q = (B, H, Tq, D)
+    shape_k = (B, H, Tk, D)
+    return (dq.reshape(shape_q), dk.reshape(shape_k), dv.reshape(shape_k),
+            None, None)
+
+
+_flash_chunk.defvjp(_flash_chunk_fwd_rule, _flash_chunk_bwd_rule)
+
+
 # above this many K+V bytes per (batch, head), stream K/V blockwise
 # instead of holding them resident in VMEM. Measured on v5e (D=64 bf16,
 # fwd+bwd): resident wins while it compiles (59 ms vs tri-stream 75 at
@@ -1017,20 +1319,9 @@ def pallas_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     sequence length bounded by HBM only). None = auto by K/V footprint.
     """
     B, H, T, D = q.shape
-    if scale is None:
-        scale = D ** -0.5
-    block_q = min(block_q if block_q is not None else _auto_block(T), T)
-    block_k = min(block_k if block_k is not None else _auto_block(T), T)
-    assert T % block_q == 0 and T % block_k == 0, (T, block_q, block_k)
-    rate = float(dropout_rate)
-    if rate > 0.0 and dropout_rng is None:
-        raise ValueError("dropout_rate > 0 requires dropout_rng")
-    if dropout_rng is not None and rate > 0.0:
-        seed = jax.random.randint(dropout_rng, (1,), 0, 2**31 - 1,
-                                  dtype=jnp.int32)
-    else:
-        rate = 0.0
-        seed = jnp.zeros((1,), jnp.int32)
+    scale, rate, seed = _flash_prologue(D, scale, dropout_rate, dropout_rng)
+    block_q = _block_for(T, block_q)
+    block_k = _block_for(T, block_k)
     if stream is None:
         stream = _should_stream(T, D, jnp.dtype(q.dtype).itemsize)
     if pltpu is None:
@@ -1039,5 +1330,5 @@ def pallas_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         # run everywhere via interpret mode
         stream = False
     fn = _flash_stream if stream else _flash
-    return fn(q, k, v, seed, float(scale), bool(causal), block_q,
+    return fn(q, k, v, seed, scale, bool(causal), block_q,
               block_k, rate)

@@ -56,17 +56,108 @@ from ..ops.attention import NEG_INF, uint8_inverted_dropout
 Q_CHUNK = 2048
 
 
+def _flash_hop_supported(Tl: int, D: int, itemsize: int) -> bool:
+    """Envelope for running ring hops through the Pallas chunk kernel
+    (mirrors ops.flash_attention._pallas_supported: TPU backend,
+    lane-aligned shapes). The chunk kernel holds one (batch, head)'s
+    full K/V shard resident in VMEM — no streaming variant — so shards
+    past the measured resident-compile bound (flash_pallas.
+    STREAM_KV_BYTES) fall back to the q-chunked einsum body, which has
+    no such limit."""
+    from ..ops.flash_pallas import STREAM_KV_BYTES
+
+    return (jax.default_backend() == "tpu" and D in (32, 64, 128, 256)
+            and Tl % 128 == 0 and Tl >= 128
+            and 2 * Tl * D * itemsize <= STREAM_KV_BYTES)
+
+
+def _ring_local_flash(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                      axis_name: str, scale: Optional[float],
+                      dropout_rate: float = 0.0,
+                      rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Ring body with Pallas chunk-attention hops.
+
+    Each hop is one fused (o, lse) kernel call
+    (ops.flash_pallas.pallas_flash_chunk) with global-position causal
+    masking and in-kernel dropout; hops merge by the logsumexp
+    recurrence in plain JAX, so the whole ring is differentiable through
+    the kernels' custom VJPs. Per-hop HBM is O(B*H*Tl*D) — no (Tl, Tl)
+    score materialization at all (vs the einsum body's q-chunked tiles).
+    The kernel holds one (batch, head)'s K/V chunk resident in VMEM, so
+    per-device shards are bounded like the resident single-chip kernel
+    (~32k rows at D=64 bf16) — far above practical ring shard sizes.
+
+    Dropout: the kernel's counter-hash mask keys on absolute (seed,
+    program bh, q position, k position); positions are global here and
+    every (q, k) pair is computed on exactly one device/hop, while
+    ``rng`` arrives pre-folded per (data, model) shard, so streams never
+    collide.
+    """
+    from ..ops.flash_pallas import pallas_flash_chunk
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    q_off = idx * Tl
+
+    def hop_attn(k_cur, v_cur, src):
+        return pallas_flash_chunk(q, k_cur, v_cur, scale=scale, causal=True,
+                                  q_offset=q_off, k_offset=src * Tl,
+                                  dropout_rate=dropout_rate,
+                                  dropout_rng=rng)
+
+    def merge(o_acc, lse_acc, o_s, lse_s):
+        # both lse's are finite on every executed hop: the diagonal hop's
+        # rows attend at least themselves, earlier-chunk hops are fully
+        # unmasked, and future chunks never execute (cond below)
+        m = jnp.maximum(lse_acc, lse_s)
+        w1 = jnp.exp(lse_acc - m)
+        w2 = jnp.exp(lse_s - m)
+        denom = w1 + w2
+        o = (o_acc * w1[..., None] + o_s.astype(jnp.float32) * w2[..., None]
+             ) / denom[..., None]
+        return o, m + jnp.log(denom)
+
+    o_acc, lse_acc = hop_attn(k, v, idx)  # resident diagonal block
+    o_acc = o_acc.astype(jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, s):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (idx - s) % n
+
+        def do_hop(o_a, lse_a):
+            o_s, lse_s = hop_attn(k_cur, v_cur, src)
+            return merge(o_a, lse_a, o_s, lse_s)
+
+        o_acc, lse_acc = jax.lax.cond(src <= idx, do_hop,
+                                      lambda a, b: (a, b), o_acc, lse_acc)
+        return (o_acc, lse_acc, k_cur, v_cur), None
+
+    if n > 1:
+        (o_acc, _, _, _), _ = jax.lax.scan(
+            step, (o_acc, lse_acc, k, v), jnp.arange(1, n))
+    return o_acc.astype(q.dtype)
+
+
 def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                 axis_name: str, scale: Optional[float],
                 dropout_rate: float = 0.0,
                 rng: Optional[jax.Array] = None, train: bool = False,
-                q_chunk: int = Q_CHUNK) -> jnp.ndarray:
+                q_chunk: int = Q_CHUNK,
+                hop_impl: str = "auto") -> jnp.ndarray:
     """Per-device ring attention body. q/k/v: local (B, H, T_local, D).
 
     ``rng`` must already be decorrelated across every sharded axis except
     ``axis_name`` (the ring folds in its own seq-axis index, hop and
     q-chunk); callers whose batch/heads are sharded fold those axis
     indices in first (ring_attention does this for the GSPMD wrapper).
+
+    ``hop_impl``: 'einsum' (q-chunked XLA tiles, runs everywhere),
+    'flash' (Pallas chunk kernel per hop — _ring_local_flash), or 'auto'
+    (flash on TPU when the shape fits the kernel envelope).
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -74,6 +165,13 @@ def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     if scale is None:
         scale = D ** -0.5
     dropping = train and dropout_rate > 0.0 and rng is not None
+    if hop_impl == "flash" or (
+            hop_impl == "auto"
+            and _flash_hop_supported(Tl, D, jnp.dtype(q.dtype).itemsize)):
+        return _ring_local_flash(q, k, v, axis_name=axis_name, scale=scale,
+                                 dropout_rate=dropout_rate if dropping
+                                 else 0.0,
+                                 rng=rng if dropping else None)
     key = (jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
            if dropping else None)
     # largest divisor of Tl that fits the chunk bound, so the per-hop

@@ -221,6 +221,67 @@ def test_ring_q_chunking_matches_unchunked():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+# ---------------------------------------------------------------------------
+# Pallas chunk-kernel ring hops (hop_impl='flash'; interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+
+def _ring_fn(mesh, **kw):
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from replicatinggpt_tpu.parallel.ring_attention import _ring_local
+
+    spec = P("data", "model", "seq", None)
+    return jax.shard_map(
+        functools.partial(_ring_local, axis_name="seq", scale=None, **kw),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+
+
+def test_ring_flash_hops_match_einsum_hops():
+    """hop_impl='flash' routes hops through the Pallas chunk kernel with
+    lse-merged accumulation; output and grads must match the einsum ring
+    (and therefore the dense core)."""
+    mesh, _ = _mesh(1, 4, 1)
+    q, k, v = _qkv(T=512, D=32)  # T_local=128, kernel-eligible
+    want = np.asarray(_ring_fn(mesh)(q, k, v))
+    got = np.asarray(_ring_fn(mesh, hop_impl="flash")(q, k, v))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    ge = jax.grad(lambda q, k, v: loss(_ring_fn(mesh), q, k, v),
+                  argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(
+        lambda q, k, v: loss(_ring_fn(mesh, hop_impl="flash"), q, k, v),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ring_flash_hop_dropout_statistics():
+    """In-kernel dropout on the flash hops: uniform-weights construction
+    recovers the quantized keep rate; deterministic in rng."""
+    mesh, _ = _mesh(1, 2, 1)
+    B, H, T, D = 1, 2, 256, 32
+    rate, rate_q = 0.5, 128 / 256
+    q = jnp.zeros((B, H, T, D), jnp.float32)
+    v = jnp.ones((B, H, T, D), jnp.float32)
+    fn = _ring_fn(mesh, hop_impl="flash", dropout_rate=rate,
+                  rng=jax.random.PRNGKey(42), train=True)
+    out = fn(q, q, v)
+    rows = np.asarray(out)[..., 0]
+    n_allowed = np.arange(1, T + 1, dtype=np.float64)
+    keeps = rows * n_allowed * (1.0 - rate_q)
+    keep_frac = keeps.sum() / (B * H * n_allowed.sum())
+    assert abs(keep_frac - (1.0 - rate_q)) < 0.03, keep_frac
+    assert abs(rows.mean() - 1.0) < 0.04, rows.mean()
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(fn(q, q, v)))
+
+
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_train_step_with_sequence_parallelism(impl):
     """Full sharded train step, seq axis 2: loss finite and close to the
