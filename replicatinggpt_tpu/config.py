@@ -142,6 +142,12 @@ class TrainConfig:
     steps_per_dispatch: int = 1      # >1: lax.scan K optimizer steps per
                                      # dispatch (amortizes host->device
                                      # round trips; loss curve unchanged)
+    grad_accum_steps: int = 1        # >1: each optimizer step averages
+                                     # grads over this many batch_size
+                                     # microbatches (effective batch =
+                                     # grad_accum_steps * batch_size) via an
+                                     # on-device lax.scan — big global
+                                     # batches without the activation memory
     seed: int = 1337                 # GPT1.py:10
     sampling: str = "random"         # 'random' (GPT1.py:75-83) |
                                      # 'sequential' (GPT-2.py:200-213)
@@ -293,6 +299,9 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--steps-per-dispatch", type=int, default=None,
                    help="lax.scan K optimizer steps per device dispatch")
+    p.add_argument("--grad-accum-steps", type=int, default=None,
+                   help="microbatches averaged per optimizer step "
+                        "(effective batch = this * batch-size)")
     # mesh overrides
     p.add_argument("--dp", type=int, default=None, help="mesh data axis size")
     p.add_argument("--sp", type=int, default=None, help="mesh seq axis size")
@@ -327,6 +336,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         ("max_iters", args.max_iters), ("eval_interval", args.eval_interval),
         ("eval_iters", args.eval_iters), ("seed", args.seed),
         ("steps_per_dispatch", args.steps_per_dispatch),
+        ("grad_accum_steps", args.grad_accum_steps),
         ("lr_schedule", args.lr_schedule),
         ("warmup_iters", args.warmup_iters), ("min_lr", args.min_lr),
         ("grad_clip", args.grad_clip), ("log_interval", args.log_interval),

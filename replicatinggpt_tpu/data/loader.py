@@ -121,7 +121,10 @@ def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2,
     (K, B, T) superbatches (multi-step dispatch) routes 3-d items to
     ``superbatch_sharding`` (P(None,'data','seq')) — required whenever
     ``sharding`` is set and 3-d items appear, so the scan path never drops
-    the batch sharding.
+    the batch sharding. Higher-rank stacks (e.g. (K, accum, B, T) when
+    multi-step dispatch composes with gradient accumulation) derive their
+    layout from ``sharding``: every leading stack dim replicates, the
+    trailing (B, T) keep the batch spec.
     """
     import jax
 
@@ -154,6 +157,14 @@ def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2,
                 "stacked (K,B,T) superbatch on a sharded run needs "
                 "superbatch_sharding")
             return global_batch(a, superbatch_sharding, batch_axis=1)
+        if a.ndim > 3:
+            # (K, accum, B, T)-style stacks: leading dims replicate, (B, T)
+            # keeps the batch spec — derived from the base batch sharding
+            from jax.sharding import NamedSharding, PartitionSpec
+            spec = PartitionSpec(*([None] * (a.ndim - 2)),
+                                 *sharding.spec)
+            stacked = NamedSharding(sharding.mesh, spec)
+            return global_batch(a, stacked, batch_axis=a.ndim - 2)
         return global_batch(a, sharding)
 
     def producer():

@@ -56,19 +56,20 @@ from ..ops.attention import NEG_INF, uint8_inverted_dropout
 Q_CHUNK = 2048
 
 
-def _flash_hop_supported(Tl: int, D: int, itemsize: int) -> bool:
-    """Envelope for running ring hops through the Pallas chunk kernel
-    (mirrors ops.flash_attention._pallas_supported: TPU backend,
-    lane-aligned shapes). The chunk kernel holds one (batch, head)'s
-    full K/V shard resident in VMEM — no streaming variant — so shards
-    past the measured resident-compile bound (flash_pallas.
-    STREAM_KV_BYTES) fall back to the q-chunked einsum body, which has
-    no such limit."""
+def _flash_hop_supported(q) -> bool:
+    """Envelope for running ring hops through the Pallas chunk kernel:
+    the shared kernel-eligibility check (ops.flash_attention.
+    _pallas_supported — TPU backend, lane-aligned shapes) plus a
+    residency bound. The chunk kernel holds one (batch, head)'s full
+    K/V shard resident in VMEM — no streaming variant — so shards past
+    the measured resident-compile bound (flash_pallas.STREAM_KV_BYTES)
+    fall back to the q-chunked einsum body, which has no such limit."""
+    from ..ops.flash_attention import _pallas_supported
     from ..ops.flash_pallas import STREAM_KV_BYTES
 
-    return (jax.default_backend() == "tpu" and D in (32, 64, 128, 256)
-            and Tl % 128 == 0 and Tl >= 128
-            and 2 * Tl * D * itemsize <= STREAM_KV_BYTES)
+    *_, Tl, D = q.shape
+    return (_pallas_supported(q)
+            and 2 * Tl * D * q.dtype.itemsize <= STREAM_KV_BYTES)
 
 
 def _ring_local_flash(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
@@ -166,8 +167,7 @@ def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         scale = D ** -0.5
     dropping = train and dropout_rate > 0.0 and rng is not None
     if hop_impl == "flash" or (
-            hop_impl == "auto"
-            and _flash_hop_supported(Tl, D, jnp.dtype(q.dtype).itemsize)):
+            hop_impl == "auto" and _flash_hop_supported(q)):
         return _ring_local_flash(q, k, v, axis_name=axis_name, scale=scale,
                                  dropout_rate=dropout_rate if dropping
                                  else 0.0,

@@ -238,7 +238,12 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
             logger.log(f"resumed from step {start_step}")
 
     history = []
-    tokens_per_batch = tcfg.batch_size * mcfg.block_size
+    accum = max(tcfg.grad_accum_steps, 1)
+    if accum > 1:
+        logger.log(f"gradient accumulation: {accum} x {tcfg.batch_size} "
+                   f"rows/optimizer step "
+                   f"(effective batch {accum * tcfg.batch_size})")
+    tokens_per_batch = tcfg.batch_size * mcfg.block_size * accum
     # ship tokens in the smallest dtype covering the vocab (2-4x less H2D
     # traffic); the jitted steps widen to int32 on device (steps.loss_fn)
     wire = (np.uint8 if mcfg.vocab_size <= 0xff
@@ -268,14 +273,22 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
         # its batches were drawn: the prefetch producer runs ahead of the
         # consumed step, so a mid-run checkpoint must save the cursor
         # as-of-consumption, not the live (raced-ahead) batcher state.
+        def draw_step():
+            # one optimizer step's batch: (B, T), or stacked (accum, B, T)
+            # microbatches under gradient accumulation
+            if accum == 1:
+                return next(narrow)
+            xs, ys = zip(*(next(narrow) for _ in range(accum)))
+            return np.stack(xs), np.stack(ys)
+
         i = start_step
         while i < tcfg.max_iters:
             c = chunk_at(i)
             if c > 1:
-                xs, ys = zip(*(next(narrow) for _ in range(c)))
+                xs, ys = zip(*(draw_step() for _ in range(c)))
                 item = (np.stack(xs), np.stack(ys))
             else:
-                item = next(narrow)
+                item = draw_step()
             yield (*item, train_batcher.state())
             i += c
 

@@ -36,17 +36,51 @@ def loss_fn(params, batch, cfg: ModelConfig, rng=None, train=False,
     return loss
 
 
+def _accum_grads(params, batch, *, mcfg: ModelConfig, rng, train,
+                 attention_fn, blocks_fn, accum: int):
+    """Mean loss/grads over ``accum`` stacked microbatches (each array of
+    ``batch`` is (accum, b, T)) via an on-device ``lax.scan`` — one
+    microbatch's activations live at a time, so the effective batch
+    ``accum * b`` costs single-microbatch activation memory. Equal-sized
+    microbatches make the mean-of-means identical to the full-batch mean."""
+    vg = jax.value_and_grad(loss_fn)
+
+    def body(carry, xs):
+        loss_sum, gsum = carry
+        mb, j = xs
+        loss, g = vg(params, mb, mcfg,
+                     rng=None if rng is None else jax.random.fold_in(rng, j),
+                     train=train, attention_fn=attention_fn,
+                     blocks_fn=blocks_fn)
+        return (loss_sum + loss,
+                jax.tree_util.tree_map(jnp.add, gsum, g)), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (loss_sum, gsum), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), zeros),
+        (batch, jnp.arange(accum)), length=accum)
+    inv = 1.0 / accum
+    return (loss_sum * inv,
+            jax.tree_util.tree_map(lambda g: g * inv, gsum))
+
+
 def _one_step(state: TrainState, batch, *, mcfg: ModelConfig, optimizer,
-              with_grad_norm: bool, attention_fn, blocks_fn
+              with_grad_norm: bool, attention_fn, blocks_fn, accum: int = 1
               ) -> Tuple[TrainState, Dict[str, Any]]:
     """The single optimizer step shared by make_train_step (jitted 1:1) and
     make_train_scan (scanned K:1) — one body, so the two dispatch shapes
     cannot drift apart semantically."""
     rng = jax.random.fold_in(state.rng, state.step)
-    loss, grads = jax.value_and_grad(loss_fn)(
-        state.params, batch, mcfg, rng=rng,
-        train=(mcfg.dropout > 0 or mcfg.attn_dropout > 0),
-        attention_fn=attention_fn, blocks_fn=blocks_fn)
+    train = mcfg.dropout > 0 or mcfg.attn_dropout > 0
+    if accum > 1:
+        loss, grads = _accum_grads(
+            state.params, batch, mcfg=mcfg, rng=rng if train else None,
+            train=train, attention_fn=attention_fn, blocks_fn=blocks_fn,
+            accum=accum)
+    else:
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, batch, mcfg, rng=rng, train=train,
+            attention_fn=attention_fn, blocks_fn=blocks_fn)
     updates, opt_state = optimizer.update(grads, state.opt_state,
                                           state.params)
     params = jax.tree_util.tree_map(
@@ -70,10 +104,14 @@ def make_train_step(mcfg: ModelConfig, tcfg: TrainConfig,
     function is mesh-agnostic. ``with_grad_norm`` adds a tree-wide grad-norm
     reduction to the metrics (off by default — it costs a full-tree
     reduction per step). ``attention_fn`` overrides the attention core —
-    the sequence-parallel paths (ring / Ulysses) plug in here."""
+    the sequence-parallel paths (ring / Ulysses) plug in here.
+
+    With ``tcfg.grad_accum_steps > 1`` the batch arrays are stacked
+    ``(accum, batch_size, T)`` microbatches (host-assembled like the K-step
+    superbatch, sharded P(None,'data','seq') on mesh runs)."""
     step = partial(_one_step, mcfg=mcfg, optimizer=make_optimizer(tcfg),
                    with_grad_norm=with_grad_norm, attention_fn=attention_fn,
-                   blocks_fn=blocks_fn)
+                   blocks_fn=blocks_fn, accum=tcfg.grad_accum_steps)
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
@@ -95,10 +133,10 @@ def make_train_scan(mcfg: ModelConfig, tcfg: TrainConfig, k: int,
     tests/test_train.py::test_train_scan_matches_single_steps."""
     one = partial(_one_step, mcfg=mcfg, optimizer=make_optimizer(tcfg),
                   with_grad_norm=with_grad_norm, attention_fn=attention_fn,
-                  blocks_fn=blocks_fn)
+                  blocks_fn=blocks_fn, accum=tcfg.grad_accum_steps)
 
     def run(state: TrainState, batches) -> Tuple[TrainState, Dict[str, Any]]:
-        xs, ys = batches  # (K, B, T) each
+        xs, ys = batches  # (K, B, T) each; (K, accum, B, T) under accumulation
         return jax.lax.scan(lambda s, b: one(s, b), state, (xs, ys),
                             length=k)
 

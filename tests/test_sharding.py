@@ -247,3 +247,35 @@ def test_runner_gates_flash_auto_on_mesh(tcfg):
     mesh = make_mesh(cfg.mesh)
     train(cfg, mesh=mesh, logger=StepLogger(stream=stream))
     assert "'auto' -> 'einsum'" in stream.getvalue()
+
+
+def test_grad_accum_on_mesh_matches_unsharded(tcfg):
+    """Gradient accumulation on a (data, seq) mesh — (A, b, T) microbatch
+    stack sharded P(None,'data','seq') — must match the unsharded step
+    bit-for-bit in loss and stay in the sharded layout."""
+    from replicatinggpt_tpu.parallel.mesh import make_superbatch_sharding
+    t = dataclasses.replace(tcfg, lr=1e-3, batch_size=8, grad_accum_steps=2)
+    A = 2
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, TINY.vocab_size, (A, 8, TINY.block_size),
+                     dtype=np.int32)
+    step = make_train_step(TINY, t, donate=False)
+
+    s_un = create_train_state(jax.random.PRNGKey(0), TINY, t)
+    s_un, m_un = step(s_un, (x, x))
+
+    mesh_cfg = MeshConfig(data=4, seq=2, fsdp=True)
+    mesh = make_mesh(mesh_cfg)
+    ss = make_superbatch_sharding(mesh)
+    xb = jax.device_put(x, ss)
+    assert xb.sharding.spec == P(None, "data", "seq")
+    s_sh = shard_train_state(_state_fn(TINY, t), mesh, mesh_cfg)
+    s_sh, m_sh = step(s_sh, (xb, xb))
+
+    np.testing.assert_allclose(float(m_un["loss"]), float(m_sh["loss"]),
+                               rtol=2e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(jax.device_get(b)), rtol=1e-4,
+            atol=1e-5),
+        s_un.params, s_sh.params)

@@ -164,3 +164,78 @@ def test_estimate_loss_scan_matches_loop(tiny):
                          eval_scan=make_eval_scan(m))
     for split in ("train", "val"):
         assert abs(loop[split] - scan[split]) < 1e-5
+
+
+def test_grad_accum_matches_full_batch(tiny):
+    """grad_accum_steps=A over (A, b, T) microbatches must take the same
+    optimizer step as one full (A*b, T) batch: equal-sized microbatch
+    mean-of-means == full-batch mean (dropout off; f32 summation-order
+    tolerance only)."""
+    import dataclasses
+    m, t = tiny.model, tiny.train
+    A, b = 4, 4
+    x = np.asarray(jax.random.randint(jax.random.PRNGKey(1),
+                                      (A * b, m.block_size), 0,
+                                      m.vocab_size), np.int32)
+    y = np.asarray(jax.random.randint(jax.random.PRNGKey(2),
+                                      (A * b, m.block_size), 0,
+                                      m.vocab_size), np.int32)
+
+    s_full = create_train_state(jax.random.PRNGKey(0), m, t)
+    full = make_train_step(m, dataclasses.replace(t, batch_size=A * b),
+                           donate=False)
+    s_full, met_full = full(s_full, (x, y))
+
+    s_acc = create_train_state(jax.random.PRNGKey(0), m, t)
+    acc = make_train_step(
+        m, dataclasses.replace(t, batch_size=b, grad_accum_steps=A),
+        donate=False)
+    s_acc, met_acc = acc(
+        s_acc, (x.reshape(A, b, -1), y.reshape(A, b, -1)))
+
+    assert abs(float(met_full["loss"]) - float(met_acc["loss"])) < 1e-5
+    assert int(s_acc.step) == 1
+    jax.tree_util.tree_map(
+        lambda p, q: np.testing.assert_allclose(p, q, rtol=1e-5, atol=1e-6),
+        s_full.params, s_acc.params)
+
+
+def test_grad_accum_with_dropout_deterministic(tiny):
+    """Under dropout, accumulation draws a distinct mask stream per
+    microbatch (rng folded on the scan index) and the step is a pure
+    function of (state, batch)."""
+    import dataclasses
+    m = dataclasses.replace(tiny.model, dropout=0.2, attn_dropout=0.2)
+    t = dataclasses.replace(tiny.train, batch_size=4, grad_accum_steps=2)
+    x = np.asarray(jax.random.randint(jax.random.PRNGKey(1),
+                                      (2, 4, m.block_size), 0,
+                                      m.vocab_size), np.int32)
+    s1 = create_train_state(jax.random.PRNGKey(0), m, t)
+    s2 = create_train_state(jax.random.PRNGKey(0), m, t)
+    step = make_train_step(m, t, donate=False)
+    s1, m1 = step(s1, (x, x))
+    s2, m2 = step(s2, (x, x))
+    assert float(m1["loss"]) == float(m2["loss"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b),
+        s1.params, s2.params)
+
+
+def test_runner_grad_accum_composes_with_scan_dispatch(tiny):
+    """Runner with grad_accum_steps>1 walks the same trajectory whether
+    steps are dispatched one at a time or K per lax.scan (the (K, A, B, T)
+    feed path)."""
+    import dataclasses
+    from replicatinggpt_tpu.train.runner import train
+    base = tiny.replace(
+        train=dataclasses.replace(tiny.train, max_iters=12, eval_interval=6,
+                                  eval_iters=2, log_interval=0, batch_size=4,
+                                  grad_accum_steps=2),
+        dataset="datasets/shakespeare.txt")
+    r1 = train(base)
+    r2 = train(base.replace(
+        train=dataclasses.replace(base.train, steps_per_dispatch=3)))
+    h1 = np.asarray([[tr, va] for _, tr, va in r1.history])
+    h2 = np.asarray([[tr, va] for _, tr, va in r2.history])
+    assert h1.shape == h2.shape
+    np.testing.assert_allclose(h1, h2, rtol=2e-4)
