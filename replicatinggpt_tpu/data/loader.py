@@ -111,20 +111,18 @@ def make_batcher(kind: str, data: np.ndarray, batch_size: int,
     raise ValueError(f"unknown sampling kind {kind!r}")
 
 
-def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2,
-             superbatch_sharding=None) -> Iterator:
+def prefetch(batches: Iterator[Batch], sharding=None,
+             depth: int = 2) -> Iterator:
     """Move batches to device on a background thread, ``depth`` ahead.
 
     ``sharding`` is an optional ``jax.sharding.Sharding`` for the global
     (B, T) batch (data/seq-parallel layouts); None keeps the default single
-    -device placement. A stream mixing single (B, T) batches and stacked
-    (K, B, T) superbatches (multi-step dispatch) routes 3-d items to
-    ``superbatch_sharding`` (P(None,'data','seq')) — required whenever
-    ``sharding`` is set and 3-d items appear, so the scan path never drops
-    the batch sharding. Higher-rank stacks (e.g. (K, accum, B, T) when
-    multi-step dispatch composes with gradient accumulation) derive their
-    layout from ``sharding``: every leading stack dim replicates, the
-    trailing (B, T) keep the batch spec.
+    -device placement. Stacked items of any rank — (K, B, T) multi-step
+    superbatches, (accum, B, T) gradient-accumulation stacks, or
+    (K, accum, B, T) when the two compose — derive their layout from
+    ``sharding``: every leading stack dim replicates, the trailing (B, T)
+    keep the batch spec (so no dispatch shape ever drops the batch
+    sharding).
     """
     import jax
 
@@ -152,14 +150,9 @@ def prefetch(batches: Iterator[Batch], sharding=None, depth: int = 2,
         # (jax.make_array_from_process_local_data); single-process
         # this is plain device_put with the sharding
         from ..parallel.distributed import global_batch
-        if a.ndim == 3:
-            assert superbatch_sharding is not None, (
-                "stacked (K,B,T) superbatch on a sharded run needs "
-                "superbatch_sharding")
-            return global_batch(a, superbatch_sharding, batch_axis=1)
-        if a.ndim > 3:
-            # (K, accum, B, T)-style stacks: leading dims replicate, (B, T)
-            # keeps the batch spec — derived from the base batch sharding
+        if a.ndim > 2:
+            # stacked items: leading dims replicate, (B, T) keeps the
+            # batch spec — derived from the base batch sharding
             from jax.sharding import NamedSharding, PartitionSpec
             spec = PartitionSpec(*([None] * (a.ndim - 2)),
                                  *sharding.spec)

@@ -132,14 +132,21 @@ def _causal_mask(s, q_first, k_first, block_q, block_k):
     return jnp.where(kpos <= qpos, s, NEG_INF)
 
 
-def _fwd_tile(q_scaled, k, v, acc, m, l, *, causal, q_first, k_first,
+def _fwd_tile(q, k, v, acc, m, l, *, scale, causal, q_first, k_first,
               block_q, block_k, seed, bh, dropout_rate):
     """One online-softmax update: returns (acc', m', l'). The softmax
     normalizer l is dropout-free (dense-path semantics: dropout applies
     to the normalized weights); only the V accumulation sees the
-    inverted-dropout multiplier."""
-    s = jax.lax.dot_general(q_scaled, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+    inverted-dropout multiplier.
+
+    Matmuls run on the operands' native dtype (bf16 inputs hit the MXU's
+    bf16 path — ~4x the f32 rate) with f32 accumulation
+    (preferred_element_type); scaling, max/exp and the normalizer stay
+    f32. The probability tile is cast back to the value dtype for the
+    p@v matmul — the standard flash-kernel trade (weights are in [0, 1],
+    so the cast costs ~3 relative digits on an already-bf16 pipeline)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
     if causal:
         s = _causal_mask(s, q_first, k_first, block_q, block_k)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -149,7 +156,9 @@ def _fwd_tile(q_scaled, k, v, acc, m, l, *, causal, q_first, k_first,
     if dropout_rate > 0.0:
         p = p * _dropout_mult(seed, bh, q_first, k_first, block_q, block_k,
                               dropout_rate)
-    acc_new = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+    acc_new = acc * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     return acc_new, m_new, l_new
 
 
@@ -157,9 +166,10 @@ def _dq_tile(q, k, v, do, lse, delta, *, scale, causal, q_first, k_first,
              block_q, block_k, seed, bh, dropout_rate):
     """dq contribution of one (q-block, kv-block) tile. d(softmax):
     ds_ij = p_ij (z_ij dp_ij - delta_i); delta (the do.o rowsum) already
-    absorbs the dropout mask z from forward."""
-    s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
+    absorbs the dropout mask z from forward. Matmuls on native dtype
+    with f32 accumulation (see _fwd_tile)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
     if causal:
         s = _causal_mask(s, q_first, k_first, block_q, block_k)
     p = jnp.exp(s - lse)
@@ -169,16 +179,18 @@ def _dq_tile(q, k, v, do, lse, delta, *, scale, causal, q_first, k_first,
         dp = dp * _dropout_mult(seed, bh, q_first, k_first, block_q,
                                 block_k, dropout_rate)
     ds = p * (dp - delta) * scale
-    return jnp.dot(ds, k, preferred_element_type=jnp.float32)
+    return jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
 
 
 def _dkv_tile(q, k, v, do, lse, delta, *, scale, causal, q_first, k_first,
               block_q, block_k, seed, bh, dropout_rate):
     """(dk, dv) contributions of one tile. The dropout stream keys off
     absolute (seed, bh, q-pos, k-pos), so kv-major loops regenerate the
-    exact forward mask."""
-    s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
+    exact forward mask. Matmuls on native dtype with f32 accumulation
+    (see _fwd_tile)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
     if causal:
         s = _causal_mask(s, q_first, k_first, block_q, block_k)
     p = jnp.exp(s - lse)
@@ -188,14 +200,14 @@ def _dkv_tile(q, k, v, do, lse, delta, *, scale, causal, q_first, k_first,
     else:
         z = None
     dv_c = jax.lax.dot_general(
-        p * z if z is not None else p, do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        (p * z if z is not None else p).astype(do.dtype), do,
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     if z is not None:
         dp = dp * z
     ds = p * (dp - delta) * scale
-    dk_c = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+    dk_c = jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                                preferred_element_type=jnp.float32)
     return dk_c, dv_c
 
@@ -208,7 +220,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
                 causal, seq_len, block_q, block_k, dropout_rate):
     i = pl.program_id(0)
     j = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * scale          # (bq, D)
+    q = q_ref[...]                                      # (bq, D) native dtype
     D = q.shape[-1]
     q_first = j * block_q
 
@@ -219,9 +231,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
 
     def body(kb, carry):
         acc, m, l = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        return _fwd_tile(q, k, v, acc, m, l, causal=causal,
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
+        return _fwd_tile(q, k, v, acc, m, l, scale=scale, causal=causal,
                          q_first=q_first, k_first=kb * block_k,
                          block_q=block_q, block_k=block_k, seed=seed_ref[0],
                          bh=i, dropout_rate=dropout_rate)
@@ -277,8 +289,8 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    block_k, dropout_rate):
     i = pl.program_id(0)
     j = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32)                   # (bq, D)
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]                                       # (bq, D) native dtype
+    do = do_ref[...]
     lse = lse_ref[...][:, :1]                            # (bq, 1) of (bq, LANES)
     delta = delta_ref[...][:, :1]
     q_first = j * block_q
@@ -288,8 +300,8 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         n_kv = seq_len // block_k
 
     def body(kb, dq):
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
         return dq + _dq_tile(q, k, v, do, lse, delta, scale=scale,
                              causal=causal, q_first=q_first,
                              k_first=kb * block_k, block_q=block_q,
@@ -297,7 +309,7 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                              dropout_rate=dropout_rate)
 
     dq = jax.lax.fori_loop(0, n_kv,
-                           body, jnp.zeros_like(q))
+                           body, jnp.zeros(q.shape, jnp.float32))
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
@@ -306,16 +318,16 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     block_q, block_k, dropout_rate):
     i = pl.program_id(0)
     kb = pl.program_id(1)
-    k = k_ref[...].astype(jnp.float32)                   # (bk, D)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]                                       # (bk, D) native dtype
+    v = v_ref[...]
     k_first = kb * block_k
     n_q = seq_len // block_q
     first_q = (k_first // block_q) if causal else 0
 
     def body(jb, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(jb * block_q, block_q), :]
+        do = do_ref[pl.ds(jb * block_q, block_q), :]
         lse = lse_ref[pl.ds(jb * block_q, block_q), :][:, :1]
         delta = delta_ref[pl.ds(jb * block_q, block_q), :][:, :1]
         dk_c, dv_c = _dkv_tile(q, k, v, do, lse, delta, scale=scale,
@@ -450,12 +462,10 @@ def _fwd_kernel_stream(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(needed)
     def _update():
-        q = q_ref[...].astype(jnp.float32) * scale        # (bq, D)
-        k = k_ref[...].astype(jnp.float32)                # (bk, D)
-        v = v_ref[...].astype(jnp.float32)
         acc, m_new, l_new = _fwd_tile(
-            q, k, v, acc_ref[...], m_ref[...][:, :1], l_ref[...][:, :1],
-            causal=causal, q_first=q_first, k_first=k_first,
+            q_ref[...], k_ref[...], v_ref[...],
+            acc_ref[...], m_ref[...][:, :1], l_ref[...][:, :1],
+            scale=scale, causal=causal, q_first=q_first, k_first=k_first,
             block_q=block_q, block_k=block_k, seed=seed_ref[0], bh=i,
             dropout_rate=dropout_rate)
         acc_ref[...] = acc
@@ -528,8 +538,7 @@ def _bwd_dq_kernel_stream(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     @pl.when(needed)
     def _update():
         dq_acc_ref[...] = dq_acc_ref[...] + _dq_tile(
-            q_ref[...].astype(jnp.float32), k_ref[...].astype(jnp.float32),
-            v_ref[...].astype(jnp.float32), do_ref[...].astype(jnp.float32),
+            q_ref[...], k_ref[...], v_ref[...], do_ref[...],
             lse_ref[...][:, :1], delta_ref[...][:, :1], scale=scale,
             causal=causal, q_first=q_first, k_first=k_first,
             block_q=block_q, block_k=block_k, seed=seed_ref[0], bh=i,
@@ -561,8 +570,7 @@ def _bwd_dkv_kernel_stream(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     @pl.when(needed)
     def _update():
         dk_c, dv_c = _dkv_tile(
-            q_ref[...].astype(jnp.float32), k_ref[...].astype(jnp.float32),
-            v_ref[...].astype(jnp.float32), do_ref[...].astype(jnp.float32),
+            q_ref[...], k_ref[...], v_ref[...], do_ref[...],
             lse_ref[...][:, :1], delta_ref[...][:, :1], scale=scale,
             causal=causal, q_first=q_first, k_first=k_first,
             block_q=block_q, block_k=block_k, seed=seed_ref[0], bh=i,
@@ -686,11 +694,10 @@ def _fwd_kernel_tri(tmap_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     acc, m_new, l_new = _fwd_tile(
-        q_ref[...].astype(jnp.float32) * scale,
-        k_ref[...].astype(jnp.float32), v_ref[...].astype(jnp.float32),
-        acc_ref[...], m_ref[...][:, :1], l_ref[...][:, :1], causal=True,
-        q_first=q_first, k_first=k_first, block_q=block, block_k=block,
-        seed=seed_ref[0], bh=i, dropout_rate=dropout_rate)
+        q_ref[...], k_ref[...], v_ref[...],
+        acc_ref[...], m_ref[...][:, :1], l_ref[...][:, :1], scale=scale,
+        causal=True, q_first=q_first, k_first=k_first, block_q=block,
+        block_k=block, seed=seed_ref[0], bh=i, dropout_rate=dropout_rate)
     acc_ref[...] = acc
     m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -759,8 +766,7 @@ def _bwd_dq_kernel_tri(tmap_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
     dq_acc_ref[...] = dq_acc_ref[...] + _dq_tile(
-        q_ref[...].astype(jnp.float32), k_ref[...].astype(jnp.float32),
-        v_ref[...].astype(jnp.float32), do_ref[...].astype(jnp.float32),
+        q_ref[...], k_ref[...], v_ref[...], do_ref[...],
         lse_ref[...][:, :1], delta_ref[...][:, :1], scale=scale,
         causal=True, q_first=q_first, k_first=k_first, block_q=block,
         block_k=block, seed=seed_ref[0], bh=i, dropout_rate=dropout_rate)
@@ -786,8 +792,7 @@ def _bwd_dkv_kernel_tri(tmap_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
     dk_c, dv_c = _dkv_tile(
-        q_ref[...].astype(jnp.float32), k_ref[...].astype(jnp.float32),
-        v_ref[...].astype(jnp.float32), do_ref[...].astype(jnp.float32),
+        q_ref[...], k_ref[...], v_ref[...], do_ref[...],
         lse_ref[...][:, :1], delta_ref[...][:, :1], scale=scale,
         causal=True, q_first=q_first, k_first=k_first, block_q=block,
         block_k=block, seed=seed_ref[0], bh=i, dropout_rate=dropout_rate)
@@ -1059,7 +1064,7 @@ def _chunk_fwd_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
                       block_k, dropout_rate):
     i = pl.program_id(0)
     j = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * scale
+    q = q_ref[...]
     D = q.shape[-1]
     q_first = off_ref[0] + j * block_q
     n_kv = seq_len_k // block_k
@@ -1073,9 +1078,9 @@ def _chunk_fwd_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
 
     def body(kb, carry):
         acc, m, l = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        return _fwd_tile(q, k, v, acc, m, l, causal=causal,
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
+        return _fwd_tile(q, k, v, acc, m, l, scale=scale, causal=causal,
                          q_first=q_first,
                          k_first=off_ref[1] + kb * block_k,
                          block_q=block_q, block_k=block_k,
@@ -1097,8 +1102,8 @@ def _chunk_bwd_dq_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
                          seq_len_k, block_q, block_k, dropout_rate):
     i = pl.program_id(0)
     j = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]
+    do = do_ref[...]
     lse = lse_ref[...][:, :1]
     deltap = deltap_ref[...][:, :1]
     q_first = off_ref[0] + j * block_q
@@ -1108,8 +1113,8 @@ def _chunk_bwd_dq_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
             (q_first + block_q - 1 - off_ref[1]) // block_k + 1, 0, n_kv)
 
     def body(kb, dq):
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
         return dq + _dq_tile(q, k, v, do, lse, deltap, scale=scale,
                              causal=causal, q_first=q_first,
                              k_first=off_ref[1] + kb * block_k,
@@ -1117,8 +1122,8 @@ def _chunk_bwd_dq_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
                              seed=seed_ref[0], bh=off_ref[2] + i,
                              dropout_rate=dropout_rate)
 
-    dq_ref[...] = jax.lax.fori_loop(0, n_kv, body,
-                                    jnp.zeros_like(q)).astype(dq_ref.dtype)
+    dq_ref[...] = jax.lax.fori_loop(
+        0, n_kv, body, jnp.zeros(q.shape, jnp.float32)).astype(dq_ref.dtype)
 
 
 def _chunk_bwd_dkv_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
@@ -1127,8 +1132,8 @@ def _chunk_bwd_dkv_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
                           dropout_rate):
     i = pl.program_id(0)
     kb = pl.program_id(1)
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]
+    v = v_ref[...]
     k_first = off_ref[1] + kb * block_k
     n_q = seq_len_q // block_q
     if causal:
@@ -1139,8 +1144,8 @@ def _chunk_bwd_dkv_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
 
     def body(jb, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(jb * block_q, block_q), :]
+        do = do_ref[pl.ds(jb * block_q, block_q), :]
         lse = lse_ref[pl.ds(jb * block_q, block_q), :][:, :1]
         deltap = deltap_ref[pl.ds(jb * block_q, block_q), :][:, :1]
         dk_c, dv_c = _dkv_tile(q, k, v, do, lse, deltap, scale=scale,

@@ -303,8 +303,7 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
             return self.snap
 
     cursor = _ConsumedCursor(train_batcher.state())
-    batches_raw = prefetch(feed(), sharding=batch_sharding,
-                           superbatch_sharding=super_sharding)
+    batches_raw = prefetch(feed(), sharding=batch_sharding)
 
     def batches_iter():
         for *batch, snap in batches_raw:
