@@ -138,18 +138,12 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
         if blocks_fn is not None:
             logger.log(f"pipeline parallelism: {cfg.mesh.pipe} stages, "
                        f"{cfg.mesh.microbatches or 2 * cfg.mesh.pipe} "
-                       f"microbatches"
-                       + (" (attention-weight dropout not applied on the"
-                          " pipeline path)" if mcfg.attn_dropout > 0
-                          else ""))
+                       f"microbatches")
         else:
             attention_fn = select_attention_fn(mcfg, cfg.mesh, mesh)
             if attention_fn is not None:
                 logger.log(f"sequence parallelism: seq axis {cfg.mesh.seq}, "
-                           f"impl {mcfg.attention_impl!r}"
-                           + (" (attention-weight dropout not applied on the"
-                              " seq-parallel path)" if mcfg.attn_dropout > 0
-                              else ""))
+                           f"impl {mcfg.attention_impl!r}")
     if (mesh is not None
             and mcfg.attention_impl in ("auto", "ring", "ulysses")
             and attention_fn is None and blocks_fn is None):

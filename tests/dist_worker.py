@@ -29,6 +29,7 @@ def main() -> None:
     p.add_argument("--out", required=True)
     p.add_argument("--max-iters", type=int, default=20)
     p.add_argument("--steps-per-dispatch", type=int, default=1)
+    p.add_argument("--grad-accum-steps", type=int, default=1)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true")
@@ -62,6 +63,7 @@ def main() -> None:
             eval_iters=2, log_interval=0, batch_size=8,
             sampling="sequential",
             steps_per_dispatch=args.steps_per_dispatch,
+            grad_accum_steps=args.grad_accum_steps,
             checkpoint_every=args.checkpoint_every),
         mesh=MeshConfig(data=jax.device_count()),
         dataset=os.path.join(repo, "datasets", "shakespeare.txt"))

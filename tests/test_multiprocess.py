@@ -83,6 +83,24 @@ def test_two_process_multistep_dispatch_matches_single_process(
     np.testing.assert_allclose(got["param_sq"], ref["param_sq"], rtol=1e-4)
 
 
+def test_two_process_grad_accum_matches_single_process(tmp_path):
+    """Gradient accumulation across processes: each optimizer step's
+    (A, B, T) microbatch stack — and the (K, A, B, T) scan-dispatch stack —
+    is assembled from per-process rows (batch_axis = ndim-2). Params must
+    match a single-process run with the same accumulation settings."""
+    ref, _ = _run(1, str(tmp_path), "accref",
+                  ["--grad-accum-steps", "2", "--max-iters", "12"])
+    got, _ = _run(2, str(tmp_path), "acc2",
+                  ["--grad-accum-steps", "2", "--max-iters", "12"])
+    gotk, _ = _run(2, str(tmp_path), "acc2k3",
+                   ["--grad-accum-steps", "2", "--max-iters", "12",
+                    "--steps-per-dispatch", "3"])
+    assert got["end_step"] == ref["end_step"] == 12
+    np.testing.assert_allclose(got["param_sq"], ref["param_sq"], rtol=1e-4)
+    assert gotk["end_step"] == 12
+    np.testing.assert_allclose(gotk["param_sq"], ref["param_sq"], rtol=1e-4)
+
+
 def test_stop_on_noncoordinator_is_ignored(tmp_path):
     """Only the coordinator's flag decides (skewed signal delivery must not
     desynchronize the hosts): a stop_event set on process 1 alone runs to
