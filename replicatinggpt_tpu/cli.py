@@ -90,7 +90,8 @@ def cmd_train(args) -> int:
 
 
 def _sample(params, cfg, tokenizer, n_tokens: int, prompt_text: str = None,
-            top_k: int = 0, temperature: float = 1.0, mesh=None) -> None:
+            top_k: int = 0, top_p: float = 0.0, temperature: float = 1.0,
+            mesh=None) -> None:
     import jax.numpy as jnp
     import numpy as np
     from .sample import GenerateConfig, generate, shard_for_decode
@@ -107,7 +108,7 @@ def _sample(params, cfg, tokenizer, n_tokens: int, prompt_text: str = None,
                                           cfg.mesh)
     toks = generate(params, prompt, cfg.model,
                     GenerateConfig(max_new_tokens=n_tokens, top_k=top_k,
-                                   temperature=temperature))
+                                   top_p=top_p, temperature=temperature))
     print(tokenizer.decode(np.asarray(toks)[0].tolist()))
 
 
@@ -134,7 +135,7 @@ def cmd_generate(args) -> int:
         else:
             state = restored
     _sample(state.params, cfg, tokenizer, args.sample_tokens,
-            prompt_text=args.prompt, top_k=args.top_k,
+            prompt_text=args.prompt, top_k=args.top_k, top_p=args.top_p,
             temperature=args.temperature, mesh=_build_mesh_if_needed(cfg))
     return 0
 
@@ -232,6 +233,8 @@ def main(argv=None) -> int:
     pg.add_argument("--prompt", default=None)
     pg.add_argument("--sample-tokens", type=int, default=500)
     pg.add_argument("--top-k", type=int, default=0)
+    pg.add_argument("--top-p", type=float, default=0.0,
+                    help="nucleus sampling mass; 0 = off")
     pg.add_argument("--temperature", type=float, default=1.0)
     pg.set_defaults(fn=cmd_generate)
 

@@ -41,7 +41,27 @@ class GenerateConfig:
     temperature: float = 1.0
     top_k: int = 0                     # 0 = full multinomial (GPT1.py:208);
                                        # 50 = the GPT-2 sampler (GPT-2.py:245)
+    top_p: float = 0.0                 # 0 = off; (0, 1] = nucleus sampling
+                                       # (beyond the reference's samplers;
+                                       # composes with top_k: k-filter first)
     greedy: bool = False
+
+
+def _top_p_filter(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filter: keep the smallest prefix of the descending-softmax
+    distribution whose cumulative probability reaches ``p`` (always
+    including the top token), mask the rest to -inf. Sort-based, O(V log V)
+    on device — static shapes, jit/scan-friendly."""
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept iff the cumulative mass BEFORE it is < p (so the
+    # first token is always kept and the prefix total first reaches >= p)
+    keep = (cum - probs) < p
+    # per-row logit threshold: the smallest kept sorted logit
+    thresh = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
 
 
 def _sample_token(rng: jax.Array, logits: jnp.ndarray,
@@ -54,6 +74,8 @@ def _sample_token(rng: jax.Array, logits: jnp.ndarray,
         k = min(gcfg.top_k, logits.shape[-1])
         kth = jax.lax.top_k(logits, k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if gcfg.top_p and gcfg.top_p > 0.0:
+        logits = _top_p_filter(logits, gcfg.top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 

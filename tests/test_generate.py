@@ -155,3 +155,71 @@ def test_generate_compile_stability(params):
     generate(params, jnp.zeros((1, 1), jnp.int32), cfg, gcfg2,
              rng=jax.random.PRNGKey(1))
     assert _decode_segment._cache_size() == n_first
+
+
+def test_top_p_filter_keeps_nucleus_only():
+    """The nucleus filter keeps exactly the smallest descending-probability
+    prefix reaching mass p (always >= 1 token), masks the rest to -inf."""
+    import jax.numpy as jnp
+    from replicatinggpt_tpu.sample.generate import _top_p_filter
+
+    # probs ~ [0.6, 0.3, 0.08, 0.02] after softmax
+    logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.08, 0.02]], jnp.float32))
+    out = _top_p_filter(logits, 0.5)      # 0.6 alone reaches 0.5
+    assert jnp.isfinite(out[0, 0]) and not jnp.any(jnp.isfinite(out[0, 1:]))
+    out = _top_p_filter(logits, 0.85)     # needs 0.6 + 0.3
+    assert bool(jnp.all(jnp.isfinite(out[0, :2])))
+    assert not jnp.any(jnp.isfinite(out[0, 2:]))
+    out = _top_p_filter(logits, 1.0)      # keeps everything
+    assert bool(jnp.all(jnp.isfinite(out)))
+    # extreme p always keeps the argmax
+    out = _top_p_filter(logits, 1e-9)
+    assert jnp.isfinite(out[0, 0]) and not jnp.any(jnp.isfinite(out[0, 1:]))
+
+
+def test_sample_token_top_p_never_draws_masked_tail():
+    """_sample_token with top_p draws only nucleus members: over many
+    draws from a known distribution, the masked tail never appears (this
+    pins the guard wiring, not just the filter math)."""
+    import jax
+    import jax.numpy as jnp
+    from replicatinggpt_tpu.sample.generate import (GenerateConfig,
+                                                    _sample_token)
+
+    logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.08, 0.02]], jnp.float32))
+    batched = jnp.broadcast_to(logits, (500, 4))
+    draws = _sample_token(jax.random.PRNGKey(0), batched,
+                          GenerateConfig(top_p=0.5))
+    assert bool(jnp.all(draws == 0))              # nucleus = {0}
+    draws = _sample_token(jax.random.PRNGKey(1), batched,
+                          GenerateConfig(top_p=0.85))
+    assert bool(jnp.all(draws <= 1))              # nucleus = {0, 1}
+    assert bool(jnp.any(draws == 1))              # and it still samples
+
+
+def test_generate_top_p_end_to_end():
+    """End-to-end: top-p generation produces valid tokens and greedy
+    decoding ignores top_p (nucleus membership itself is pinned by
+    test_sample_token_top_p_never_draws_masked_tail)."""
+    import jax
+    import jax.numpy as jnp
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.sample import GenerateConfig, generate
+    from replicatinggpt_tpu.train.state import create_train_state
+
+    cfg = get_config("test-tiny")
+    m = cfg.model
+    state = create_train_state(jax.random.PRNGKey(0), m, cfg.train)
+    toks = generate(state.params, jnp.zeros((1, 1), jnp.int32), m,
+                    GenerateConfig(max_new_tokens=24, top_p=0.9),
+                    rng=jax.random.PRNGKey(1))
+    assert toks.shape == (1, 24)
+    assert bool(jnp.all((toks >= 0) & (toks < m.vocab_size)))
+    # greedy unaffected by top_p
+    g1 = generate(state.params, jnp.zeros((1, 1), jnp.int32), m,
+                  GenerateConfig(max_new_tokens=8, greedy=True, top_p=0.5),
+                  rng=jax.random.PRNGKey(2))
+    g2 = generate(state.params, jnp.zeros((1, 1), jnp.int32), m,
+                  GenerateConfig(max_new_tokens=8, greedy=True),
+                  rng=jax.random.PRNGKey(3))
+    assert bool(jnp.all(g1 == g2))
