@@ -177,12 +177,17 @@ class Config:
 # Presets: every configuration the reference can express + BASELINE workloads
 # ---------------------------------------------------------------------------
 
-def _gpt2_ladder(n_layer: int, n_head: int, n_embd: int) -> ModelConfig:
+def _gpt2_ladder(n_layer: int, n_head: int, n_embd: int,
+                 remat: bool = False) -> ModelConfig:
     # Size table from GPT-2.py:140-147 (vocab 50257, context 1024).
+    # remat=True for 350M+: without it the layer-stacked residuals of a
+    # 24-48 layer scan (~18 GB at 350M/B=8) exceed a single chip's HBM —
+    # measured OOM on v5e-16G; with remat the same config trains (the
+    # FLOPs-for-HBM trade jax.checkpoint exists for).
     return ModelConfig(
         vocab_size=50257, block_size=1024, n_layer=n_layer, n_head=n_head,
         n_embd=n_embd, dropout=0.0, attn_dropout=0.0, tied_head=True,
-        activation="gelu",
+        activation="gelu", remat=remat,
     )
 
 
@@ -238,7 +243,7 @@ PRESETS = {
     # BASELINE.json config 4: GPT-2 350M, v4-32, bf16, FSDP.
     "gpt2-medium": Config(
         name="gpt2-medium",
-        model=_gpt2_ladder(24, 16, 1024),
+        model=_gpt2_ladder(24, 16, 1024, remat=True),
         train=TrainConfig(batch_size=64, lr=3e-4, max_iters=1000,
                           sampling="sequential", lr_schedule="cosine",
                           warmup_iters=100, grad_clip=1.0),
@@ -246,11 +251,11 @@ PRESETS = {
         tokenizer="bpe",
     ),
     "gpt2-large": Config(
-        name="gpt2-large", model=_gpt2_ladder(36, 20, 1280),
+        name="gpt2-large", model=_gpt2_ladder(36, 20, 1280, remat=True),
         mesh=MeshConfig(data=16, fsdp=True), tokenizer="bpe",
     ),
     "gpt2-xl": Config(
-        name="gpt2-xl", model=_gpt2_ladder(48, 25, 1600),
+        name="gpt2-xl", model=_gpt2_ladder(48, 25, 1600, remat=True),
         mesh=MeshConfig(data=16, fsdp=True), tokenizer="bpe",
     ),
     # Tiny config for tests / smoke runs.
@@ -290,6 +295,12 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dtype", type=str, default=None)
     p.add_argument("--attention", dest="attention_impl", default=None,
                    choices=["auto", "einsum", "flash", "ring", "ulysses"])
+    p.add_argument("--remat", action="store_true", default=None,
+                   help="jax.checkpoint each block (trade FLOPs for HBM)")
+    p.add_argument("--no-remat", dest="remat", action="store_false",
+                   help="disable the preset's remat (e.g. 350M+ presets "
+                        "default remat on for single-chip HBM; a pod-slice "
+                        "FSDP run may not need it)")
     # train overrides
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
@@ -328,6 +339,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         ("n_layer", args.n_layer), ("n_head", args.n_head),
         ("n_embd", args.n_embd), ("dropout", args.dropout),
         ("dtype", args.dtype), ("attention_impl", args.attention_impl),
+        ("remat", args.remat),
     ) if v is not None}
     if args.dropout is not None:
         mk["attn_dropout"] = args.dropout

@@ -51,17 +51,22 @@ def _top_p_filter(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     """Nucleus filter: keep the smallest prefix of the descending-softmax
     distribution whose cumulative probability reaches ``p`` (always
     including the top token), mask the rest to -inf. Sort-based, O(V log V)
-    on device — static shapes, jit/scan-friendly."""
-    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    on device — static shapes, jit/scan-friendly.
+
+    Rank-based (keep flags scattered back through the argsort), not
+    value-thresholded: boundary ties cannot widen the nucleus past the
+    prefix (a value threshold would keep every token tied with the
+    boundary logit — a no-op on fully tied rows)."""
+    idx = jnp.argsort(logits, axis=-1)[:, ::-1]          # descending order
+    sorted_logits = jnp.take_along_axis(logits, idx, axis=-1)
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    # token i is kept iff the cumulative mass BEFORE it is < p (so the
-    # first token is always kept and the prefix total first reaches >= p)
+    # sorted position i is kept iff the cumulative mass BEFORE it is < p
+    # (so the top token is always kept and the prefix first reaches >= p)
     keep = (cum - probs) < p
-    # per-row logit threshold: the smallest kept sorted logit
-    thresh = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
-                     keepdims=True)
-    return jnp.where(logits < thresh, -jnp.inf, logits)
+    rows = jnp.arange(logits.shape[0])[:, None]
+    mask = jnp.zeros(logits.shape, bool).at[rows, idx].set(keep)
+    return jnp.where(mask, logits, -jnp.inf)
 
 
 def _sample_token(rng: jax.Array, logits: jnp.ndarray,

@@ -175,6 +175,11 @@ def test_top_p_filter_keeps_nucleus_only():
     # extreme p always keeps the argmax
     out = _top_p_filter(logits, 1e-9)
     assert jnp.isfinite(out[0, 0]) and not jnp.any(jnp.isfinite(out[0, 1:]))
+    # boundary ties cannot widen the nucleus (rank-based, not
+    # value-thresholded): fully tied row at p=0.25 keeps exactly one
+    tied = jnp.zeros((1, 4), jnp.float32)
+    out = _top_p_filter(tied, 0.25)
+    assert int(jnp.sum(jnp.isfinite(out))) == 1
 
 
 def test_sample_token_top_p_never_draws_masked_tail():
