@@ -168,19 +168,37 @@ def _dq_tile(q, k, v, do, lse, delta, *, scale, causal, q_first, k_first,
     ds_ij = p_ij (z_ij dp_ij - delta_i); delta (the do.o rowsum) already
     absorbs the dropout mask z from forward. Matmuls on native dtype
     with f32 accumulation (see _fwd_tile)."""
+    z = (_dropout_mult(seed, bh, q_first, k_first, block_q, block_k,
+                       dropout_rate) if dropout_rate > 0.0 else None)
+    p = _bwd_p_tile(q, k, lse, scale=scale, causal=causal, q_first=q_first,
+                    k_first=k_first, block_q=block_q, block_k=block_k)
+    ds = _bwd_ds_tile(p, do, v, delta, scale=scale, z=z)
+    return jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _bwd_p_tile(q, k, lse, *, scale, causal, q_first, k_first, block_q,
+                block_k):
+    """Recompute one probability tile from the forward's lse — the shared
+    first half of every backward tile (split dq / dkv kernels and the
+    fused single-tile kernel all call this; keep it the one source of
+    truth for the score/mask/exp math)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if causal:
         s = _causal_mask(s, q_first, k_first, block_q, block_k)
-    p = jnp.exp(s - lse)
+    return jnp.exp(s - lse)
+
+
+def _bwd_ds_tile(p, do, v, delta, *, scale, z):
+    """d(softmax) tile ds = p (z dp - delta) scale — the shared second
+    half (see _bwd_p_tile). ``z`` is the inverted-dropout multiplier or
+    None; callers cast ds to the operand dtype at their final matmuls."""
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    if dropout_rate > 0.0:
-        dp = dp * _dropout_mult(seed, bh, q_first, k_first, block_q,
-                                block_k, dropout_rate)
-    ds = p * (dp - delta) * scale
-    return jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-                               preferred_element_type=jnp.float32)
+    if z is not None:
+        dp = dp * z
+    return p * (dp - delta) * scale
 
 
 def _dkv_tile(q, k, v, do, lse, delta, *, scale, causal, q_first, k_first,
@@ -189,24 +207,14 @@ def _dkv_tile(q, k, v, do, lse, delta, *, scale, causal, q_first, k_first,
     absolute (seed, bh, q-pos, k-pos), so kv-major loops regenerate the
     exact forward mask. Matmuls on native dtype with f32 accumulation
     (see _fwd_tile)."""
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if causal:
-        s = _causal_mask(s, q_first, k_first, block_q, block_k)
-    p = jnp.exp(s - lse)
-    if dropout_rate > 0.0:
-        z = _dropout_mult(seed, bh, q_first, k_first, block_q, block_k,
-                          dropout_rate)
-    else:
-        z = None
+    z = (_dropout_mult(seed, bh, q_first, k_first, block_q, block_k,
+                       dropout_rate) if dropout_rate > 0.0 else None)
+    p = _bwd_p_tile(q, k, lse, scale=scale, causal=causal, q_first=q_first,
+                    k_first=k_first, block_q=block_q, block_k=block_k)
     dv_c = jax.lax.dot_general(
         (p * z if z is not None else p).astype(do.dtype), do,
         (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    if z is not None:
-        dp = dp * z
-    ds = p * (dp - delta) * scale
+    ds = _bwd_ds_tile(p, do, v, delta, scale=scale, z=z)
     dk_c = jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                                preferred_element_type=jnp.float32)
     return dk_c, dv_c
@@ -344,6 +352,59 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dk_ref, dv_ref, *, scale, causal,
+                      block_q, block_k, dropout_rate):
+    """Single-tile fused backward (T == block_q == block_k): the score /
+    probability tile is computed once and dq, dk AND dv all come from it —
+    one kernel launch and one s/p recompute instead of two of each. At
+    short T the per-step cost is launch- and recompute-bound (traced on
+    v5e: 12 bwd launches were 23% of the char-GPT step), which is exactly
+    what this halves. Same dropout stream as the split kernels
+    (seed, bh, q_first=0, k_first=0), so fused and split backwards see the
+    forward's mask."""
+    i = pl.program_id(0)
+    q = q_ref[...]
+    k = k_ref[...]
+    v = v_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[...][:, :1]
+    delta = delta_ref[...][:, :1]
+    z = (_dropout_mult(seed_ref[0], i, 0, 0, block_q, block_k,
+                       dropout_rate) if dropout_rate > 0.0 else None)
+    p = _bwd_p_tile(q, k, lse, scale=scale, causal=causal, q_first=0,
+                    k_first=0, block_q=block_q, block_k=block_k)
+    dv = jax.lax.dot_general(
+        (p * z if z is not None else p).astype(do.dtype), do,
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = _bwd_ds_tile(p, do, v, delta, scale=scale, z=z).astype(k.dtype)
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused(scale, causal, block_q, block_k, dropout_rate,
+                     seed, qf, kf, vf, gf, lse, delta, BH, T, D, dtype):
+    kernel = functools.partial(
+        _bwd_fused_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
+    spec_td = _vmem_spec((None, T, D), lambda i: (i, 0, 0))
+    spec_tl = _vmem_spec((None, T, LANES), lambda i: (i, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(BH,),
+        in_specs=[_smem_spec(), spec_td, spec_td, spec_td, spec_td,
+                  spec_tl, spec_tl],
+        out_specs=[spec_td, spec_td, spec_td],
+        out_shape=[jax.ShapeDtypeStruct((BH, T, D), dtype)] * 3,
+        interpret=_interpret_mode(),
+    )(seed, qf, kf, vf, gf, lse, delta)
+
+
 def _flash_bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
     q, k, v, seed, o, lse = residuals  # lse: (BH, T) — see _flash_fwd_rule
     B, H, T, D = q.shape
@@ -358,6 +419,15 @@ def _flash_bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
     lse = jnp.broadcast_to(lse[:, :, None], (BH, T, LANES))
     qf, kf, vf = (t.reshape(BH, T, D) for t in (q, k, v))
     gf = g.reshape(BH, T, D)
+
+    if T == block_q and T == block_k:
+        # single-tile case: one fused launch computes dq, dk, dv together
+        dq, dk, dv = _flash_bwd_fused(
+            scale, causal, block_q, block_k, dropout_rate,
+            seed, qf, kf, vf, gf, lse, delta, BH, T, D, q.dtype)
+        shape = (B, H, T, D)
+        return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape),
+                None)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, seq_len=T,

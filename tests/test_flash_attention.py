@@ -313,3 +313,29 @@ def test_auto_tile_512_parity_and_grads():
                                block_q=128, block_k=128)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5,
                                rtol=2e-5)
+
+
+def test_fused_single_tile_bwd_matches_split_kernels():
+    """T == block triggers the fused dq/dk/dv backward; forcing smaller
+    blocks runs the split dq + dkv kernels. Gradients must agree (same
+    tile math, different launch structure), with and without dropout."""
+    B, H, T, D = 2, 3, 256, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, T, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, T, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, T, D), jnp.float32)
+
+    def grads(block, rate):
+        def loss(q, k, v):
+            kw = dict(causal=True, block_q=block, block_k=block)
+            if rate > 0:
+                kw.update(dropout_rate=rate,
+                          dropout_rng=jax.random.PRNGKey(7))
+            return jnp.sum(pallas_flash_attention(q, k, v, **kw) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    for rate in (0.0, 0.2):
+        fused = grads(T, rate)        # single tile -> fused kernel
+        split = grads(T // 2, rate)   # 2x2 tiles -> split dq + dkv kernels
+        for a, b in zip(fused, split):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
