@@ -203,10 +203,11 @@ def _bwd_ds_tile(p, do, v, delta, *, scale, z):
 
 def _dkv_tile(q, k, v, do, lse, delta, *, scale, causal, q_first, k_first,
               block_q, block_k, seed, bh, dropout_rate):
-    """(dk, dv) contributions of one tile. The dropout stream keys off
-    absolute (seed, bh, q-pos, k-pos), so kv-major loops regenerate the
-    exact forward mask. Matmuls on native dtype with f32 accumulation
-    (see _fwd_tile)."""
+    """(dk, dv) contributions of one tile, plus the ds tile (cast to the
+    operand dtype) so fully-fused callers can derive dq from the same
+    recompute. The dropout stream keys off absolute (seed, bh, q-pos,
+    k-pos), so kv-major loops regenerate the exact forward mask. Matmuls
+    on native dtype with f32 accumulation (see _fwd_tile)."""
     z = (_dropout_mult(seed, bh, q_first, k_first, block_q, block_k,
                        dropout_rate) if dropout_rate > 0.0 else None)
     p = _bwd_p_tile(q, k, lse, scale=scale, causal=causal, q_first=q_first,
@@ -214,10 +215,10 @@ def _dkv_tile(q, k, v, do, lse, delta, *, scale, causal, q_first, k_first,
     dv_c = jax.lax.dot_general(
         (p * z if z is not None else p).astype(do.dtype), do,
         (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    ds = _bwd_ds_tile(p, do, v, delta, scale=scale, z=z)
-    dk_c = jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+    dsc = _bwd_ds_tile(p, do, v, delta, scale=scale, z=z).astype(q.dtype)
+    dk_c = jax.lax.dot_general(dsc, q, (((0,), (0,)), ((), ())),
                                preferred_element_type=jnp.float32)
-    return dk_c, dv_c
+    return dk_c, dv_c, dsc
 
 
 # ---------------------------------------------------------------------------
@@ -338,7 +339,7 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         do = do_ref[pl.ds(jb * block_q, block_q), :]
         lse = lse_ref[pl.ds(jb * block_q, block_q), :][:, :1]
         delta = delta_ref[pl.ds(jb * block_q, block_q), :][:, :1]
-        dk_c, dv_c = _dkv_tile(q, k, v, do, lse, delta, scale=scale,
+        dk_c, dv_c, _ = _dkv_tile(q, k, v, do, lse, delta, scale=scale,
                                causal=causal, q_first=jb * block_q,
                                k_first=k_first, block_q=block_q,
                                block_k=block_k, seed=seed_ref[0], bh=i,
@@ -370,17 +371,12 @@ def _bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     do = do_ref[...]
     lse = lse_ref[...][:, :1]
     delta = delta_ref[...][:, :1]
-    z = (_dropout_mult(seed_ref[0], i, 0, 0, block_q, block_k,
-                       dropout_rate) if dropout_rate > 0.0 else None)
-    p = _bwd_p_tile(q, k, lse, scale=scale, causal=causal, q_first=0,
-                    k_first=0, block_q=block_q, block_k=block_k)
-    dv = jax.lax.dot_general(
-        (p * z if z is not None else p).astype(do.dtype), do,
-        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    ds = _bwd_ds_tile(p, do, v, delta, scale=scale, z=z).astype(k.dtype)
-    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+    dk, dv, dsc = _dkv_tile(q, k, v, do, lse, delta, scale=scale,
+                            causal=causal, q_first=0, k_first=0,
+                            block_q=block_q, block_k=block_k,
+                            seed=seed_ref[0], bh=i,
+                            dropout_rate=dropout_rate)
+    dq = jax.lax.dot_general(dsc, k, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
     dq_ref[...] = dq.astype(dq_ref.dtype)
     dk_ref[...] = dk.astype(dk_ref.dtype)
@@ -405,6 +401,92 @@ def _flash_bwd_fused(scale, causal, block_q, block_k, dropout_rate,
     )(seed, qf, kf, vf, gf, lse, delta)
 
 
+# dq scratch bound for the kv-major fused backward. The kernel's VMEM
+# footprint per program is the full-T q/do/lse/delta blocks (~1.3 kB/row
+# at D=64) PLUS this (T, D) f32 scratch and the full-T dq output block;
+# 1 MiB of scratch (T<=4096 at D=64) keeps the total comfortably inside
+# what the resident family is measured to compile, and leaves the split
+# kernels reachable for longer resident sequences (T in (4k, 16k])
+FUSED_DQ_SCRATCH_BYTES = 1024 * 1024
+
+
+def _bwd_fused_multi_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                            delta_ref, dq_ref, dk_ref, dv_ref, dq_acc_ref,
+                            *, scale, causal, seq_len, block_q, block_k,
+                            dropout_rate):
+    """kv-major fully-fused backward: one kernel computes dq, dk AND dv,
+    sharing every tile's p/ds recompute (the split dq + dkv kernels each
+    rebuild them). dq accumulates into a per-(batch, head) (T, D) f32
+    VMEM scratch — safe because TPU grids execute sequentially — and is
+    written out on the last kv step. Causal q-loop starts at the first
+    q tile that can see this kv block (same skip as _bwd_dkv_kernel)."""
+    i = pl.program_id(0)
+    kb = pl.program_id(1)
+    n_kv = seq_len // block_k
+    k = k_ref[...]
+    v = v_ref[...]
+    k_first = kb * block_k
+    n_q = seq_len // block_q
+    first_q = (k_first // block_q) if causal else 0
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    def body(jb, carry):
+        dk, dv = carry
+        q_first = jb * block_q
+        q = q_ref[pl.ds(q_first, block_q), :]
+        do = do_ref[pl.ds(q_first, block_q), :]
+        lse = lse_ref[pl.ds(q_first, block_q), :][:, :1]
+        delta = delta_ref[pl.ds(q_first, block_q), :][:, :1]
+        dk_c, dv_c, dsc = _dkv_tile(q, k, v, do, lse, delta, scale=scale,
+                                    causal=causal, q_first=q_first,
+                                    k_first=k_first, block_q=block_q,
+                                    block_k=block_k, seed=seed_ref[0],
+                                    bh=i, dropout_rate=dropout_rate)
+        dq_c = jax.lax.dot_general(dsc, k, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        dq_acc_ref[pl.ds(q_first, block_q), :] = (
+            dq_acc_ref[pl.ds(q_first, block_q), :] + dq_c)
+        return dk + dk_c, dv + dv_c
+
+    dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_q, n_q, body, (dk0, jnp.zeros_like(dk0)))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+    @pl.when(kb == n_kv - 1)
+    def _finalize():
+        dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_fused_multi(scale, causal, block_q, block_k, dropout_rate,
+                           seed, qf, kf, vf, gf, lse, delta, BH, T, D,
+                           dtype):
+    kernel = functools.partial(
+        _bwd_fused_multi_kernel, scale=scale, causal=causal, seq_len=T,
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
+    spec_full = _vmem_spec((None, T, D), lambda i, kb: (i, 0, 0))
+    spec_kv = _vmem_spec((None, block_k, D), lambda i, kb: (i, kb, 0))
+    spec_tl = _vmem_spec((None, T, LANES), lambda i, kb: (i, 0, 0))
+    kw = {}
+    cp = _compiler_params(1, 2)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, T // block_k),
+        in_specs=[_smem_spec(), spec_full, spec_kv, spec_kv, spec_full,
+                  spec_tl, spec_tl],
+        out_specs=[spec_full, spec_kv, spec_kv],
+        out_shape=[jax.ShapeDtypeStruct((BH, T, D), dtype)] * 3,
+        scratch_shapes=[_scratch((T, D))],
+        interpret=_interpret_mode(),
+        **kw,
+    )(seed, qf, kf, vf, gf, lse, delta)
+
+
 def _flash_bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
     q, k, v, seed, o, lse = residuals  # lse: (BH, T) — see _flash_fwd_rule
     B, H, T, D = q.shape
@@ -423,6 +505,19 @@ def _flash_bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
     if T == block_q and T == block_k:
         # single-tile case: one fused launch computes dq, dk, dv together
         dq, dk, dv = _flash_bwd_fused(
+            scale, causal, block_q, block_k, dropout_rate,
+            seed, qf, kf, vf, gf, lse, delta, BH, T, D, q.dtype)
+        shape = (B, H, T, D)
+        return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape),
+                None)
+
+    if pltpu is not None and T * D * 4 <= FUSED_DQ_SCRATCH_BYTES:
+        # multi-tile but the (T, D) f32 dq scratch fits VMEM: kv-major
+        # fully-fused backward — one launch and one p/ds recompute per
+        # tile instead of two of each (split kernels below remain for
+        # longer resident sequences, and for pure-CPU installs where
+        # pltpu — and so VMEM scratch — is unavailable)
+        dq, dk, dv = _flash_bwd_fused_multi(
             scale, causal, block_q, block_k, dropout_rate,
             seed, qf, kf, vf, gf, lse, delta, BH, T, D, q.dtype)
         shape = (B, H, T, D)
@@ -639,7 +734,7 @@ def _bwd_dkv_kernel_stream(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(needed)
     def _update():
-        dk_c, dv_c = _dkv_tile(
+        dk_c, dv_c, _ = _dkv_tile(
             q_ref[...], k_ref[...], v_ref[...], do_ref[...],
             lse_ref[...][:, :1], delta_ref[...][:, :1], scale=scale,
             causal=causal, q_first=q_first, k_first=k_first,
@@ -861,7 +956,7 @@ def _bwd_dkv_kernel_tri(tmap_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    dk_c, dv_c = _dkv_tile(
+    dk_c, dv_c, _ = _dkv_tile(
         q_ref[...], k_ref[...], v_ref[...], do_ref[...],
         lse_ref[...][:, :1], delta_ref[...][:, :1], scale=scale,
         causal=True, q_first=q_first, k_first=k_first, block_q=block,
@@ -1218,7 +1313,7 @@ def _chunk_bwd_dkv_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
         do = do_ref[pl.ds(jb * block_q, block_q), :]
         lse = lse_ref[pl.ds(jb * block_q, block_q), :][:, :1]
         deltap = deltap_ref[pl.ds(jb * block_q, block_q), :][:, :1]
-        dk_c, dv_c = _dkv_tile(q, k, v, do, lse, deltap, scale=scale,
+        dk_c, dv_c, _ = _dkv_tile(q, k, v, do, lse, deltap, scale=scale,
                                causal=causal,
                                q_first=off_ref[0] + jb * block_q,
                                k_first=k_first,

@@ -339,3 +339,35 @@ def test_fused_single_tile_bwd_matches_split_kernels():
         for a, b in zip(fused, split):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
+
+
+def test_fused_multi_tile_bwd_matches_split_kernels():
+    """The kv-major fully-fused backward (1 < n_tiles, dq in VMEM
+    scratch) must match the split dq + dkv kernels; forcing tiny blocks
+    at T big enough to exceed the scratch bound runs the split path."""
+    from replicatinggpt_tpu.ops import flash_pallas as fp
+
+    B, H, T, D = 2, 2, 512, 64
+    q = jax.random.normal(jax.random.PRNGKey(3), (B, H, T, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(4), (B, H, T, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(5), (B, H, T, D), jnp.float32)
+
+    def grads(rate, scratch_bytes):
+        old = fp.FUSED_DQ_SCRATCH_BYTES
+        fp.FUSED_DQ_SCRATCH_BYTES = scratch_bytes
+        try:
+            def loss(q, k, v):
+                kw = dict(causal=True, block_q=128, block_k=128)
+                if rate > 0:
+                    kw.update(dropout_rate=rate,
+                              dropout_rng=jax.random.PRNGKey(11))
+                return jnp.sum(pallas_flash_attention(q, k, v, **kw) ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        finally:
+            fp.FUSED_DQ_SCRATCH_BYTES = old
+    for rate in (0.0, 0.2):
+        fused = grads(rate, fp.FUSED_DQ_SCRATCH_BYTES)  # multi-tile fused
+        split = grads(rate, 0)                           # forced split
+        for a, b in zip(fused, split):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
