@@ -410,81 +410,40 @@ def _flash_bwd_fused(scale, causal, block_q, block_k, dropout_rate,
 FUSED_DQ_SCRATCH_BYTES = 1024 * 1024
 
 
-def _bwd_fused_multi_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                            delta_ref, dq_ref, dk_ref, dv_ref, dq_acc_ref,
-                            *, scale, causal, seq_len, block_q, block_k,
-                            dropout_rate):
-    """kv-major fully-fused backward: one kernel computes dq, dk AND dv,
-    sharing every tile's p/ds recompute (the split dq + dkv kernels each
-    rebuild them). dq accumulates into a per-(batch, head) (T, D) f32
-    VMEM scratch — safe because TPU grids execute sequentially — and is
-    written out on the last kv step. Causal q-loop starts at the first
-    q tile that can see this kv block (same skip as _bwd_dkv_kernel)."""
-    i = pl.program_id(0)
-    kb = pl.program_id(1)
-    n_kv = seq_len // block_k
-    k = k_ref[...]
-    v = v_ref[...]
-    k_first = kb * block_k
-    n_q = seq_len // block_q
-    first_q = (k_first // block_q) if causal else 0
-
-    @pl.when(kb == 0)
-    def _init():
-        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
-
-    def body(jb, carry):
-        dk, dv = carry
-        q_first = jb * block_q
-        q = q_ref[pl.ds(q_first, block_q), :]
-        do = do_ref[pl.ds(q_first, block_q), :]
-        lse = lse_ref[pl.ds(q_first, block_q), :][:, :1]
-        delta = delta_ref[pl.ds(q_first, block_q), :][:, :1]
-        dk_c, dv_c, dsc = _dkv_tile(q, k, v, do, lse, delta, scale=scale,
-                                    causal=causal, q_first=q_first,
-                                    k_first=k_first, block_q=block_q,
-                                    block_k=block_k, seed=seed_ref[0],
-                                    bh=i, dropout_rate=dropout_rate)
-        dq_c = jax.lax.dot_general(dsc, k, (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
-        dq_acc_ref[pl.ds(q_first, block_q), :] = (
-            dq_acc_ref[pl.ds(q_first, block_q), :] + dq_c)
-        return dk + dk_c, dv + dv_c
-
-    dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(first_q, n_q, body, (dk0, jnp.zeros_like(dk0)))
-    dk_ref[...] = dk.astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
-
-    @pl.when(kb == n_kv - 1)
-    def _finalize():
-        dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
-
-
-def _flash_bwd_fused_multi(scale, causal, block_q, block_k, dropout_rate,
-                           seed, qf, kf, vf, gf, lse, delta, BH, T, D,
-                           dtype):
+def _fused_kv_major_bwd(scale, causal, block_q, block_k, dropout_rate,
+                        seed, offs, qf, kf, vf, gf, lse, delta,
+                        BH, Tq, Tk, D, dtype):
+    """Shared kv-major fully-fused backward launch: one kernel computes
+    dq, dk AND dv with a (Tq, D) f32 dq scratch (see
+    _chunk_bwd_fused_kernel). The resident family is exactly the
+    offs == (0, 0, 0), Tq == Tk special case — one kernel serves both
+    the per-layer and ring-hop gradient paths."""
     kernel = functools.partial(
-        _bwd_fused_multi_kernel, scale=scale, causal=causal, seq_len=T,
-        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
-    spec_full = _vmem_spec((None, T, D), lambda i, kb: (i, 0, 0))
+        _chunk_bwd_fused_kernel, scale=scale, causal=causal,
+        seq_len_q=Tq, seq_len_k=Tk, block_q=block_q, block_k=block_k,
+        dropout_rate=dropout_rate)
+    spec_q = _vmem_spec((None, Tq, D), lambda i, kb: (i, 0, 0))
     spec_kv = _vmem_spec((None, block_k, D), lambda i, kb: (i, kb, 0))
-    spec_tl = _vmem_spec((None, T, LANES), lambda i, kb: (i, 0, 0))
+    spec_tl = _vmem_spec((None, Tq, LANES), lambda i, kb: (i, 0, 0))
     kw = {}
     cp = _compiler_params(1, 2)
     if cp is not None:
         kw["compiler_params"] = cp
     return pl.pallas_call(
         kernel,
-        grid=(BH, T // block_k),
-        in_specs=[_smem_spec(), spec_full, spec_kv, spec_kv, spec_full,
-                  spec_tl, spec_tl],
-        out_specs=[spec_full, spec_kv, spec_kv],
-        out_shape=[jax.ShapeDtypeStruct((BH, T, D), dtype)] * 3,
-        scratch_shapes=[_scratch((T, D))],
+        grid=(BH, Tk // block_k),
+        in_specs=[_smem_spec(), _smem_spec(), spec_q, spec_kv, spec_kv,
+                  spec_q, spec_tl, spec_tl],
+        out_specs=[spec_q, spec_kv, spec_kv],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), dtype),
+        ],
+        scratch_shapes=[_scratch((Tq, D))],
         interpret=_interpret_mode(),
         **kw,
-    )(seed, qf, kf, vf, gf, lse, delta)
+    )(seed, offs, qf, kf, vf, gf, lse, delta)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
@@ -517,9 +476,10 @@ def _flash_bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
         # tile instead of two of each (split kernels below remain for
         # longer resident sequences, and for pure-CPU installs where
         # pltpu — and so VMEM scratch — is unavailable)
-        dq, dk, dv = _flash_bwd_fused_multi(
+        dq, dk, dv = _fused_kv_major_bwd(
             scale, causal, block_q, block_k, dropout_rate,
-            seed, qf, kf, vf, gf, lse, delta, BH, T, D, q.dtype)
+            seed, jnp.zeros((3,), jnp.int32), qf, kf, vf, gf, lse, delta,
+            BH, T, T, D, q.dtype)
         shape = (B, H, T, D)
         return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape),
                 None)
@@ -1376,6 +1336,62 @@ def _flash_chunk_fwd_rule(q, k, v, seed, offs, scale, causal, block_q,
     return (o, lse), (q, k, v, seed, offs, o, lse)
 
 
+def _chunk_bwd_fused_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
+                            lse_ref, deltap_ref, dq_ref, dk_ref, dv_ref,
+                            dq_acc_ref, *, scale, causal, seq_len_q,
+                            seq_len_k, block_q, block_k, dropout_rate):
+    """kv-major fully-fused chunk backward (the ring-hop gradient path):
+    same structure as _bwd_fused_multi_kernel — dq accumulates in a
+    (Tq, D) f32 VMEM scratch across the sequential grid, dk/dv write per
+    kv block, and every tile's p/ds recompute (through _dkv_tile, the
+    shared math) serves all three gradients. Global-position causal skip
+    identical to _chunk_bwd_dkv_kernel."""
+    i = pl.program_id(0)
+    kb = pl.program_id(1)
+    n_kv = seq_len_k // block_k
+    k = k_ref[...]
+    v = v_ref[...]
+    k_first = off_ref[1] + kb * block_k
+    n_q = seq_len_q // block_q
+    if causal:
+        jb0 = jnp.clip((k_first - off_ref[0]) // block_q, 0, n_q)
+    else:
+        jb0 = 0
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    def body(jb, carry):
+        dk, dv = carry
+        q_first = jb * block_q
+        q = q_ref[pl.ds(q_first, block_q), :]
+        do = do_ref[pl.ds(q_first, block_q), :]
+        lse = lse_ref[pl.ds(q_first, block_q), :][:, :1]
+        deltap = deltap_ref[pl.ds(q_first, block_q), :][:, :1]
+        dk_c, dv_c, dsc = _dkv_tile(q, k, v, do, lse, deltap, scale=scale,
+                                    causal=causal,
+                                    q_first=off_ref[0] + q_first,
+                                    k_first=k_first, block_q=block_q,
+                                    block_k=block_k, seed=seed_ref[0],
+                                    bh=off_ref[2] + i,
+                                    dropout_rate=dropout_rate)
+        dq_c = jax.lax.dot_general(dsc, k, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        dq_acc_ref[pl.ds(q_first, block_q), :] = (
+            dq_acc_ref[pl.ds(q_first, block_q), :] + dq_c)
+        return dk + dk_c, dv + dv_c
+
+    dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(jb0, n_q, body, (dk0, jnp.zeros_like(dk0)))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+    @pl.when(kb == n_kv - 1)
+    def _finalize():
+        dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
 def _flash_chunk_bwd_rule(scale, causal, block_q, block_k, dropout_rate,
                           residuals, g):
     q, k, v, seed, offs, o, lse = residuals
@@ -1397,6 +1413,18 @@ def _flash_chunk_bwd_rule(scale, causal, block_q, block_k, dropout_rate,
     kf = k.reshape(BH, Tk, D)
     vf = v.reshape(BH, Tk, D)
     gf = do.reshape(BH, Tq, D)
+
+    if pltpu is not None and Tq * D * 4 <= FUSED_DQ_SCRATCH_BYTES:
+        # one fused kv-major launch (see _chunk_bwd_fused_kernel); the
+        # split kernels below remain for long chunks and pltpu-less runs
+        dq, dk, dv = _fused_kv_major_bwd(
+            scale, causal, block_q, block_k, dropout_rate,
+            seed, offs, qf, kf, vf, gf, lse_b, deltap,
+            BH, Tq, Tk, D, q.dtype)
+        shape_q = (B, H, Tq, D)
+        shape_k = (B, H, Tk, D)
+        return (dq.reshape(shape_q), dk.reshape(shape_k),
+                dv.reshape(shape_k), None, None)
 
     dq = pl.pallas_call(
         functools.partial(

@@ -318,3 +318,44 @@ def test_train_step_with_sequence_parallelism(impl):
     loss = float(jax.device_get(metrics["loss"]))
     assert np.isfinite(loss)
     np.testing.assert_allclose(loss, float(m0["loss"]), atol=1e-4, rtol=1e-4)
+
+
+def test_chunk_fused_bwd_matches_split_kernels():
+    """The kv-major fused chunk backward (default within the dq-scratch
+    bound) must match the split dq + dkv chunk kernels — multi-kv-tile
+    shapes, runtime offsets (incl. a partially-masked hop), dropout, and
+    a loss that feeds both o and lse cotangents."""
+    from replicatinggpt_tpu.ops import flash_pallas as fp
+
+    B, H, Tq, Tk, D = 1, 2, 256, 256, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, Tq, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, Tk, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, Tk, D), jnp.float32)
+
+    def grads(q_off, rate, scratch_bytes):
+        old = fp.FUSED_DQ_SCRATCH_BYTES
+        fp.FUSED_DQ_SCRATCH_BYTES = scratch_bytes
+        try:
+            def loss(q, k, v):
+                kw = dict(q_offset=jnp.int32(q_off),
+                          k_offset=jnp.int32(0),
+                          block_q=128, block_k=128)
+                if rate > 0:
+                    kw.update(dropout_rate=rate,
+                              dropout_rng=jax.random.PRNGKey(9))
+                o, lse = fp.pallas_flash_chunk(q, k, v, **kw)
+                safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+                return jnp.sum(o ** 2) + 0.1 * jnp.sum(safe ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        finally:
+            fp.FUSED_DQ_SCRATCH_BYTES = old
+
+    # fully visible hop; partially masked hop; diagonal self-hop (q_off=0
+    # drives the causal q-tile skip jb0 >= 1 for the later kv blocks)
+    for q_off in (Tk, 128, 0):
+        for rate in (0.0, 0.2):
+            fused = grads(q_off, rate, fp.FUSED_DQ_SCRATCH_BYTES)
+            split = grads(q_off, rate, 0)
+            for a, b in zip(fused, split):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-4, atol=2e-4)
