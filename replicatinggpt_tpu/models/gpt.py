@@ -157,17 +157,17 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
             # seq-parallel impls ('ring'/'ulysses') only exist as sharded
             # wrappers (parallel/ring_attention.py, parallel/ulysses.py)
             # passed in via attention_fn; locally they degrade to the
-            # dense/flash choice. Measured crossover on v5e with
-            # auto-sized tiles (flash_pallas._auto_block): flash wins from
-            # T=256 up (19.2 vs 19.7 ms/step on the char-GPT workload;
-            # 2.3x kernel speedup at 512x512 tiles made the old T>=1024
-            # threshold stale). Only the T threshold lives here;
-            # kernel-envelope and dropout fallbacks belong to
-            # full_causal_attention/_pallas_supported (one source of
-            # truth — attention-weight dropout runs in-kernel on the
-            # Pallas path, and degrades to dense einsum elsewhere).
+            # dense/flash choice. FLASH_MIN_T is the measured v5e
+            # crossover (19.2 vs 19.7 ms/step on the char-GPT workload at
+            # T=256; 2.3x kernel speedup at 512x512 auto tiles made the
+            # old T>=1024 threshold stale). Kernel-envelope and dropout
+            # fallbacks belong to full_causal_attention/_pallas_supported
+            # (one source of truth — attention-weight dropout runs
+            # in-kernel on the Pallas path, and degrades to dense einsum
+            # elsewhere).
+            from ..ops.flash_attention import FLASH_MIN_T
             T = q.shape[2]
-            impl = "flash" if T >= 256 else "einsum"
+            impl = "flash" if T >= FLASH_MIN_T else "einsum"
         attn = full_causal_attention(
             q, k, v, dropout_rate=cfg.attn_dropout, rng=r_attn, train=train,
             impl=impl)

@@ -17,6 +17,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+# Measured 'auto' flash/einsum crossover on v5e with auto-sized tiles
+# (benchmarks/RESULTS.md): flash wins from T=256 up. Single source of
+# truth for the local policy in models.gpt._block AND the mesh wrapper
+# in parallel/sharded_flash.py — re-tune it here only.
+FLASH_MIN_T = 256
+
 
 def _xla_sdpa(q, k, v, scale, causal):
     # (B,H,T,D) -> jax.nn.dot_product_attention wants (B,T,H,D)

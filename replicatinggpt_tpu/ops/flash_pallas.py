@@ -1340,12 +1340,13 @@ def _chunk_bwd_fused_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
                             lse_ref, deltap_ref, dq_ref, dk_ref, dv_ref,
                             dq_acc_ref, *, scale, causal, seq_len_q,
                             seq_len_k, block_q, block_k, dropout_rate):
-    """kv-major fully-fused chunk backward (the ring-hop gradient path):
-    same structure as _bwd_fused_multi_kernel — dq accumulates in a
-    (Tq, D) f32 VMEM scratch across the sequential grid, dk/dv write per
-    kv block, and every tile's p/ds recompute (through _dkv_tile, the
-    shared math) serves all three gradients. Global-position causal skip
-    identical to _chunk_bwd_dkv_kernel."""
+    """kv-major fully-fused chunk backward (the ring-hop gradient path;
+    also serves the resident multi-tile path via _fused_kv_major_bwd with
+    zero offsets): dq accumulates in a (Tq, D) f32 VMEM scratch across
+    the sequential grid, dk/dv write per kv block, and every tile's p/ds
+    recompute (through _dkv_tile, the shared math) serves all three
+    gradients. Global-position causal skip identical to
+    _chunk_bwd_dkv_kernel."""
     i = pl.program_id(0)
     kb = pl.program_id(1)
     n_kv = seq_len_k // block_k

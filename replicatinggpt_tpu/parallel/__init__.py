@@ -2,33 +2,84 @@ from .mesh import (make_mesh, make_batch_sharding, batch_pspec, state_pspecs,
                    param_pspecs, shard_train_state)
 from .pipeline import make_pipeline_blocks_fn, pipeline_blocks
 from .ring_attention import make_ring_attention_fn, ring_attention
+from .sharded_flash import (make_sharded_flash_attention_fn,
+                            sharded_flash_attention)
 from .ulysses import make_ulysses_attention_fn, ulysses_attention
 
 __all__ = ["make_mesh", "make_batch_sharding", "batch_pspec", "state_pspecs",
            "param_pspecs", "shard_train_state", "ring_attention",
            "make_ring_attention_fn", "ulysses_attention",
-           "make_ulysses_attention_fn", "select_attention_fn",
+           "make_ulysses_attention_fn", "sharded_flash_attention",
+           "make_sharded_flash_attention_fn", "select_attention_fn",
            "pipeline_blocks", "make_pipeline_blocks_fn", "select_blocks_fn"]
 
 
 def select_attention_fn(mcfg, mesh_cfg, mesh):
-    """Pick the sequence-parallel attention core for a (config, mesh) pair.
+    """Pick the mesh-aware attention core for a (config, mesh) pair.
 
-    Returns None — use the local einsum/flash core, GSPMD handles any
-    sharding (including gathering a seq-sharded KV) — unless the mesh
-    shards the sequence axis AND the configured impl opts into an explicit
-    seq-parallel core. 'ulysses' / 'ring' select their path directly;
-    'auto' is measurement-driven (benchmarks/seq_parallel_bench.py →
-    benchmarks/SEQ_PARALLEL.md): Ulysses whenever the head count divides
-    by the seq axis — 1.7-2.2x faster fwd+bwd on the 8-way virtual mesh at
-    T∈{4k,8k}, ~n/2x less collective traffic analytically, and its local
-    core sees the full sequence so the Pallas flash kernel applies — ring
-    otherwise (no head-divisibility constraint). An explicit 'einsum' or
-    'flash' is respected as-is.
+    Two regimes:
+
+    - 'seq' axis > 1: an explicit sequence-parallel core. 'ulysses' /
+      'ring' select their path directly; 'auto' is measurement-driven
+      (benchmarks/seq_parallel_bench.py → benchmarks/SEQ_PARALLEL.md):
+      Ulysses whenever the head count divides by the seq axis — 1.7-2.2x
+      faster fwd+bwd on the 8-way virtual mesh at T∈{4k,8k}, ~n/2x less
+      collective traffic analytically, and its local core sees the full
+      sequence so the Pallas flash kernel applies — ring otherwise (no
+      head-divisibility constraint).
+    - no 'seq' axis (pure DP / FSDP / TP): the batch/head-parallel
+      shard_map flash wrapper (parallel/sharded_flash.py) whenever the
+      local policy would pick the Pallas kernel — TPU backend, T at or
+      past the measured flash crossover, local heads divisible by the
+      'model' axis. Without it, mesh runs would have to degrade to dense
+      O(T²) einsum because pallas_call has no GSPMD partitioning rule.
+      An explicit attention_impl='flash' forces the wrapper on any
+      backend (the local core still falls back to SDPA/einsum off-TPU,
+      so virtual-mesh dryruns exercise the same program structure).
+
+    Returns None when plain GSPMD on the einsum core is the right
+    answer: no mesh, explicit 'einsum', or sub-crossover sequence
+    lengths off the Pallas envelope.
     """
-    if mesh is None or mesh_cfg.seq <= 1:
+    if mesh is None:
         return None
+    if mesh_cfg.seq <= 1:
+        import jax
+
+        from ..ops.flash_attention import FLASH_MIN_T
+        impl = mcfg.attention_impl
+        if impl in ("auto", "ring", "ulysses"):
+            # ring/ulysses need a seq axis; without one they mean 'auto'.
+            # Conservative gates for 'auto': TP-indivisible heads would
+            # make the wrapper gather heads per call, and off-TPU /
+            # sub-crossover T the kernel wouldn't run anyway — plain
+            # GSPMD einsum is the right core for all of those.
+            on_tpu = jax.default_backend() == "tpu"
+            if (not on_tpu or mcfg.block_size < FLASH_MIN_T
+                    or (mesh_cfg.model > 1
+                        and mcfg.n_head % mesh_cfg.model != 0)):
+                return None
+            impl = "flash"
+        if impl == "flash":
+            # Explicit 'flash' always wraps — the wrapper self-guards
+            # against indivisible batch/head dims (dropping the axis from
+            # its specs rather than degrading the whole run to dense
+            # einsum). A resolved 'auto' keeps the per-T crossover policy
+            # in the local core.
+            local = ("flash" if mcfg.attention_impl == "flash" else "auto")
+            fn = make_sharded_flash_attention_fn(
+                mesh, impl=local, dropout_rate=mcfg.attn_dropout)
+            fn.impl_name = "shard_map-flash"
+            return fn
+        return None  # explicit 'einsum'
     impl = mcfg.attention_impl
+    if impl == "flash":
+        # seq-sharded mesh: the memory-efficient request is honored by a
+        # seq-parallel core whose local core is the flash kernel — a bare
+        # pallas_call can't partition over 'seq', and degrading to dense
+        # GSPMD einsum would materialize the O(T^2) weights the user
+        # explicitly opted out of
+        impl = "auto"
     if impl == "auto":
         # Ulysses shards local heads over 'seq'; heads may already be
         # sharded over 'model' (TP), so the constraint is on local heads
@@ -40,11 +91,14 @@ def select_attention_fn(mcfg, mesh_cfg, mesh):
         # interpreter is too slow to be a win off-TPU)
         import jax
         local = "flash" if jax.default_backend() == "tpu" else "einsum"
-        return make_ulysses_attention_fn(mesh, impl=local,
-                                         dropout_rate=mcfg.attn_dropout)
+        fn = make_ulysses_attention_fn(mesh, impl=local,
+                                       dropout_rate=mcfg.attn_dropout)
+        fn.impl_name = "ulysses"
+        return fn
     if impl == "ring":
-        return make_ring_attention_fn(mesh,
-                                      dropout_rate=mcfg.attn_dropout)
+        fn = make_ring_attention_fn(mesh, dropout_rate=mcfg.attn_dropout)
+        fn.impl_name = "ring"
+        return fn
     return None
 
 

@@ -1,0 +1,108 @@
+"""Batch/head-parallel flash attention for meshes without a 'seq' axis.
+
+The Pallas flash kernel (ops/flash_pallas.py) has no GSPMD partitioning
+rule, so a jit-sharded program cannot call ``pallas_call`` directly — the
+compiler would have to either replicate the kernel (wrong numbers) or fail
+to lower. Sequence-parallel runs already solve this with explicit shard_map
+regions (parallel/ring_attention.py, parallel/ulysses.py); this module is
+the same move for the remaining — and most common — mesh shapes: pure DP,
+FSDP, and TP, where attention is embarrassingly parallel per device
+(batch sharded over 'data', heads over 'model', full sequence local).
+
+The body runs the ordinary local attention core: the Pallas flash kernel
+on TPU (the whole point — BASELINE configs 3/4 train at T=1024 where flash
+is worth tens of percent, benchmarks/RESULTS.md), XLA SDPA / einsum
+elsewhere. Attention-weight dropout decorrelates per (data, model) shard
+by folding the device indices into the rng, mirroring the Ulysses wrapper.
+
+The reference never loses its flash path on its device
+(/root/reference/GPT-2.py:46); with this wrapper, neither do mesh runs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import full_causal_attention
+from ..ops.flash_attention import FLASH_MIN_T
+
+
+def _local_attention(q, k, v, key=None, *, scale: Optional[float],
+                     dropout_rate: float, impl: str, batch_axis, head_axis):
+    """Per-device body: plain causal attention over the local
+    (B/data, H/model, T, D) shard — no collectives; causality is exact
+    because the full sequence is local. The rng folds in only the mesh
+    axes that actually partition the block (devices along an unused axis
+    compute identical replicated outputs and must stay bit-identical)."""
+    if impl == "auto":
+        impl = "flash" if q.shape[2] >= FLASH_MIN_T else "einsum"
+    if key is not None:
+        shard = jax.lax.axis_index(batch_axis) if batch_axis else 0
+        if head_axis:
+            shard = (shard * jax.lax.axis_size(head_axis)
+                     + jax.lax.axis_index(head_axis))
+        key = jax.random.fold_in(key, shard)
+    return full_causal_attention(q, k, v, scale=scale, impl=impl,
+                                 dropout_rate=dropout_rate, rng=key,
+                                 train=key is not None)
+
+
+def sharded_flash_attention(q, k, v, *, mesh: Mesh,
+                            scale: Optional[float] = None,
+                            impl: str = "auto",
+                            dropout_rate: float = 0.0,
+                            rng: Optional[jax.Array] = None,
+                            train: bool = False):
+    """Causal attention on a mesh whose 'seq' axis is 1.
+
+    q, k, v: global (B, H, T, D) with B sharded over 'data' and H over
+    'model' (the layout GSPMD produces from the batch sharding and the
+    Megatron column-parallel qkv projection, parallel/mesh.py). Same
+    attention_fn contract as the ring/Ulysses wrappers, including
+    in-core attention-weight dropout.
+
+    Self-guarding on shard_map's even-division requirement: an axis whose
+    size does not divide the corresponding dim drops out of the specs
+    (the body then sees that dim whole, at the cost of a gather), and if
+    neither axis divides, the call falls back to the plain GSPMD einsum
+    core — the envelope the wrapper replaced.
+    """
+    data_n = mesh.shape.get("data", 1)
+    model_n = mesh.shape.get("model", 1)
+    batch_axis = "data" if (data_n > 1 and q.shape[0] % data_n == 0) else None
+    head_axis = "model" if (model_n > 1 and q.shape[1] % model_n == 0) \
+        else None
+    if batch_axis is None and head_axis is None and (data_n > 1
+                                                    or model_n > 1):
+        # nothing shard_map-able: preserve the pre-wrapper behavior
+        # (GSPMD einsum tolerates uneven sharding via padding)
+        return full_causal_attention(q, k, v, scale=scale, impl="einsum",
+                                     dropout_rate=dropout_rate, rng=rng,
+                                     train=train)
+    spec = P(batch_axis, head_axis, None, None)
+    local = functools.partial(_local_attention, scale=scale,
+                              dropout_rate=dropout_rate, impl=impl,
+                              batch_axis=batch_axis, head_axis=head_axis)
+    if not (train and dropout_rate > 0.0 and rng is not None):
+        fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+        return fn(q, k, v)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, P()),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v, rng)
+
+
+def make_sharded_flash_attention_fn(mesh: Mesh,
+                                    scale: Optional[float] = None,
+                                    impl: str = "auto",
+                                    dropout_rate: float = 0.0):
+    """attention_fn for ``models.gpt.forward`` / ``train.steps``."""
+    def attention_fn(q, k, v, rng=None, train=False):
+        return sharded_flash_attention(q, k, v, mesh=mesh, scale=scale,
+                                       impl=impl, dropout_rate=dropout_rate,
+                                       rng=rng, train=train)
+    return attention_fn

@@ -142,23 +142,40 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
         else:
             attention_fn = select_attention_fn(mcfg, cfg.mesh, mesh)
             if attention_fn is not None:
-                logger.log(f"sequence parallelism: seq axis {cfg.mesh.seq}, "
-                           f"impl {mcfg.attention_impl!r}")
+                # impl_name may differ from the configured impl ('auto'
+                # or explicit 'flash' route to ring/ulysses on a seq
+                # mesh; DP/FSDP/TP meshes get the shard_map wrapper)
+                resolved = getattr(attention_fn, "impl_name",
+                                   mcfg.attention_impl)
+                if cfg.mesh.seq > 1:
+                    logger.log(f"sequence parallelism: seq axis "
+                               f"{cfg.mesh.seq}, impl {resolved!r} "
+                               f"(configured {mcfg.attention_impl!r})")
+                else:
+                    axes = [a for a, n in (("data", cfg.mesh.data),
+                                           ("model", cfg.mesh.model))
+                            if n > 1] or ["data"]
+                    on_tpu = jax.default_backend() == "tpu"
+                    logger.log(f"mesh attention: {resolved!r} shard_map "
+                               f"wrapper over {tuple(axes)}; local core "
+                               + ("Pallas flash (SDPA/einsum off the "
+                                  "kernel envelope)" if on_tpu
+                                  else "SDPA/einsum (non-TPU backend)"))
     if (mesh is not None
             and mcfg.attention_impl in ("auto", "ring", "ulysses")
             and attention_fn is None and blocks_fn is None):
-        # pallas_call has no GSPMD partitioning rule: inside a sharded jit
-        # program the flash kernel may fail to lower (or silently
-        # replicate) — 'auto' must not pick it when a mesh is active and
-        # no seq-parallel wrapper owns the attention. Long context on a
-        # mesh belongs to ring/Ulysses (seq axis > 1) anyway; an explicit
-        # attention_impl='flash' is honored as the user's own call.
+        # No shard_map wrapper claimed the attention ('auto' off-TPU, at
+        # sub-crossover T, or with heads indivisible by the 'model'
+        # axis) — pin the local core to einsum so 'auto' can never
+        # resolve to a bare pallas_call inside the sharded jit program
+        # (the kernel has no GSPMD partitioning rule). Explicit 'flash'
+        # never reaches here: on an active mesh select_attention_fn
+        # always returns a wrapper for it (shard_map or seq-parallel).
         import dataclasses as dc
         prev_impl = mcfg.attention_impl
         mcfg = dc.replace(mcfg, attention_impl="einsum")
         logger.log(f"attention_impl {prev_impl!r} -> 'einsum': mesh run "
-                   "without a seq-parallel attention wrapper (the Pallas "
-                   "kernel has no GSPMD partitioning rule)")
+                   "where the shard_map flash wrapper does not apply")
     train_step = make_train_step(mcfg, tcfg, attention_fn=attention_fn,
                                  blocks_fn=blocks_fn)
     super_sharding = None

@@ -371,3 +371,38 @@ def test_fused_multi_tile_bwd_matches_split_kernels():
         for a, b in zip(fused, split):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_grads_match_einsum():
+    """bf16 inputs run the native-bf16 matmul tiles (p/ds cast to operand
+    dtype, f32 accumulation); gradients must track the einsum reference
+    within bf16 tolerance on BOTH backward families — fused (block == T)
+    and split (forced smaller blocks). Pins the bf16-specific precision
+    envelope the f32 parity tests can't see (ADVICE r2)."""
+    B, H, T, D = 2, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.bfloat16)
+               for kk in ks)
+
+    def flash_grads(block):
+        def loss(q, k, v):
+            out = pallas_flash_attention(q, k, v, causal=True,
+                                         block_q=block, block_k=block)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def ref_grads():
+        def loss(q, k, v):
+            out = full_causal_attention(q, k, v, impl="einsum")
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    gr = ref_grads()
+    for block in (T, T // 2):  # fused single-tile, then split kernels
+        gf = flash_grads(block)
+        for a, b in zip(gf, gr):
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            # bf16 has ~8 mantissa bits; grads here are O(1-30), so the
+            # elementwise band is dominated by the final bf16 rounding
+            np.testing.assert_allclose(a, b, rtol=6e-2, atol=0.25)

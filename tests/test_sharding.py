@@ -279,3 +279,153 @@ def test_grad_accum_on_mesh_matches_unsharded(tcfg):
             np.asarray(a), np.asarray(jax.device_get(b)), rtol=1e-4,
             atol=1e-5),
         s_un.params, s_sh.params)
+
+
+# ---------------------------------------------------------------------------
+# batch/head shard_map flash wrapper (parallel/sharded_flash.py) — the
+# DP/FSDP/TP mesh path that keeps the Pallas kernel instead of degrading
+# to dense einsum (VERDICT r2 item 1)
+# ---------------------------------------------------------------------------
+
+def _wrapper_qkv(B=8, H=4, T=256, D=32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, H, T, D), jnp.float32) for k in ks)
+
+
+def test_sharded_flash_wrapper_matches_einsum_interpret(monkeypatch):
+    """The shard_map wrapper running the *actual Pallas kernel* (interpret
+    mode on CPU) over a (data=4, model=2) mesh must match the unsharded
+    einsum core in outputs AND grads."""
+    from replicatinggpt_tpu.ops import flash_attention as fa
+    from replicatinggpt_tpu.ops.attention import full_causal_attention
+    from replicatinggpt_tpu.parallel.sharded_flash import \
+        sharded_flash_attention
+
+    monkeypatch.setattr(fa, "_pallas_supported", lambda q: True)
+    mesh = make_mesh(MeshConfig(data=4, seq=1, model=2))
+    q, k, v = _wrapper_qkv()
+
+    def loss_wrapped(q, k, v):
+        out = sharded_flash_attention(q, k, v, mesh=mesh, impl="flash")
+        return jnp.sum(out ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_causal_attention(q, k, v, impl="einsum") ** 2)
+
+    ref_out = full_causal_attention(q, k, v, impl="einsum")
+    got_out = sharded_flash_attention(q, k, v, mesh=mesh, impl="flash")
+    np.testing.assert_allclose(np.asarray(got_out), np.asarray(ref_out),
+                               atol=2e-5, rtol=2e-5)
+    gw = jax.grad(loss_wrapped, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gw, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5,
+                                   rtol=5e-5)
+
+
+def test_sharded_flash_wrapper_dropout_streams_decorrelate(monkeypatch):
+    """With attention dropout on, each (data, model) shard must draw an
+    independent mask stream (fold_in of the device indices): a replicated
+    batch row on different 'data' shards gets different masks."""
+    from replicatinggpt_tpu.ops import flash_attention as fa
+    from replicatinggpt_tpu.parallel.sharded_flash import \
+        sharded_flash_attention
+
+    monkeypatch.setattr(fa, "_pallas_supported", lambda q: False)
+    mesh = make_mesh(MeshConfig(data=4, seq=1, model=2))
+    q, k, v = _wrapper_qkv(B=4, H=2, T=64, D=16)
+    # identical rows across the batch: without per-shard folding, the
+    # dropout pattern would repeat across 'data' shards
+    q = jnp.broadcast_to(q[:1], q.shape)
+    k = jnp.broadcast_to(k[:1], k.shape)
+    v = jnp.broadcast_to(v[:1], v.shape)
+    out = sharded_flash_attention(q, k, v, mesh=mesh, impl="einsum",
+                                  dropout_rate=0.5,
+                                  rng=jax.random.PRNGKey(7), train=True)
+    out = np.asarray(out)
+    assert not np.allclose(out[0], out[1]), \
+        "data shards 0 and 1 drew identical dropout masks"
+
+
+def test_dp_training_with_flash_wrapper_matches_single_device(tcfg):
+    """DP training through the shard_map wrapper (explicit 'flash'; the
+    local core resolves to SDPA on CPU) must match single-device training
+    on the same global batch."""
+    mcfg = dataclasses.replace(TINY, attention_impl="flash")
+    t = dataclasses.replace(tcfg, lr=1e-3)
+    batch = _batch(mcfg, B=8)
+    state1 = _state_fn(mcfg, t)()
+    step1 = make_train_step(mcfg, t, donate=False)
+    losses1 = []
+    for _ in range(3):
+        state1, m = step1(state1, batch)
+        losses1.append(float(m["loss"]))
+
+    from replicatinggpt_tpu.parallel import select_attention_fn
+    mesh_cfg = MeshConfig(data=8)
+    mesh = make_mesh(mesh_cfg)
+    attn_fn = select_attention_fn(mcfg, mesh_cfg, mesh)
+    assert attn_fn is not None, "explicit 'flash' must select the wrapper"
+    state8 = shard_train_state(_state_fn(mcfg, t), mesh, mesh_cfg)
+    bs = make_batch_sharding(mesh)
+    batch8 = tuple(jax.device_put(np.asarray(b), bs) for b in batch)
+    step8 = make_train_step(mcfg, t, donate=False, attention_fn=attn_fn)
+    losses8 = []
+    for _ in range(3):
+        state8, m = step8(state8, batch8)
+        losses8.append(float(m["loss"]))
+    np.testing.assert_allclose(losses1, losses8, rtol=2e-4)
+
+
+def test_select_attention_fn_policy_no_seq_axis():
+    """Wrapper selection policy on meshes without a seq axis: explicit
+    'flash' always wraps (the wrapper self-guards indivisible dims);
+    'auto' wraps only on TPU (einsum under GSPMD is the CPU answer);
+    explicit 'einsum' never wraps."""
+    from replicatinggpt_tpu.parallel import select_attention_fn
+    mesh_cfg = MeshConfig(data=4, seq=1, model=2)
+    mesh = make_mesh(mesh_cfg)
+    flash = dataclasses.replace(TINY, attention_impl="flash")
+    assert select_attention_fn(flash, mesh_cfg, mesh) is not None
+    # 'auto' on this CPU backend: no wrapper (einsum under GSPMD)
+    auto = dataclasses.replace(TINY, attention_impl="auto")
+    assert select_attention_fn(auto, mesh_cfg, mesh) is None
+    einsum = dataclasses.replace(TINY, attention_impl="einsum")
+    assert select_attention_fn(einsum, mesh_cfg, mesh) is None
+    # explicit 'flash' with n_head=3 indivisible by model=2 still wraps
+    # (the wrapper drops the head axis from its specs, never dense einsum)
+    bad = dataclasses.replace(TINY, n_head=3, n_embd=33,
+                              attention_impl="flash")
+    assert select_attention_fn(bad, mesh_cfg, mesh) is not None
+    # explicit 'flash' on a seq-sharded mesh routes to a flash-capable
+    # seq-parallel core (never dense einsum — the O(T^2) memory the user
+    # opted out of)
+    seq_cfg = MeshConfig(data=2, seq=2, model=2)
+    assert select_attention_fn(flash, seq_cfg, make_mesh(seq_cfg)) \
+        is not None
+
+
+def test_sharded_flash_wrapper_self_guards_indivisible_dims():
+    """shard_map requires even division; the wrapper must drop an
+    indivisible axis from its specs (gather instead of crash) and fall
+    back to plain einsum when nothing divides — matching the GSPMD
+    envelope it replaced."""
+    from replicatinggpt_tpu.ops.attention import full_causal_attention
+    from replicatinggpt_tpu.parallel.sharded_flash import \
+        sharded_flash_attention
+
+    mesh = make_mesh(MeshConfig(data=4, seq=1, model=2))
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    # B=6 does not divide data=4 -> heads-only sharding
+    q, k, v = (jax.random.normal(kk, (6, 4, 64, 16), jnp.float32)
+               for kk in ks)
+    ref = full_causal_attention(q, k, v, impl="einsum")
+    got = sharded_flash_attention(q, k, v, mesh=mesh, impl="einsum")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # B=6, H=3: neither axis divides -> plain einsum fallback
+    q3, k3, v3 = (t[:, :3] for t in (q, k, v))
+    ref3 = full_causal_attention(q3, k3, v3, impl="einsum")
+    got3 = sharded_flash_attention(q3, k3, v3, mesh=mesh, impl="einsum")
+    np.testing.assert_allclose(np.asarray(got3), np.asarray(ref3),
+                               atol=2e-5, rtol=2e-5)
