@@ -144,16 +144,18 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
                                 if rng is not None else (None, None, None))
     h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_eps)
     qkv = h @ lp["qkv_kernel"].astype(cd) + lp["qkv_bias"].astype(cd)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q, k, v = (_split_heads(t, cfg.n_head) for t in (q, k, v))
+    attn = None
+    impl = cfg.attention_impl
     if attention_fn is not None:
-        # seq-parallel cores (ring/Ulysses) apply attention-weight
-        # dropout themselves from the per-block rng (per-device streams
-        # derived inside their shard_map regions)
-        attn = attention_fn(q, k, v, rng=r_attn, train=train)
-    else:
-        impl = cfg.attention_impl
-        if impl in ("auto", "ring", "ulysses"):
+        # mesh wrappers without head/seq sharding expose a packed-qkv
+        # hook (parallel/sharded_flash.py) so sharded runs also skip the
+        # head-layout round trip; None -> ordinary split-heads path
+        packed_hook = getattr(attention_fn, "packed_qkv", None)
+        if packed_hook is not None:
+            attn = packed_hook(qkv, cfg.n_head, rng=r_attn, train=train)
+    if attention_fn is None and impl in ("auto", "ring", "ulysses",
+                                         "flash"):
+        if impl != "flash":
             # seq-parallel impls ('ring'/'ulysses') only exist as sharded
             # wrappers (parallel/ring_attention.py, parallel/ulysses.py)
             # passed in via attention_fn; locally they degrade to the
@@ -166,12 +168,28 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
             # in-kernel on the Pallas path, and degrades to dense einsum
             # elsewhere).
             from ..ops.flash_attention import FLASH_MIN_T
-            T = q.shape[2]
-            impl = "flash" if T >= FLASH_MIN_T else "einsum"
-        attn = full_causal_attention(
-            q, k, v, dropout_rate=cfg.attn_dropout, rng=r_attn, train=train,
-            impl=impl)
-    attn = _merge_heads(attn)
+            impl = "flash" if qkv.shape[1] >= FLASH_MIN_T else "einsum"
+        if impl == "flash":
+            # packed-heads kernel consumes the fused projection output
+            # directly — no (B,T,H,D)<->(B,H,T,D) round trip on either
+            # pass; None off the envelope -> split-heads path below
+            from ..ops.flash_attention import packed_qkv_attention
+            attn = packed_qkv_attention(qkv, cfg.n_head,
+                                        dropout_rate=cfg.attn_dropout,
+                                        rng=r_attn, train=train)
+    if attn is None:
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (_split_heads(t, cfg.n_head) for t in (q, k, v))
+        if attention_fn is not None:
+            # seq-parallel cores (ring/Ulysses) apply attention-weight
+            # dropout themselves from the per-block rng (per-device
+            # streams derived inside their shard_map regions)
+            attn = attention_fn(q, k, v, rng=r_attn, train=train)
+        else:
+            attn = full_causal_attention(
+                q, k, v, dropout_rate=cfg.attn_dropout, rng=r_attn,
+                train=train, impl=impl)
+        attn = _merge_heads(attn)
     attn = attn @ lp["attn_out_kernel"].astype(cd) + lp["attn_out_bias"].astype(cd)
     # Projection dropout: declared-but-unapplied in the reference
     # (GPT1.py:132,136, SURVEY.md §8-Q2); correct-by-default here.

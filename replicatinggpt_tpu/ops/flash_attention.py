@@ -40,6 +40,39 @@ def _pallas_supported(q) -> bool:
     return D in (32, 64, 128, 256) and T % 128 == 0 and T >= 128
 
 
+def _packed_backend_ok() -> bool:
+    """Pallas lowering gate for the packed family (tests monkeypatch this
+    to exercise the interpret-mode kernel on CPU). One site — the local
+    routing and the mesh packed hook both go through it."""
+    return jax.default_backend() == "tpu"
+
+
+def packed_qkv_attention(qkv: jnp.ndarray, n_head: int, *,
+                         scale: Optional[float] = None,
+                         dropout_rate: float = 0.0,
+                         rng: Optional[jax.Array] = None,
+                         train: bool = False) -> Optional[jnp.ndarray]:
+    """Attention straight off the fused (B, T, 3C) QKV projection via the
+    packed-heads kernel (flash_pallas packed family): returns the merged
+    (B, T, C) output, or None when the kernel does not apply (non-TPU
+    backend or off the residency/shape envelope) — callers then take the
+    split-heads path. Skipping the (B,T,H,D)<->(B,H,T,D) layout round
+    trip is worth ~18% of attention fwd+bwd at char-GPT shapes on v5e
+    (benchmarks/RESULTS.md)."""
+    if not _packed_backend_ok():
+        return None
+    from .flash_pallas import packed_supported, pallas_flash_attention_packed
+    B, T, C3 = qkv.shape
+    if not packed_supported(T, C3 // 3, n_head,
+                            jnp.dtype(qkv.dtype).itemsize):
+        return None
+    training_dropout = train and dropout_rate > 0.0 and rng is not None
+    return pallas_flash_attention_packed(
+        qkv, n_head, scale=scale, causal=True,
+        dropout_rate=dropout_rate if training_dropout else 0.0,
+        dropout_rng=rng if training_dropout else None)
+
+
 def supports_dropout(q) -> bool:
     """Attention-weight dropout is implemented in the Pallas kernel only
     (counter-based in-kernel mask); the XLA-SDPA fallback has no hook for

@@ -1531,3 +1531,245 @@ def pallas_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     fn = _flash_stream if stream else _flash
     return fn(q, k, v, seed, scale, bool(causal), block_q,
               block_k, rate)
+
+
+# ---------------------------------------------------------------------------
+# packed-heads resident family: attention straight off the fused QKV
+# projection, no head transposes anywhere
+#
+# The (B, H, T, D) layout the families above consume costs real HBM: the
+# char-GPT HLO carries ~1.1 GB/step of (B,T,H,D)<->(B,H,T,D) transpose
+# copies feeding/draining the kernels (benchmarks/RESULTS.md), and a
+# per-head 4-d BlockSpec that would read (B,T,H,D) directly is
+# Mosaic-unrepresentable (a (1, bq, 1, D) block's trailing dims neither
+# divide (8, 128) nor equal the array dims). This family sidesteps the
+# layout question entirely: the kernel consumes the QKV projection's own
+# (B, T, 3C) output — q as columns [0, C), k [C, 2C), v [2C, 3C), heads
+# as D-wide column strips — with grid (B,) and the whole (T, 3C) block
+# resident in VMEM. Heads are a static in-kernel loop over lane slices;
+# per-head tile math is byte-identical to the unpacked kernels
+# (_fwd_tile/_dkv_tile with bh = b * H + h), so dropout masks and
+# numerics match the unpacked family bit-for-bit.
+#
+# The backward emits d(qkv) as one packed (B, T, 3C) array — dq columns
+# from a (T, C) f32 VMEM scratch accumulated kv-major (one p/ds
+# recompute per tile serves dq, dk and dv, as in the fused kv-major
+# kernel above), dk/dv written per kv-row-block — so the gradient flows
+# straight into the projection matmul's VJP with no split/concat/
+# transpose on either side of either pass.
+#
+# Residency bound: the whole (T, 3C) block (plus do/dqkv/scratch in the
+# backward) must fit VMEM (~16 MB/core), so this family owns the
+# short-T/many-head regime (char-GPT: T=256, C=384 -> 0.6 MB) and the
+# general (B, H, T, D) families keep everything past PACKED_QKV_BYTES.
+# ---------------------------------------------------------------------------
+
+# (T, 3C) itemsize bound for the packed family. The backward's VMEM
+# footprint per program is qkv + do + dqkv + (T, C) f32 scratch
+# ~= 2.8x the qkv block (bf16), double-buffered across batch programs;
+# 2 MiB keeps the worst case ~11 MiB under the ~16 MiB/core budget.
+PACKED_QKV_BYTES = 2 * 1024 * 1024
+
+
+def packed_supported(T: int, C: int, n_head: int, itemsize: int) -> bool:
+    """Envelope for the packed-heads family: head strips must be
+    lane-sliceable D in {32, 64, 128, 256}, T tileable, and the whole
+    (T, 3C) block resident (see PACKED_QKV_BYTES)."""
+    if C % n_head != 0:
+        return False
+    D = C // n_head
+    return (D in (32, 64, 128, 256) and T >= 128 and T % 128 == 0
+            and T * 3 * C * itemsize <= PACKED_QKV_BYTES)
+
+
+def _fwd_kernel_packed(seed_ref, qkv_ref, o_ref, lse_ref, *, scale, causal,
+                       n_head, head_dim, seq_len, block_q, block_k,
+                       dropout_rate):
+    b = pl.program_id(0)
+    H, D, C = n_head, head_dim, n_head * head_dim
+    n_q = seq_len // block_q
+    n_kv_total = seq_len // block_k
+    for jb in range(n_q):
+        q_first = jb * block_q
+        rows = slice(jb * block_q, (jb + 1) * block_q)
+        if causal:
+            n_kv = min((q_first + block_q + block_k - 1) // block_k,
+                       n_kv_total)
+        else:
+            n_kv = n_kv_total
+        outs = []
+        lses = []
+        for h in range(H):
+            q = qkv_ref[rows, h * D:(h + 1) * D]
+            acc = jnp.zeros((block_q, D), jnp.float32)
+            m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+            l = jnp.zeros((block_q, 1), jnp.float32)
+            for kb in range(n_kv):
+                krows = slice(kb * block_k, (kb + 1) * block_k)
+                k = qkv_ref[krows, C + h * D:C + (h + 1) * D]
+                v = qkv_ref[krows, 2 * C + h * D:2 * C + (h + 1) * D]
+                acc, m, l = _fwd_tile(
+                    q, k, v, acc, m, l, scale=scale, causal=causal,
+                    q_first=q_first, k_first=kb * block_k,
+                    block_q=block_q, block_k=block_k, seed=seed_ref[0],
+                    bh=b * H + h, dropout_rate=dropout_rate)
+            l = jnp.maximum(l, 1e-30)
+            outs.append((acc / l).astype(o_ref.dtype))
+            lses.append(m + jnp.log(l))
+        o_ref[rows, :] = jnp.concatenate(outs, axis=1)
+        lse_ref[rows, :] = jnp.concatenate(lses, axis=1)
+
+
+def _packed_fwd(qkv, seed, scale, causal, n_head, block_q, block_k,
+                dropout_rate):
+    B, T, C3 = qkv.shape
+    C = C3 // 3
+    D = C // n_head
+    kernel = functools.partial(
+        _fwd_kernel_packed, scale=scale, causal=causal, n_head=n_head,
+        head_dim=D, seq_len=T, block_q=block_q, block_k=block_k,
+        dropout_rate=dropout_rate)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=[
+            _smem_spec(),
+            _vmem_spec((None, T, C3), lambda b: (b, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((None, T, C), lambda b: (b, 0, 0)),
+            _vmem_spec((None, T, n_head), lambda b: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, C), qkv.dtype),
+            jax.ShapeDtypeStruct((B, T, n_head), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+    )(seed, qkv)
+    return o, lse
+
+
+def _bwd_kernel_packed(seed_ref, qkv_ref, do_ref, lse_ref, delta_ref,
+                       dqkv_ref, dq_scratch, *, scale, causal, n_head,
+                       head_dim, seq_len, block_q, block_k, dropout_rate):
+    """kv-major fully-fused packed backward: one p/ds recompute per
+    (head, q-block, kv-block) tile serves dq (into the (T, C) f32
+    scratch), dk and dv (register accumulators over q-blocks, written
+    per kv-row-block). Loops are static Python — the residency bound
+    keeps n_q * n_kv * H small — so accumulators live in registers."""
+    b = pl.program_id(0)
+    H, D, C = n_head, head_dim, n_head * head_dim
+    n_q = seq_len // block_q
+    n_kv = seq_len // block_k
+    dq_scratch[...] = jnp.zeros((seq_len, C), jnp.float32)
+    for kb in range(n_kv):
+        k_first = kb * block_k
+        krows = slice(kb * block_k, (kb + 1) * block_k)
+        dks = []
+        dvs = []
+        for h in range(H):
+            k = qkv_ref[krows, C + h * D:C + (h + 1) * D]
+            v = qkv_ref[krows, 2 * C + h * D:2 * C + (h + 1) * D]
+            dk_acc = jnp.zeros((block_k, D), jnp.float32)
+            dv_acc = jnp.zeros((block_k, D), jnp.float32)
+            jb0 = (k_first // block_q) if causal else 0
+            for jb in range(jb0, n_q):
+                rows = slice(jb * block_q, (jb + 1) * block_q)
+                q = qkv_ref[rows, h * D:(h + 1) * D]
+                do = do_ref[rows, h * D:(h + 1) * D]
+                lse = lse_ref[rows, h:h + 1]
+                delta = delta_ref[rows, h:h + 1]
+                dk_c, dv_c, dsc = _dkv_tile(
+                    q, k, v, do, lse, delta, scale=scale, causal=causal,
+                    q_first=jb * block_q, k_first=k_first,
+                    block_q=block_q, block_k=block_k, seed=seed_ref[0],
+                    bh=b * H + h, dropout_rate=dropout_rate)
+                dk_acc += dk_c
+                dv_acc += dv_c
+                dq_c = jax.lax.dot_general(
+                    dsc, k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                dq_scratch[rows, h * D:(h + 1) * D] += dq_c
+            dks.append(dk_acc.astype(dqkv_ref.dtype))
+            dvs.append(dv_acc.astype(dqkv_ref.dtype))
+        dqkv_ref[krows, C:2 * C] = jnp.concatenate(dks, axis=1)
+        dqkv_ref[krows, 2 * C:3 * C] = jnp.concatenate(dvs, axis=1)
+    dqkv_ref[:, 0:C] = dq_scratch[...].astype(dqkv_ref.dtype)
+
+
+def _packed_bwd(qkv, do, lse, delta, seed, scale, causal, n_head, block_q,
+                block_k, dropout_rate):
+    B, T, C3 = qkv.shape
+    C = C3 // 3
+    D = C // n_head
+    kernel = functools.partial(
+        _bwd_kernel_packed, scale=scale, causal=causal, n_head=n_head,
+        head_dim=D, seq_len=T, block_q=block_q, block_k=block_k,
+        dropout_rate=dropout_rate)
+    spec_full = lambda w: _vmem_spec((None, T, w), lambda b: (b, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=[_smem_spec(), spec_full(C3), spec_full(C),
+                  spec_full(n_head), spec_full(n_head)],
+        out_specs=spec_full(C3),
+        out_shape=jax.ShapeDtypeStruct((B, T, C3), qkv.dtype),
+        scratch_shapes=[_scratch((T, C))],
+        interpret=_interpret_mode(),
+    )(seed, qkv, do, lse, delta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _flash_packed(qkv, seed, scale, causal, n_head, block_q, block_k,
+                  dropout_rate):
+    o, _ = _packed_fwd(qkv, seed, scale, causal, n_head, block_q, block_k,
+                       dropout_rate)
+    return o
+
+
+def _flash_packed_fwd_rule(qkv, seed, scale, causal, n_head, block_q,
+                           block_k, dropout_rate):
+    o, lse = _packed_fwd(qkv, seed, scale, causal, n_head, block_q,
+                         block_k, dropout_rate)
+    return o, (qkv, seed, o, lse)
+
+
+def _flash_packed_bwd_rule(scale, causal, n_head, block_q, block_k,
+                           dropout_rate, residuals, g):
+    qkv, seed, o, lse = residuals
+    B, T, C = o.shape
+    D = C // n_head
+    # delta = rowsum(do * o) per head — a minor-dim split + reduce on the
+    # packed layout, no transposes (dropout's mask is already inside o,
+    # matching the unpacked families' delta semantics)
+    delta = (g.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+        B, T, n_head, D).sum(-1)
+    dqkv = _packed_bwd(qkv, g.astype(qkv.dtype), lse, delta, seed, scale,
+                       causal, n_head, block_q, block_k, dropout_rate)
+    return dqkv, None
+
+
+_flash_packed.defvjp(_flash_packed_fwd_rule, _flash_packed_bwd_rule)
+
+
+def pallas_flash_attention_packed(qkv: jnp.ndarray, n_head: int, *,
+                                  scale: Optional[float] = None,
+                                  causal: bool = True,
+                                  block_q: Optional[int] = None,
+                                  block_k: Optional[int] = None,
+                                  dropout_rate: float = 0.0,
+                                  dropout_rng: Optional[jax.Array] = None
+                                  ) -> jnp.ndarray:
+    """Packed-heads flash attention. qkv: (B, T, 3C) — the fused QKV
+    projection output, untouched. Returns the merged (B, T, C) attention
+    output, ready for the output projection. Numerics (including the
+    in-kernel dropout stream) are bit-identical to
+    ``pallas_flash_attention`` on the same logical q/k/v."""
+    B, T, C3 = qkv.shape
+    C = C3 // 3
+    D = C // n_head
+    scale, rate, seed = _flash_prologue(D, scale, dropout_rate, dropout_rng)
+    block_q = _block_for(T, block_q)
+    block_k = _block_for(T, block_k)
+    return _flash_packed(qkv, seed, scale, bool(causal), n_head, block_q,
+                         block_k, rate)

@@ -76,13 +76,24 @@ def sharded_flash_attention(q, k, v, *, mesh: Mesh,
     batch_axis = "data" if (data_n > 1 and q.shape[0] % data_n == 0) else None
     head_axis = "model" if (model_n > 1 and q.shape[1] % model_n == 0) \
         else None
-    if batch_axis is None and head_axis is None and (data_n > 1
-                                                    or model_n > 1):
-        # nothing shard_map-able: preserve the pre-wrapper behavior
-        # (GSPMD einsum tolerates uneven sharding via padding)
+    dropped = ((data_n > 1 and batch_axis is None)
+               or (model_n > 1 and head_axis is None))
+    if dropped and impl != "flash":
+        # 'auto' must not degrade to replicated compute: dropping an
+        # indivisible axis from the specs makes every device along it
+        # gather and redundantly compute that whole dimension's
+        # attention — strictly worse than the GSPMD einsum this wrapper
+        # replaced. Only an explicit 'flash' (the user opting into the
+        # memory-efficient kernel at any cost) pays the gather below.
         return full_causal_attention(q, k, v, scale=scale, impl="einsum",
                                      dropout_rate=dropout_rate, rng=rng,
                                      train=train)
+    # Reaching here with both axes dropped means explicit 'flash' on a
+    # mesh where nothing divides: the specs below are fully replicated,
+    # every device computes the whole batch's attention redundantly —
+    # wasteful, but memory-efficient and what the user asked for (dense
+    # einsum at the long T that motivates 'flash' would materialize the
+    # O(T^2) weights instead).
     spec = P(batch_axis, head_axis, None, None)
     local = functools.partial(_local_attention, scale=scale,
                               dropout_rate=dropout_rate, impl=impl,
@@ -96,13 +107,73 @@ def sharded_flash_attention(q, k, v, *, mesh: Mesh,
     return fn(q, k, v, rng)
 
 
+def _local_packed(qkv, key=None, *, n_head, scale: Optional[float],
+                  dropout_rate: float):
+    """Per-device body of the packed-qkv fast path: the packed-heads
+    kernel on this device's batch shard, dropout stream folded per
+    'data' shard (the in-kernel counter already decorrelates heads).
+    Routes through ops.flash_attention.packed_qkv_attention — the one
+    envelope-gating site — which cannot return None here because the
+    hook prechecked the identical envelope before opening shard_map."""
+    from ..ops.flash_attention import packed_qkv_attention
+    if key is not None:
+        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+    out = packed_qkv_attention(qkv, n_head, scale=scale,
+                               dropout_rate=dropout_rate, rng=key,
+                               train=key is not None)
+    assert out is not None, "packed envelope changed between gate and body"
+    return out
+
+
 def make_sharded_flash_attention_fn(mesh: Mesh,
                                     scale: Optional[float] = None,
                                     impl: str = "auto",
                                     dropout_rate: float = 0.0):
-    """attention_fn for ``models.gpt.forward`` / ``train.steps``."""
+    """attention_fn for ``models.gpt.forward`` / ``train.steps``.
+
+    On meshes that shard neither heads nor sequence (pure DP / FSDP),
+    the returned fn also carries a ``packed_qkv`` hook: models.gpt._block
+    offers it the fused (B, T, 3C) projection output so the packed-heads
+    kernel family — the round-3 +45-50% char-GPT win — engages per
+    device instead of paying the split/transpose round trip the
+    (B, H, T, D) contract implies. The hook returns None off the packed
+    envelope (non-TPU, indivisible batch, VMEM bound); _block then takes
+    the ordinary split-heads path through this same wrapper.
+    """
     def attention_fn(q, k, v, rng=None, train=False):
         return sharded_flash_attention(q, k, v, mesh=mesh, scale=scale,
                                        impl=impl, dropout_rate=dropout_rate,
                                        rng=rng, train=train)
+
+    model_n = mesh.shape.get("model", 1)
+    seq_n = mesh.shape.get("seq", 1)
+    if model_n == 1 and seq_n == 1:
+        def packed_qkv(qkv, n_head, rng=None, train=False):
+            from ..ops.flash_attention import (FLASH_MIN_T,
+                                               _packed_backend_ok)
+            from ..ops.flash_pallas import packed_supported
+            if not _packed_backend_ok():
+                return None
+            B, T, C3 = qkv.shape
+            data_n = mesh.shape.get("data", 1)
+            if B % data_n != 0:
+                return None
+            if impl != "flash" and T < FLASH_MIN_T:
+                return None  # 'auto' keeps the measured crossover
+            if not packed_supported(T, C3 // 3, n_head,
+                                    qkv.dtype.itemsize):
+                return None
+            spec = P("data", None, None)
+            local = functools.partial(_local_packed, n_head=n_head,
+                                      scale=scale,
+                                      dropout_rate=dropout_rate)
+            if not (train and dropout_rate > 0.0 and rng is not None):
+                fn = jax.shard_map(local, mesh=mesh, in_specs=(spec,),
+                                   out_specs=spec, check_vma=False)
+                return fn(qkv)
+            fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, P()),
+                               out_specs=spec, check_vma=False)
+            return fn(qkv, rng)
+
+        attention_fn.packed_qkv = packed_qkv
     return attention_fn

@@ -406,3 +406,148 @@ def test_bf16_grads_match_einsum():
             # bf16 has ~8 mantissa bits; grads here are O(1-30), so the
             # elementwise band is dominated by the final bf16 rounding
             np.testing.assert_allclose(a, b, rtol=6e-2, atol=0.25)
+
+
+# ---------------------------------------------------------------------------
+# packed-heads family: attention straight off the fused (B, T, 3C) QKV
+# projection (no head transposes) — must match the unpacked family
+# bit-for-bit on the same logical q/k/v
+# ---------------------------------------------------------------------------
+
+def _packed_inputs(B=2, T=256, H=6, D=64, seed=0, dtype=jnp.float32):
+    C = H * D
+    qkv = jax.random.normal(jax.random.PRNGKey(seed), (B, T, 3 * C), dtype)
+    return qkv, C
+
+
+def _heads(x, H):
+    B, T, C = x.shape
+    return x.reshape(B, T, H, C // H).transpose(0, 2, 1, 3)
+
+
+def test_packed_fwd_bit_identical_to_unpacked():
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H = 6
+    qkv, C = _packed_inputs(H=H)
+    B, T = qkv.shape[:2]
+    q, k, v = jnp.split(qkv, 3, -1)
+    ref = pallas_flash_attention(_heads(q, H), _heads(k, H), _heads(v, H))
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, C)
+    got = pallas_flash_attention_packed(qkv, H)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_packed_dropout_bit_identical_to_unpacked():
+    """The packed kernel derives its dropout stream from bh = b*H + h —
+    the same counter the unpacked kernels use — so masks must be exactly
+    equal, not just statistically alike."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H = 4
+    qkv, C = _packed_inputs(B=2, T=128, H=H, D=32, seed=3)
+    B, T = qkv.shape[:2]
+    rng = jax.random.PRNGKey(7)
+    got = pallas_flash_attention_packed(qkv, H, dropout_rate=0.2,
+                                        dropout_rng=rng)
+    q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+    ref = pallas_flash_attention(q, k, v, dropout_rate=0.2, dropout_rng=rng)
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, C)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_packed_grads_match_unpacked():
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H = 4
+    qkv, C = _packed_inputs(B=1, T=256, H=H, D=32, seed=11)
+    B, T = qkv.shape[:2]
+
+    def loss_packed(qkv):
+        return jnp.sum(pallas_flash_attention_packed(qkv, H) ** 2)
+
+    def loss_unpacked(qkv):
+        q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+        o = pallas_flash_attention(q, k, v)
+        return jnp.sum(o.transpose(0, 2, 1, 3).reshape(B, T, C) ** 2)
+
+    gp = jax.grad(loss_packed)(qkv)
+    gu = jax.grad(loss_unpacked)(qkv)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gu), atol=2e-4,
+                               rtol=2e-4)
+
+
+def test_packed_grads_with_dropout_match_unpacked():
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H = 2
+    qkv, C = _packed_inputs(B=1, T=128, H=H, D=32, seed=13)
+    B, T = qkv.shape[:2]
+    rng = jax.random.PRNGKey(5)
+
+    def loss_packed(qkv):
+        o = pallas_flash_attention_packed(qkv, H, dropout_rate=0.25,
+                                          dropout_rng=rng)
+        return jnp.sum(o ** 2)
+
+    def loss_unpacked(qkv):
+        q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+        o = pallas_flash_attention(q, k, v, dropout_rate=0.25,
+                                   dropout_rng=rng)
+        return jnp.sum(o.transpose(0, 2, 1, 3).reshape(B, T, C) ** 2)
+
+    gp = jax.grad(loss_packed)(qkv)
+    gu = jax.grad(loss_unpacked)(qkv)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gu), atol=2e-4,
+                               rtol=2e-4)
+
+
+def test_packed_supported_envelope():
+    from replicatinggpt_tpu.ops.flash_pallas import (PACKED_QKV_BYTES,
+                                                     packed_supported)
+    assert packed_supported(256, 384, 6, 2)        # char-GPT bf16
+    assert not packed_supported(1024, 768, 12, 2)  # 124M: 4.7MB > bound
+    assert not packed_supported(256, 384, 5, 2)    # C % H != 0
+    assert not packed_supported(192, 384, 6, 2)    # T % 128 != 0
+    assert not packed_supported(256, 96, 6, 2)     # D=16 not sliceable
+    t_max = PACKED_QKV_BYTES // (3 * 384 * 2) // 128 * 128
+    assert packed_supported(t_max, 384, 6, 2)
+    assert not packed_supported(t_max + 128, 384, 6, 2)
+
+
+def test_model_block_routes_packed(monkeypatch):
+    """forward() with attention_impl resolving to flash must produce the
+    same logits through the packed path (backend check monkeypatched so
+    the interpret-mode kernel engages on CPU) as through the split-heads
+    path."""
+    import replicatinggpt_tpu.ops.flash_attention as fa
+    from replicatinggpt_tpu.config import ModelConfig
+    from replicatinggpt_tpu.models.gpt import forward, init_params
+
+    mcfg = ModelConfig(vocab_size=64, block_size=256, n_layer=2, n_head=4,
+                       n_embd=128, dropout=0.0, attn_dropout=0.0,
+                       dtype="float32", attention_impl="flash")
+    params = init_params(jax.random.PRNGKey(0), mcfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 256), 0, 64)
+
+    ref, _ = forward(params, x, mcfg)  # CPU backend -> split path (SDPA)
+
+    calls = []
+
+    def force_packed(qkv, n_head, **kw):
+        from replicatinggpt_tpu.ops.flash_pallas import \
+            pallas_flash_attention_packed
+        calls.append(qkv.shape)
+        rng, train = kw.get("rng"), kw.get("train", False)
+        rate = kw.get("dropout_rate", 0.0)
+        on = train and rate > 0.0 and rng is not None
+        return pallas_flash_attention_packed(
+            qkv, n_head, scale=kw.get("scale"),
+            dropout_rate=rate if on else 0.0,
+            dropout_rng=rng if on else None)
+
+    monkeypatch.setattr(fa, "packed_qkv_attention", force_packed)
+    got, _ = forward(params, x, mcfg)
+    assert calls, "packed path was not routed"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4,
+                               rtol=2e-4)
