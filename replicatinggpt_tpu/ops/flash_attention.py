@@ -47,6 +47,19 @@ def _packed_backend_ok() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def packed_envelope_ok(qkv: jnp.ndarray, n_head: int) -> bool:
+    """THE packed-family gate: backend + shape/residency envelope. Both
+    packed entry points — the local routing below and the mesh hook's
+    precheck (parallel/sharded_flash.py) — must use this one predicate,
+    so a gate added here can never diverge the two paths."""
+    if not _packed_backend_ok():
+        return False
+    from .flash_pallas import packed_supported
+    _, T, C3 = qkv.shape
+    return packed_supported(T, C3 // 3, n_head,
+                            jnp.dtype(qkv.dtype).itemsize)
+
+
 def packed_qkv_attention(qkv: jnp.ndarray, n_head: int, *,
                          scale: Optional[float] = None,
                          dropout_rate: float = 0.0,
@@ -59,13 +72,9 @@ def packed_qkv_attention(qkv: jnp.ndarray, n_head: int, *,
     split-heads path. Skipping the (B,T,H,D)<->(B,H,T,D) layout round
     trip is worth ~18% of attention fwd+bwd at char-GPT shapes on v5e
     (benchmarks/RESULTS.md)."""
-    if not _packed_backend_ok():
+    if not packed_envelope_ok(qkv, n_head):
         return None
-    from .flash_pallas import packed_supported, pallas_flash_attention_packed
-    B, T, C3 = qkv.shape
-    if not packed_supported(T, C3 // 3, n_head,
-                            jnp.dtype(qkv.dtype).itemsize):
-        return None
+    from .flash_pallas import pallas_flash_attention_packed
     training_dropout = train and dropout_rate > 0.0 and rng is not None
     return pallas_flash_attention_packed(
         qkv, n_head, scale=scale, causal=True,

@@ -150,18 +150,14 @@ def make_sharded_flash_attention_fn(mesh: Mesh,
     if model_n == 1 and seq_n == 1:
         def packed_qkv(qkv, n_head, rng=None, train=False):
             from ..ops.flash_attention import (FLASH_MIN_T,
-                                               _packed_backend_ok)
-            from ..ops.flash_pallas import packed_supported
-            if not _packed_backend_ok():
-                return None
+                                               packed_envelope_ok)
             B, T, C3 = qkv.shape
             data_n = mesh.shape.get("data", 1)
             if B % data_n != 0:
                 return None
             if impl != "flash" and T < FLASH_MIN_T:
                 return None  # 'auto' keeps the measured crossover
-            if not packed_supported(T, C3 // 3, n_head,
-                                    qkv.dtype.itemsize):
+            if not packed_envelope_ok(qkv, n_head):
                 return None
             spec = P("data", None, None)
             local = functools.partial(_local_packed, n_head=n_head,
