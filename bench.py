@@ -277,6 +277,92 @@ def bench_longctx(args) -> None:
     })
 
 
+def _repeat_median(fn, *, repeats: int, inner: int) -> dict:
+    """Run ``fn`` (one timed lap = ``inner`` dispatched iterations ending
+    in a real device fetch) ``repeats`` times and report median + spread.
+
+    The tunnel's run-to-run noise on kernel microbenches reached 2x in
+    round 2 (3.6-7.6 ms for the same kernel at BH=192/T=1024 —
+    benchmarks/RESULTS.md), swamping remaining kernel deltas; medians
+    over >= 5 repeats with the spread attached are the defensibility
+    floor for any perf claim."""
+    import time
+    laps = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        laps.append((time.perf_counter() - t0) / inner * 1e3)
+    laps.sort()
+    return {
+        "median_ms": round(laps[len(laps) // 2], 4),
+        "min_ms": round(laps[0], 4),
+        "max_ms": round(laps[-1], 4),
+        "spread_pct": round((laps[-1] - laps[0]) / laps[len(laps) // 2]
+                            * 100, 1),
+        "repeats": repeats,
+    }
+
+
+def bench_kernel(args) -> None:
+    """Kernel-level attention microbench with a repeat-median protocol:
+    fwd+bwd through the packed family (char-GPT shapes) and the unpacked
+    resident family (124M-ish shapes), each as median over --repeats
+    laps with min/max spread. Every kernel perf row added to
+    benchmarks/RESULTS.md should come from this mode."""
+    import jax
+    import jax.numpy as jnp
+
+    from replicatinggpt_tpu.ops.flash_pallas import (
+        packed_supported, pallas_flash_attention,
+        pallas_flash_attention_packed)
+
+    repeats, inner = max(args.repeats, 1), max(args.kernel_inner, 1)
+    results = {}
+
+    def fwd_bwd_lap(grad_fn, x):
+        def lap():
+            for _ in range(inner):
+                l, _ = grad_fn(x)
+            jax.device_get(l)
+        return lap
+
+    # packed family at char-GPT shapes
+    B, T, H, D = 64, 256, 6, 64
+    C = H * D
+    if packed_supported(T, C, H, 2):
+        qkv = jax.random.normal(jax.random.PRNGKey(0), (B, T, 3 * C),
+                                jnp.bfloat16)
+        g = jax.jit(jax.value_and_grad(lambda q: jnp.sum(
+            pallas_flash_attention_packed(q, H).astype(jnp.float32) ** 2)))
+        jax.device_get(g(qkv)[0])  # compile + warm
+        results["packed_char_B64_T256_H6_D64"] = _repeat_median(
+            fwd_bwd_lap(g, qkv), repeats=repeats, inner=inner)
+        log(f"packed char shapes: {results['packed_char_B64_T256_H6_D64']}")
+
+    # unpacked resident family at the round-2 noise workload
+    BH, T2, D2 = 192, 1024, 64
+    qkv2 = [jax.random.normal(jax.random.PRNGKey(i), (BH // 6, 6, T2, D2),
+                              jnp.bfloat16) for i in range(3)]
+    g2 = jax.jit(jax.value_and_grad(lambda q, k, v: jnp.sum(
+        pallas_flash_attention(q, k, v).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2)))
+    jax.device_get(g2(*qkv2)[0])
+    results["unpacked_BH192_T1024_D64"] = _repeat_median(
+        fwd_bwd_lap(lambda x: g2(*x), qkv2), repeats=repeats, inner=inner)
+    log(f"unpacked 124M-ish shapes: {results['unpacked_BH192_T1024_D64']}")
+
+    key = ("packed_char_B64_T256_H6_D64"
+           if "packed_char_B64_T256_H6_D64" in results
+           else "unpacked_BH192_T1024_D64")
+    emit({
+        "metric": "flash_kernel_fwdbwd_median_ms",
+        "value": results[key]["median_ms"],
+        "unit": "ms",
+        "vs_baseline": 0.0,  # reference has no kernel-level numbers
+        "configs": results,
+    })
+
+
 def bench_train(args) -> None:
     import jax
     import numpy as np
@@ -420,9 +506,14 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="char-gpt")
     p.add_argument("--mode", default="train",
-                   choices=["train", "generate", "longctx"])
+                   choices=["train", "generate", "longctx", "kernel"])
     p.add_argument("--longctx-t", type=int, default=32768,
                    help="sequence length for --mode longctx")
+    p.add_argument("--repeats", type=int, default=7,
+                   help="--mode kernel: timed laps per config (median + "
+                        "spread reported; >= 5 for defensible claims)")
+    p.add_argument("--kernel-inner", type=int, default=20,
+                   help="--mode kernel: dispatched iterations per lap")
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=5)
@@ -455,8 +546,10 @@ def main() -> None:
     metric = {"generate": "generate_1k_tokens_per_sec_p50",
               "longctx": f"longctx_t{args.longctx_t}_train_tokens_per_sec"
                          "_per_chip",
+              "kernel": "flash_kernel_fwdbwd_median_ms",
               "train": "char_gpt_train_tokens_per_sec_per_chip"}[args.mode]
-    unit = "tokens/sec" if args.mode == "generate" else "tokens/sec/chip"
+    unit = ("tokens/sec" if args.mode == "generate"
+            else "ms" if args.mode == "kernel" else "tokens/sec/chip")
     start_watchdog(args.watchdog, metric, unit)
 
     try:
@@ -470,6 +563,8 @@ def main() -> None:
             bench_generate(args)
         elif args.mode == "longctx":
             bench_longctx(args)
+        elif args.mode == "kernel":
+            bench_kernel(args)
         else:
             bench_train(args)
     except BaseException as e:  # noqa: BLE001 — artifact must still emit

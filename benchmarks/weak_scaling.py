@@ -34,7 +34,7 @@ import subprocess
 import sys
 
 _CHILD = r"""
-import json, sys, time
+import dataclasses, json, sys, time
 import jax
 
 n = int(sys.argv[1])
@@ -43,6 +43,8 @@ batch_per_chip = int(sys.argv[3])
 steps = int(sys.argv[4])
 preset = sys.argv[5]
 fsdp = sys.argv[6] == "1"
+attention = sys.argv[7]          # '' = preset default
+remat = sys.argv[8]              # '' = preset default, '0'/'1' override
 
 if platform:
     jax.config.update("jax_platforms", platform)
@@ -53,6 +55,7 @@ assert len(jax.devices()) >= n, (n, jax.devices())
 import numpy as np
 
 from replicatinggpt_tpu.config import MeshConfig, get_config
+from replicatinggpt_tpu.parallel import select_attention_fn
 from replicatinggpt_tpu.parallel.mesh import (make_batch_sharding, make_mesh,
                                               shard_train_state)
 from replicatinggpt_tpu.train.state import create_train_state
@@ -60,27 +63,66 @@ from replicatinggpt_tpu.train.steps import make_train_step
 
 cfg = get_config(preset)
 mcfg, tcfg = cfg.model, cfg.train
+if attention:
+    mcfg = dataclasses.replace(mcfg, attention_impl=attention)
+if remat:
+    mcfg = dataclasses.replace(mcfg, remat=remat == "1")
 B = batch_per_chip * n
-mesh = make_mesh(MeshConfig(data=n, fsdp=fsdp))
+mesh_cfg = MeshConfig(data=n, fsdp=fsdp)
+mesh = make_mesh(mesh_cfg)
 state = shard_train_state(
     lambda: create_train_state(jax.random.PRNGKey(0), mcfg, tcfg),
-    mesh, MeshConfig(data=n, fsdp=fsdp))
-step = make_train_step(mcfg, tcfg, donate=False)
+    mesh, mesh_cfg)
+# the mesh-aware attention core (e.g. the shard_map flash wrapper for
+# explicit 'flash') — exactly what train.runner would select
+attention_fn = select_attention_fn(mcfg, mesh_cfg, mesh)
+step = make_train_step(mcfg, tcfg, donate=False, attention_fn=attention_fn)
 rng = np.random.default_rng(0)
 bs = make_batch_sharding(mesh)
 toks = rng.integers(0, mcfg.vocab_size, (B, mcfg.block_size + 1),
                     dtype=np.int32)
 batch = (jax.device_put(toks[:, :-1], bs),   # next-token targets,
          jax.device_put(toks[:, 1:], bs))    # as real training
-state, m = step(state, batch)
-assert np.isfinite(float(jax.device_get(m["loss"])))  # compile + warm
+# AOT compile so the artifact records compile time and the compiler's
+# own per-device memory accounting (the numbers a pod-slice run needs
+# to know in advance)
 t0 = time.perf_counter()
-for _ in range(steps):
-    state, m = step(state, batch)
-float(jax.device_get(m["loss"]))
-dt = time.perf_counter() - t0
-tps_chip = B * mcfg.block_size * steps / dt / n
-print(json.dumps({"n": n, "tokens_per_sec_per_chip": tps_chip}))
+lowered = step.lower(state, batch)
+compiled = lowered.compile()
+compile_s = time.perf_counter() - t0
+mem = {}
+try:
+    ma = compiled.memory_analysis()
+    mem = {"temp_bytes": int(ma.temp_size_in_bytes),
+           "argument_bytes": int(ma.argument_size_in_bytes),
+           "output_bytes": int(ma.output_size_in_bytes),
+           "peak_estimate_gb": round((ma.temp_size_in_bytes
+                                      + ma.argument_size_in_bytes)
+                                     / 2**30, 3)}
+except Exception as e:  # backend without memory_analysis
+    mem = {"memory_analysis_error": str(e)[:120]}
+t0 = time.perf_counter()
+state, m = compiled(state, batch)
+assert np.isfinite(float(jax.device_get(m["loss"])))  # warm + validate
+warm_s = time.perf_counter() - t0
+if steps > 0:
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = compiled(state, batch)
+    float(jax.device_get(m["loss"]))
+    dt = (time.perf_counter() - t0) / steps
+else:
+    # steps=0: the validation step is the measurement (big presets on a
+    # 1-core virtual mesh cost minutes per step; compile time + memory
+    # are the artifact's point there)
+    dt = warm_s
+tps_chip = B * mcfg.block_size / dt / n
+row = {"n": n, "tokens_per_sec_per_chip": tps_chip,
+       "compile_s": round(compile_s, 1), "step_s": round(dt, 3),
+       "attention_fn": ("none (GSPMD local core)" if attention_fn is None
+                        else getattr(attention_fn, "impl_name", "custom")),
+       **mem}
+print(json.dumps(row))
 """
 
 
@@ -95,6 +137,15 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--preset", default="test-tiny")
     p.add_argument("--fsdp", action="store_true")
+    p.add_argument("--attention", default="",
+                   help="override attention_impl (e.g. 'flash' to route "
+                        "the shard_map wrapper on the virtual mesh)")
+    p.add_argument("--remat", default="",
+                   help="'0'/'1' to override the preset's remat flag "
+                        "(e.g. '0' rehearses the pod-slice no-remat FSDP "
+                        "program)")
+    p.add_argument("--out", default="",
+                   help="also write the JSON artifact to this path")
     p.add_argument("--timeout", type=float, default=600.0)
     args = p.parse_args()
 
@@ -106,7 +157,7 @@ def main() -> None:
             r = subprocess.run(
                 [sys.executable, "-c", _CHILD, str(n), args.platform,
                  str(args.batch_per_chip), str(args.steps), args.preset,
-                 "1" if args.fsdp else "0"],
+                 "1" if args.fsdp else "0", args.attention, args.remat],
                 capture_output=True, text=True, timeout=args.timeout,
                 cwd=os.path.dirname(
                     os.path.dirname(os.path.abspath(__file__))))
@@ -126,8 +177,13 @@ def main() -> None:
               f"tok/s/chip", file=sys.stderr)
 
     if not rows:
-        print(json.dumps({"metric": "weak_scaling_efficiency", "value": 0.0,
-                          "unit": "fraction", "error": "all sizes failed"}))
+        line = json.dumps({"metric": "weak_scaling_efficiency", "value": 0.0,
+                           "unit": "fraction", "error": "all sizes failed",
+                           "requested_sizes": requested})
+        if args.out:  # the artifact contract holds on failure too
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
         raise SystemExit(1)
     base = rows[0]["tokens_per_sec_per_chip"]
     for row in rows:
@@ -137,6 +193,10 @@ def main() -> None:
         "value": rows[-1]["efficiency"],
         "unit": f"fraction of n={rows[0]['n']} per-chip throughput",
         "platform": args.platform or "default",
+        "preset": args.preset,
+        "fsdp": args.fsdp,
+        "attention": args.attention or "preset-default",
+        "remat": args.remat or "preset-default",
         "table": rows,
     }
     if skipped:
@@ -151,7 +211,11 @@ def main() -> None:
         # efficiency requires real chips (run with --platform '')
         out["note"] = ("virtual CPU mesh: efficiency reflects host-core "
                        "contention, not interconnect scaling")
-    print(json.dumps(out))
+    line = json.dumps(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
 
 
 if __name__ == "__main__":

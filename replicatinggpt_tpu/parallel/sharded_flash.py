@@ -151,7 +151,7 @@ def make_sharded_flash_attention_fn(mesh: Mesh,
         def packed_qkv(qkv, n_head, rng=None, train=False):
             from ..ops.flash_attention import (FLASH_MIN_T,
                                                packed_envelope_ok)
-            B, T, C3 = qkv.shape
+            B, T, _ = qkv.shape
             data_n = mesh.shape.get("data", 1)
             if B % data_n != 0:
                 return None

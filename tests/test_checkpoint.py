@@ -26,6 +26,7 @@ def _trees_equal(a, b):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
+@pytest.mark.slow
 def test_roundtrip_full_state(tiny, tmp_path):
     m, t = tiny.model, tiny.train
     state = create_train_state(jax.random.PRNGKey(0), m, t)
@@ -42,6 +43,7 @@ def test_roundtrip_full_state(tiny, tmp_path):
     ck.close()
 
 
+@pytest.mark.slow
 def test_resume_training_is_identical(tiny, tmp_path):
     """Save at step 2, keep training to 5; restore at 2 and retrain to 5 —
     final params must be bit-identical (step-keyed dropout RNG makes the
@@ -82,6 +84,7 @@ def test_batcher_cursor_roundtrip(tiny, tmp_path):
     ck.close()
 
 
+@pytest.mark.slow
 def test_latest_step(tiny, tmp_path):
     m, t = tiny.model, tiny.train
     state = create_train_state(jax.random.PRNGKey(0), m, t)
@@ -100,6 +103,7 @@ def test_latest_step(tiny, tmp_path):
     ck.close()
 
 
+@pytest.mark.slow
 def test_graceful_stop_checkpoints_and_resumes(tmp_path):
     """stop_event mid-run saves a resumable checkpoint (the preemption
     path, SURVEY.md §5 failure-detection row: the reference loses the whole
@@ -182,6 +186,7 @@ def test_restore_rejects_mismatched_rng_impl(tmp_path):
         ck.restore_latest(template)
 
 
+@pytest.mark.slow
 def test_sharded_resume_restores_mesh_layout(tmp_path):
     """FSDP-mesh run: checkpoint at step 5, resume to 10 — restored leaves
     must carry their mesh shardings (an FSDP model must never restore
@@ -230,6 +235,7 @@ def test_sharded_resume_restores_mesh_layout(tmp_path):
     ck.close()
 
 
+@pytest.mark.slow
 def test_midrun_checkpoint_cursor_not_skewed_by_prefetch(tmp_path):
     """The prefetch producer draws scan_k x depth batches ahead of the
     consumed step; a mid-run checkpoint must save the cursor as-of the

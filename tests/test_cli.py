@@ -28,6 +28,7 @@ def ckdir(tmp_path_factory):
     return ck, log
 
 
+@pytest.mark.slow
 def test_train_writes_checkpoint_and_jsonl(ckdir):
     ck, log = ckdir
     assert os.path.isdir(os.path.join(ck, "30"))

@@ -291,6 +291,7 @@ def test_tri_tile_map():
     assert km.tolist() == [[0, 0, 0, 1, 1, 2], [0, 1, 2, 1, 2, 2]]
 
 
+@pytest.mark.slow
 def test_auto_tile_512_parity_and_grads():
     """T=1024 auto-selects 512-wide tiles (_auto_block); the causal
     n_kv bound, the dkv first_q skip, and the dropout tiling must hold
@@ -315,6 +316,7 @@ def test_auto_tile_512_parity_and_grads():
                                rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_fused_single_tile_bwd_matches_split_kernels():
     """T == block triggers the fused dq/dk/dv backward; forcing smaller
     blocks runs the split dq + dkv kernels. Gradients must agree (same
@@ -341,6 +343,7 @@ def test_fused_single_tile_bwd_matches_split_kernels():
                                        rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_fused_multi_tile_bwd_matches_split_kernels():
     """The kv-major fully-fused backward (1 < n_tiles, dq in VMEM
     scratch) must match the split dq + dkv kernels; forcing tiny blocks

@@ -34,6 +34,7 @@ def _naive_greedy(params, prompt, n_new):
     return np.concatenate(out, axis=1).astype(np.int32)
 
 
+@pytest.mark.slow
 def test_greedy_matches_naive_rollout(params):
     prompt = np.array([[1, 5, 9], [3, 3, 3]], dtype=np.int32)
     n_new = 12  # stays within block_size
@@ -96,6 +97,7 @@ def test_temperature_extremes(params):
     np.testing.assert_array_equal(np.asarray(cold), np.asarray(greedy))
 
 
+@pytest.mark.slow
 def test_sharded_decode_matches_single_device(params):
     """TP-sharded decoding (shard_for_decode + the unchanged generate)
     must produce the same greedy tokens as the single-device path: the

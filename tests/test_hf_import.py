@@ -71,3 +71,40 @@ def test_untied_import_copies_head(hf_model):
     mcfg = mcfg.__class__(**{**mcfg.__dict__, "tied_head": False})
     params = import_hf_state_dict(hf_model.state_dict(), mcfg)
     np.testing.assert_array_equal(params["lm_head"], params["wte"].T)
+
+
+def test_golden_fixture_real_gpt2():
+    """Fixture-pinned import of the REAL HF gpt2 124M weights
+    (VERDICT r2 item 7): tools/make_hf_fixture.py records (input ids,
+    logits slice, loss) from a networked environment once; this test
+    re-runs the import + forward and must reproduce them bit-tightly,
+    independent of transformers' model code. Skips until both the
+    fixture and the cached weights exist (this dev image has neither —
+    zero egress)."""
+    import os
+
+    fix_path = os.path.join(os.path.dirname(__file__), "fixtures",
+                            "hf_gpt2_golden.npz")
+    if not os.path.exists(fix_path):
+        pytest.skip("golden fixture not generated yet "
+                    "(tools/make_hf_fixture.py needs network once)")
+    from replicatinggpt_tpu.interop.hf import from_pretrained
+    try:
+        params, mcfg = from_pretrained("gpt2")
+    except OSError as e:
+        # transformers raises OSError (incl. its EnvironmentError
+        # subclasses) for missing/offline weights — ONLY that skips; any
+        # other exception is a real import-path regression and must FAIL
+        pytest.skip(f"real gpt2 weights unavailable offline: {e!r}")
+    import jax
+
+    from replicatinggpt_tpu.models.gpt import forward
+    fix = np.load(fix_path)
+    ids = fix["input_ids"]
+    logits, loss = forward(params, ids, mcfg, targets=ids)
+    logits = np.asarray(jax.device_get(logits), np.float32)
+    np.testing.assert_allclose(logits[:, :8, :256], fix["logits_slice"],
+                               atol=1e-3, rtol=1e-4)
+    np.testing.assert_allclose(float(loss), float(fix["loss"]), rtol=1e-4)
+    np.testing.assert_allclose(logits.mean(), float(fix["logits_mean"]),
+                               atol=1e-3)

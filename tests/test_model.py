@@ -55,6 +55,7 @@ def test_shorter_sequence_than_block_size():
     assert logits.shape == (4, 7, TINY.vocab_size)
 
 
+@pytest.mark.slow
 def test_dropout_rng_determinism():
     cfg = ModelConfig(vocab_size=65, block_size=16, n_layer=2, n_head=2,
                       n_embd=32, dropout=0.5, attn_dropout=0.5,
@@ -127,6 +128,7 @@ def test_scan_vs_unrolled_equivalence():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     remat_cfg = ModelConfig(**{**TINY.__dict__, "remat": True})
     params = init_params(jax.random.PRNGKey(0), TINY)

@@ -1,6 +1,9 @@
 """Host-side multi-process glue that can be tested without a pod: the
 sharded SequentialBatcher must tile the exact single-host token stream."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process spawns
 import numpy as np
 
 from replicatinggpt_tpu.data.loader import SequentialBatcher

@@ -11,6 +11,7 @@ from replicatinggpt_tpu import native
 from replicatinggpt_tpu.tokenizers import ByteBPETokenizer, CharTokenizer
 
 
+@pytest.mark.slow
 def test_native_library_builds():
     assert native.available(), (
         "native fastpath failed to build; run "
@@ -38,6 +39,7 @@ def test_non_ascii_vocab_falls_back(tiny_corpus):
     assert tok.encode_np(s).tolist() == tok.encode(s)
 
 
+@pytest.mark.slow
 def test_gather_batch_matches_numpy():
     rng = np.random.default_rng(0)
     data = rng.integers(0, 1000, size=10_000).astype(np.int32)
@@ -90,6 +92,7 @@ def test_bpe_native_on_adversarial_text():
     assert tok.encode_np(text).tolist() == tok.encode(text)
 
 
+@pytest.mark.slow
 def test_random_batcher_stream_unchanged_by_native(tiny_corpus):
     # the seeded token stream must not depend on which gather path runs
     from replicatinggpt_tpu.data.loader import RandomBatcher

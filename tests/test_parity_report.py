@@ -2,7 +2,10 @@
 
 import os
 
+import pytest
 
+
+@pytest.mark.slow
 def test_parity_report_runs(tmp_path):
     from replicatinggpt_tpu.parity_report import main
     out = str(tmp_path / "report.md")

@@ -29,6 +29,7 @@ def _mcfg(**kw):
     ((1, 1, 2, 4), 4),   # PP x TP (Megatron block inside the region)
     ((2, 1, 2, 2), 2),   # PP x TP x DP
 ])
+@pytest.mark.slow
 def test_pipeline_forward_matches_dense(axes, micro):
     data, seq, model, pipe = axes
     mesh_cfg = MeshConfig(data=data, seq=seq, model=model, pipe=pipe,
@@ -46,6 +47,7 @@ def test_pipeline_forward_matches_dense(axes, micro):
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_train_step_matches_dense():
     from replicatinggpt_tpu.train.state import create_train_state
     from replicatinggpt_tpu.train.steps import make_train_step
@@ -89,6 +91,7 @@ def test_pipeline_params_sharded_by_stage():
     assert specs["params"]["wte"][0] != "pipe"
 
 
+@pytest.mark.slow
 def test_pipeline_tp_grads_match_dense():
     """TP-inside-PP backward: psum/identity transposes through the Megatron
     block must give the same parameter gradients as the dense stack."""
@@ -118,6 +121,7 @@ def test_pipeline_tp_grads_match_dense():
             err_msg=jax.tree_util.keystr(pl_))
 
 
+@pytest.mark.slow
 def test_pipeline_tp_falls_back_when_heads_indivisible():
     """n_head % tp != 0: kernels replicate through the region (old
     behavior) instead of mis-sharding heads."""

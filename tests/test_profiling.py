@@ -4,6 +4,8 @@ supplied as jax.profiler traces + blocking step-latency statistics)."""
 import glob
 import os
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 
@@ -65,6 +67,7 @@ def test_step_timer_laps_block():
     assert t.summary()["n"] == 1
 
 
+@pytest.mark.slow
 def test_runner_profile_dir(tmp_path):
     from replicatinggpt_tpu.config import get_config
     from replicatinggpt_tpu.train.runner import train

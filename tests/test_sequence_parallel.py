@@ -30,6 +30,7 @@ def _mesh(data=1, seq=8, model=1):
 
 
 @pytest.mark.parametrize("axes", [(1, 8, 1), (2, 2, 2)])
+@pytest.mark.slow
 def test_ring_matches_dense(axes):
     data, seq, model = axes
     mesh, _ = _mesh(data, seq, model)
@@ -51,6 +52,7 @@ def test_ulysses_matches_dense(axes):
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_dense():
     mesh, _ = _mesh(1, 8, 1)
     q, k, v = _qkv(T=32)
@@ -68,6 +70,7 @@ def test_ring_gradients_match_dense():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ulysses_gradients_match_dense():
     mesh, _ = _mesh(1, 4, 1)
     q, k, v = _qkv(T=32)
@@ -107,6 +110,7 @@ def test_ring_under_jit_with_sharded_inputs():
 @pytest.mark.parametrize("core,axes", [("ring", (1, 4, 1)),
                                        ("ring", (2, 2, 2)),
                                        ("ulysses", (1, 4, 1))])
+@pytest.mark.slow
 def test_seq_parallel_dropout_statistics(core, axes):
     """q=k=0 makes weights uniform over the causal prefix; with v=1 each
     output entry is (#kept / #allowed) / (1 - rate_q), so the global mean
@@ -135,6 +139,7 @@ def test_seq_parallel_dropout_statistics(core, axes):
 
 
 @pytest.mark.parametrize("core", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_seq_parallel_dropout_off_paths_unchanged(core):
     """rate=0 / train=False / rng=None must all reduce to the exact
     dropout-free computation."""
@@ -150,6 +155,7 @@ def test_seq_parallel_dropout_off_paths_unchanged(core):
 
 
 @pytest.mark.parametrize("core", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_seq_parallel_dropout_grads_match_finite_difference(core):
     """Both cores' dropout masks regenerate deterministically from
     (rng, shard indices, and for the ring: hop, chunk) in the VJP
@@ -181,6 +187,7 @@ def test_seq_parallel_dropout_grads_match_finite_difference(core):
                                    rtol=2e-2, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_ring_q_chunking_matches_unchunked():
     """Chunking only re-blocks the q rows; every row's reductions run in
     the same order, so chunked and unchunked results are identical."""
@@ -239,6 +246,7 @@ def _ring_fn(mesh, **kw):
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
 
 
+@pytest.mark.slow
 def test_ring_flash_hops_match_einsum_hops():
     """hop_impl='flash' routes hops through the Pallas chunk kernel with
     lse-merged accumulation; output and grads must match the einsum ring
@@ -262,6 +270,7 @@ def test_ring_flash_hops_match_einsum_hops():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_flash_hop_dropout_statistics():
     """In-kernel dropout on the flash hops: uniform-weights construction
     recovers the quantized keep rate; deterministic in rng."""
@@ -283,6 +292,7 @@ def test_ring_flash_hop_dropout_statistics():
 
 
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_train_step_with_sequence_parallelism(impl):
     """Full sharded train step, seq axis 2: loss finite and close to the
     unsharded single-device step on identical init + batch."""
@@ -320,6 +330,7 @@ def test_train_step_with_sequence_parallelism(impl):
     np.testing.assert_allclose(loss, float(m0["loss"]), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_chunk_fused_bwd_matches_split_kernels():
     """The kv-major fused chunk backward (default within the dq-scratch
     bound) must match the split dq + dkv chunk kernels — multi-kv-tile

@@ -136,6 +136,7 @@ def test_tp_forward_matches_unsharded(tcfg):
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_fsdp_training_matches_single_device(tcfg):
     tcfg = dataclasses.replace(tcfg, lr=1e-3)
     batch = _batch(TINY, B=8)
@@ -155,6 +156,7 @@ def test_fsdp_training_matches_single_device(tcfg):
     assert "data" in tuple(qkv.sharding.spec)
 
 
+@pytest.mark.slow
 def test_runner_with_mesh(tcfg):
     """End-to-end runner on a 4-way DP mesh."""
     cfg = get_config("test-tiny")
@@ -170,6 +172,7 @@ def test_runner_with_mesh(tcfg):
     assert np.isfinite(res.final_eval["val"])
 
 
+@pytest.mark.slow
 def test_mesh_scan_dispatch_matches_single_steps(tcfg):
     """K-step scan over a P(None,'data','seq')-sharded superbatch must
     produce the same per-step losses as K single-step dispatches on the
@@ -203,6 +206,7 @@ def test_mesh_scan_dispatch_matches_single_steps(tcfg):
             == s1.params["blocks"]["qkv_kernel"].sharding.spec)
 
 
+@pytest.mark.slow
 def test_runner_mesh_multi_step_dispatch_matches_single(tcfg):
     """End-to-end: the runner with steps_per_dispatch>1 on a DP mesh walks
     the same eval-loss trajectory as single-step dispatch (identical token
@@ -226,6 +230,7 @@ def test_runner_mesh_multi_step_dispatch_matches_single(tcfg):
     np.testing.assert_allclose(h1, h2, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_runner_gates_flash_auto_on_mesh(tcfg):
     """'auto' must not resolve to the Pallas flash kernel inside a sharded
     jit program (no GSPMD partitioning rule) — the runner rewrites it to
@@ -249,6 +254,7 @@ def test_runner_gates_flash_auto_on_mesh(tcfg):
     assert "'auto' -> 'einsum'" in stream.getvalue()
 
 
+@pytest.mark.slow
 def test_grad_accum_on_mesh_matches_unsharded(tcfg):
     """Gradient accumulation on a (data, seq) mesh — (A, b, T) microbatch
     stack sharded P(None,'data','seq') — must match the unsharded step
@@ -292,6 +298,7 @@ def _wrapper_qkv(B=8, H=4, T=256, D=32, seed=0):
     return tuple(jax.random.normal(k, (B, H, T, D), jnp.float32) for k in ks)
 
 
+@pytest.mark.slow
 def test_sharded_flash_wrapper_matches_einsum_interpret(monkeypatch):
     """The shard_map wrapper running the *actual Pallas kernel* (interpret
     mode on CPU) over a (data=4, model=2) mesh must match the unsharded
@@ -347,6 +354,7 @@ def test_sharded_flash_wrapper_dropout_streams_decorrelate(monkeypatch):
         "data shards 0 and 1 drew identical dropout masks"
 
 
+@pytest.mark.slow
 def test_dp_training_with_flash_wrapper_matches_single_device(tcfg):
     """DP training through the shard_map wrapper (explicit 'flash'; the
     local core resolves to SDPA on CPU) must match single-device training
@@ -431,6 +439,7 @@ def test_sharded_flash_wrapper_self_guards_indivisible_dims():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_mesh_packed_qkv_hook_matches_single_device(monkeypatch):
     """On a DP/FSDP mesh the wrapper's packed_qkv hook must route the
     fused (B,T,3C) projection through the packed-heads kernel (interpret

@@ -61,6 +61,7 @@ def test_estimate_loss_means_over_splits(tiny):
         assert abs(v - np.log(m.vocab_size)) < 0.5
 
 
+@pytest.mark.slow
 def test_runner_end_to_end_loss_decreases(tiny, tmp_path):
     """Full pipeline on real Tiny Shakespeare, 60 steps of the tiny model:
     val loss must drop below the uniform-random baseline ln(65)≈4.17."""
@@ -92,6 +93,7 @@ def test_lr_schedule_warmup_cosine():
     assert _make_lr_reader(get_config("test-tiny").train)(10) is None
 
 
+@pytest.mark.slow
 def test_train_scan_matches_single_steps(tiny):
     """K-step lax.scan dispatch must be semantically identical to K single
     steps (same per-step RNG fold, same optimizer stepping)."""
@@ -127,6 +129,7 @@ def test_train_scan_matches_single_steps(tiny):
         s1.params, s2.params)
 
 
+@pytest.mark.slow
 def test_runner_steps_per_dispatch_same_result(tiny):
     """Runner with steps_per_dispatch>1 reaches the same final eval as the
     single-step loop (identical seeded batch stream + step semantics)."""
@@ -166,6 +169,7 @@ def test_estimate_loss_scan_matches_loop(tiny):
         assert abs(loop[split] - scan[split]) < 1e-5
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_full_batch(tiny):
     """grad_accum_steps=A over (A, b, T) microbatches must take the same
     optimizer step as one full (A*b, T) batch: equal-sized microbatch
@@ -200,6 +204,7 @@ def test_grad_accum_matches_full_batch(tiny):
         s_full.params, s_acc.params)
 
 
+@pytest.mark.slow
 def test_grad_accum_with_dropout_deterministic(tiny):
     """Under dropout, accumulation draws a distinct mask stream per
     microbatch (rng folded on the scan index) and the step is a pure
@@ -221,6 +226,7 @@ def test_grad_accum_with_dropout_deterministic(tiny):
         s1.params, s2.params)
 
 
+@pytest.mark.slow
 def test_runner_grad_accum_composes_with_scan_dispatch(tiny):
     """Runner with grad_accum_steps>1 walks the same trajectory whether
     steps are dispatched one at a time or K per lax.scan (the (K, A, B, T)

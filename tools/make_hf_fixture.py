@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Create the golden GPT-2 124M import fixture (VERDICT r2 item 7).
+
+Run once in an environment where the real HF ``gpt2`` weights are
+available (downloaded or cached — this dev image has zero egress and no
+cache, so the fixture ships empty until a networked run executes this):
+
+    python tools/make_hf_fixture.py [--model gpt2] \
+        [--out tests/fixtures/hf_gpt2_golden.npz]
+
+It imports the real weights through ``interop.hf.from_pretrained``,
+runs the framework forward on a fixed token sequence, and records
+(input ids, a logits slice, loss) so ``tests/test_hf_import.py``'s
+fixture test can re-verify the import mapping offline forever after —
+independent of ``transformers``' model code or randomness.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2")
+    p.add_argument("--out", default="tests/fixtures/hf_gpt2_golden.npz")
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+
+    from replicatinggpt_tpu.interop.hf import from_pretrained
+    from replicatinggpt_tpu.models.gpt import forward
+
+    params, mcfg = from_pretrained(args.model)
+    # fixed, tokenizer-independent input: deterministic ids < 50257
+    rng = np.random.default_rng(1337)
+    ids = rng.integers(0, 50257, (2, 64), dtype=np.int32)
+    logits, loss = forward(params, ids, mcfg, targets=ids)
+    logits = np.asarray(jax.device_get(logits), np.float32)
+    np.savez_compressed(
+        args.out,
+        model=args.model,
+        input_ids=ids,
+        # full logits for 2x64x50257 is ~25 MB; keep a dense slice plus
+        # global moments — plenty to pin the mapping
+        logits_slice=logits[:, :8, :256],
+        logits_mean=np.float32(logits.mean()),
+        logits_std=np.float32(logits.std()),
+        loss=np.float32(jax.device_get(loss)),
+    )
+    print(f"wrote {args.out}: loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()
