@@ -182,9 +182,12 @@ def torch_cpu_baseline(mcfg, batch_size: int, remeasure: bool) -> float:
     return tps
 
 
-def measure_generate_p50(mcfg, tcfg, steps: int = 4) -> dict:
+def measure_generate_p50(mcfg, tcfg, steps: int = 4,
+                         batch_size: int = 1, state=None) -> dict:
     """BASELINE.json config 5: autoregressive generate latency — 1k-token
-    sample, p50 tokens/sec — with real device->host fetch per lap."""
+    sample, p50 tokens/sec — with real device->host fetch per lap.
+    ``batch_size`` > 1 measures batched decode (aggregate throughput =
+    B * 1000 / p50); pass ``state`` to reuse one model across a sweep."""
     import jax
     import jax.numpy as jnp
 
@@ -192,10 +195,11 @@ def measure_generate_p50(mcfg, tcfg, steps: int = 4) -> dict:
     from replicatinggpt_tpu.train.state import create_train_state
     from replicatinggpt_tpu.utils.profiling import StepTimer
 
-    state = create_train_state(jax.random.PRNGKey(0), mcfg, tcfg)
+    if state is None:
+        state = create_train_state(jax.random.PRNGKey(0), mcfg, tcfg)
     gcfg = GenerateConfig(max_new_tokens=1000, top_k=50)
-    prompt = jnp.zeros((1, 1), jnp.int32)
-    log(f"generate bench: 1000 tokens, top-k 50, "
+    prompt = jnp.zeros((batch_size, 1), jnp.int32)
+    log(f"generate bench: B={batch_size}, 1000 tokens, top-k 50, "
         f"{mcfg.n_layer}L/{mcfg.n_head}H/{mcfg.n_embd}C")
     jax.device_get(generate(state.params, prompt, mcfg, gcfg))  # warm/compile
     timer = StepTimer()
@@ -204,12 +208,39 @@ def measure_generate_p50(mcfg, tcfg, steps: int = 4) -> dict:
         toks = generate(state.params, prompt, mcfg, gcfg,
                         rng=jax.random.PRNGKey(i))
         timer.lap(toks)
-    s = timer.summary(tokens_per_step=gcfg.max_new_tokens)
+    s = timer.summary(tokens_per_step=gcfg.max_new_tokens * batch_size)
     log(f"generate: p50 {s['p50_s'] * 1e3:.1f} ms/1k-tok, "
-        f"{s['tokens_per_sec_per_chip']:,.0f} tok/s p50")
+        f"{s['tokens_per_sec_per_chip']:,.0f} aggregate tok/s p50")
     return {"generate_1k_p50_s": round(s["p50_s"], 4),
             "generate_tokens_per_sec_p50":
-                round(s["tokens_per_sec_per_chip"], 1)}
+                round(s["tokens_per_sec_per_chip"], 1),
+            "batch_size": batch_size}
+
+
+def bench_decode_sweep(args) -> None:
+    """Batched decode: aggregate tok/s vs batch size, one model/state
+    reused across the sweep (the RESULTS.md batched-decode table)."""
+    import jax
+
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.train.state import create_train_state
+
+    cfg = get_config(args.preset)
+    state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
+    rows = {}
+    laps = min(args.steps, 8)  # per-lap cost grows with B; 5-8 laps
+    for B in (int(b) for b in args.decode_batch_sizes.split(",")):
+        r = measure_generate_p50(cfg.model, cfg.train, steps=laps,
+                                 batch_size=B, state=state)
+        rows[f"B{B}"] = r
+    last = rows[sorted(rows, key=lambda k: int(k[1:]))[-1]]
+    emit({
+        "metric": "generate_batched_aggregate_tokens_per_sec_p50",
+        "value": last["generate_tokens_per_sec_p50"],
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,  # reference publishes no generation numbers
+        "sweep": rows,
+    })
 
 
 def bench_generate(args) -> None:
@@ -506,7 +537,11 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="char-gpt")
     p.add_argument("--mode", default="train",
-                   choices=["train", "generate", "longctx", "kernel"])
+                   choices=["train", "generate", "longctx", "kernel",
+                            "decode"])
+    p.add_argument("--decode-batch-sizes", default="1,8,32",
+                   help="--mode decode: comma-separated batch sizes for "
+                        "the aggregate-throughput sweep")
     p.add_argument("--longctx-t", type=int, default=32768,
                    help="sequence length for --mode longctx")
     p.add_argument("--repeats", type=int, default=7,
@@ -547,8 +582,9 @@ def main() -> None:
               "longctx": f"longctx_t{args.longctx_t}_train_tokens_per_sec"
                          "_per_chip",
               "kernel": "flash_kernel_fwdbwd_median_ms",
+              "decode": "generate_batched_aggregate_tokens_per_sec_p50",
               "train": "char_gpt_train_tokens_per_sec_per_chip"}[args.mode]
-    unit = ("tokens/sec" if args.mode == "generate"
+    unit = ("tokens/sec" if args.mode in ("generate", "decode")
             else "ms" if args.mode == "kernel" else "tokens/sec/chip")
     start_watchdog(args.watchdog, metric, unit)
 
@@ -565,6 +601,8 @@ def main() -> None:
             bench_longctx(args)
         elif args.mode == "kernel":
             bench_kernel(args)
+        elif args.mode == "decode":
+            bench_decode_sweep(args)
         else:
             bench_train(args)
     except BaseException as e:  # noqa: BLE001 — artifact must still emit
