@@ -299,17 +299,31 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     x = x[:, None, :]  # (B, 1, C)
 
     def body(carry, inputs):
-        h_in, = carry
-        lp, k_cache, v_cache = inputs
+        # Caches ride the carry as the full stacked (L, B, H, S, D)
+        # arrays, updated by dynamic_update_slice at (layer, pos) — XLA
+        # keeps ONE buffer in place across layers and across the outer
+        # decode scan. The previous formulation emitted per-layer caches
+        # as scan ys, which allocates and copies the entire cache every
+        # generated token (measured: decode step time scaled with cache
+        # bytes, 0.44 ms at B=8 -> 1.54 ms at B=32 for a model whose
+        # per-token math is microseconds).
+        h_in, ck, cv = carry
+        lp, layer_idx = inputs
         h = _layer_norm(h_in, lp["ln1_scale"], lp["ln1_bias"],
                         cfg.layernorm_eps)
         qkv = h @ lp["qkv_kernel"].astype(cd) + lp["qkv_bias"].astype(cd)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (_split_heads(t, cfg.n_head) for t in (q, k, v))  # (B,H,1,D)
-        k_cache = jax.lax.dynamic_update_slice_in_dim(
-            k_cache, k.astype(k_cache.dtype), pos, axis=2)
-        v_cache = jax.lax.dynamic_update_slice_in_dim(
-            v_cache, v.astype(v_cache.dtype), pos, axis=2)
+        zero = jnp.int32(0)
+        start = (layer_idx, zero, zero, pos, zero)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype)[None],
+                                          start)
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype)[None],
+                                          start)
+        k_cache = jax.lax.dynamic_index_in_dim(ck, layer_idx, 0,
+                                               keepdims=False)
+        v_cache = jax.lax.dynamic_index_in_dim(cv, layer_idx, 0,
+                                               keepdims=False)
         attn = cached_attention(q, k_cache, v_cache, pos)
         attn = _merge_heads(attn)
         attn = (attn @ lp["attn_out_kernel"].astype(cd)
@@ -320,21 +334,22 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
         h = _activation(h @ lp["mlp_up_kernel"].astype(cd)
                         + lp["mlp_up_bias"].astype(cd), cfg.activation)
         h = h @ lp["mlp_down_kernel"].astype(cd) + lp["mlp_down_bias"].astype(cd)
-        return (h_mid + h,), (k_cache, v_cache)
+        return (h_mid + h, ck, cv), None
 
     if cfg.use_layer_scan:
-        (x,), (new_k, new_v) = jax.lax.scan(
-            body, (x,), (params["blocks"], cache["k"], cache["v"]))
+        layer_ids = jnp.arange(cfg.n_layer)
+        (x, new_k, new_v), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], layer_ids))
     else:
         # shallow stacks: unrolled layers fuse/overlap better (same
-        # measured rationale as _run_blocks); caches restack to (L, ...)
-        ks, vs = [], []
+        # measured rationale as _run_blocks); the static Python index
+        # keeps the layer offset a compile-time constant
+        carry = (x, cache["k"], cache["v"])
         for i in range(cfg.n_layer):
             lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
-            (x,), (k_i, v_i) = body((x,), (lp, cache["k"][i], cache["v"][i]))
-            ks.append(k_i)
-            vs.append(v_i)
-        new_k, new_v = jnp.stack(ks), jnp.stack(vs)
+            carry, _ = body(carry, (lp, i))
+        x, new_k, new_v = carry
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                     cfg.layernorm_eps)
     head = (params["wte"].astype(cd).T if cfg.tied_head
