@@ -211,9 +211,13 @@ def measure_generate_p50(mcfg, tcfg, steps: int = 4,
     s = timer.summary(tokens_per_step=gcfg.max_new_tokens * batch_size)
     log(f"generate: p50 {s['p50_s'] * 1e3:.1f} ms/1k-tok, "
         f"{s['tokens_per_sec_per_chip']:,.0f} aggregate tok/s p50")
+    # Distinct keys: B=1 is per-stream latency-derived throughput; B>1 is
+    # aggregate (B x per-stream) — the same key would make artifacts from
+    # the two modes silently incomparable.
+    tps_key = ("generate_tokens_per_sec_p50" if batch_size == 1
+               else "generate_aggregate_tokens_per_sec_p50")
     return {"generate_1k_p50_s": round(s["p50_s"], 4),
-            "generate_tokens_per_sec_p50":
-                round(s["tokens_per_sec_per_chip"], 1),
+            tps_key: round(s["tokens_per_sec_per_chip"], 1),
             "batch_size": batch_size}
 
 
@@ -236,7 +240,8 @@ def bench_decode_sweep(args) -> None:
     last = rows[sorted(rows, key=lambda k: int(k[1:]))[-1]]
     emit({
         "metric": "generate_batched_aggregate_tokens_per_sec_p50",
-        "value": last["generate_tokens_per_sec_p50"],
+        "value": last.get("generate_aggregate_tokens_per_sec_p50",
+                          last.get("generate_tokens_per_sec_p50")),
         "unit": "tokens/sec",
         "vs_baseline": 0.0,  # reference publishes no generation numbers
         "sweep": rows,

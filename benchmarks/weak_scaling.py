@@ -93,11 +93,15 @@ compile_s = time.perf_counter() - t0
 mem = {}
 try:
     ma = compiled.memory_analysis()
+    # donate=False above means the step's outputs (the new train state)
+    # are fresh buffers live alongside the arguments at peak — include
+    # them, or the pod-planning estimate understates true peak usage.
     mem = {"temp_bytes": int(ma.temp_size_in_bytes),
            "argument_bytes": int(ma.argument_size_in_bytes),
            "output_bytes": int(ma.output_size_in_bytes),
            "peak_estimate_gb": round((ma.temp_size_in_bytes
-                                      + ma.argument_size_in_bytes)
+                                      + ma.argument_size_in_bytes
+                                      + ma.output_size_in_bytes)
                                      / 2**30, 3)}
 except Exception as e:  # backend without memory_analysis
     mem = {"memory_analysis_error": str(e)[:120]}

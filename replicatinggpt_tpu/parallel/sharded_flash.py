@@ -93,7 +93,22 @@ def sharded_flash_attention(q, k, v, *, mesh: Mesh,
     # every device computes the whole batch's attention redundantly —
     # wasteful, but memory-efficient and what the user asked for (dense
     # einsum at the long T that motivates 'flash' would materialize the
-    # O(T^2) weights instead).
+    # O(T^2) weights instead). Runtime-signal the N-fold redundancy once.
+    if dropped:
+        import warnings
+        parts = []
+        if data_n > 1 and batch_axis is None:
+            parts.append(f"batch (B={q.shape[0]} vs data={data_n})")
+        if model_n > 1 and head_axis is None:
+            parts.append(f"heads (H={q.shape[1]} vs model={model_n})")
+        warnings.warn(
+            f"sharded flash attention: {' and '.join(parts)} do(es) not "
+            "divide the mesh axis, so that dimension is replicated — "
+            "every device along the dropped axis redundantly computes it "
+            "(explicit impl='flash' opts into this for the "
+            "memory-efficient kernel). Pad the dimension to a multiple "
+            "of the mesh axis to shard the compute.",
+            stacklevel=2)
     spec = P(batch_axis, head_axis, None, None)
     local = functools.partial(_local_attention, scale=scale,
                               dropout_rate=dropout_rate, impl=impl,
