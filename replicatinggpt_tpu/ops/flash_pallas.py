@@ -1752,24 +1752,320 @@ def _flash_packed_bwd_rule(scale, causal, n_head, block_q, block_k,
 _flash_packed.defvjp(_flash_packed_fwd_rule, _flash_packed_bwd_rule)
 
 
+# ---------------------------------------------------------------------------
+# packed head-group family: the packed layout past the full-residency bound
+#
+# The resident packed family above needs the whole (T, 3C) block (plus
+# do/dqkv/scratch in the backward, ~2.8x) in VMEM, which caps it at
+# PACKED_QKV_BYTES — char-GPT fits (0.6 MB), GPT-2 124M (T=1024, C=768:
+# 4.7 MB, backward ~14 MB) does not; Mosaic refuses the allocation
+# (benchmarks/RESULTS.md round-3 "measured and rejected" row). This
+# family keeps the no-transpose property but shrinks residency from
+# O(T*3C) to O(T*W) by splitting heads into lane-aligned GROUPS: a group
+# is hpg = max(1, 128 // D) adjacent heads, W = hpg*D in {128, 256}
+# columns wide, so the group's q/k/v strips are addressable as last-dim
+# BlockSpec blocks of the untouched (B, T, 3C) array (block width W is
+# lane-aligned where a bare D=64 head strip is Mosaic-unrepresentable).
+# Grid carries (batch, group): each program sees only its (T, W) strips
+# — 124M: 256 KB vs the 4.7 MB full block — and loops its hpg sub-heads
+# as static in-kernel lane slices, exactly like the resident family
+# loops all H. The head->HBM gather that the (B,H,T,D) families pay as
+# separate transpose ops happens inside the kernel's double-buffered
+# block fetches instead.
+#
+# Forward grid is (B, G, n_q) with K/V strip index maps independent of
+# the q axis (fetched once per (b, g), pipelined across q blocks);
+# online-softmax state lives in registers within one grid step — no
+# cross-step carry, no scratch state. Backward is the fused kv-major
+# form of the resident packed backward on one (b, g) per program: one
+# p/ds recompute per (sub-head, q-block, kv-block) serves dq (a (T, W)
+# f32 VMEM scratch), dk and dv (register accumulators, written per
+# kv-row-block). dq/dk/dv emerge as three (B, T, C) arrays whose
+# concatenation is the packed d(qkv) — one contiguous copy, no
+# transposes.
+#
+# Per-head tile math and the dropout counter stream key off
+# bh = b*H + (g*hpg + s), identical to every other family, so outputs
+# are bit-identical to the unpacked and resident-packed kernels.
+#
+# LSE layout: narrow (B, G, T, hpg) f32 — one column per sub-head, the
+# same equal-to-array-dim trailing block the resident family's (T, H)
+# lse output uses. The first cut of this family carried stats
+# strip-broadcast (B, G, T, W); at B=64 the extra ~600 MB/layer of lse +
+# delta temps pushed the 124M k-step scan 2.7 GB past HBM (measured OOM,
+# 18.46/15.75 GB) — narrow stats fit it back (and are 128x less traffic
+# than the unpacked families' (B*H, T, LANES) broadcasts).
+# ---------------------------------------------------------------------------
+
+# (T, W) strip-residency bound. Backward VMEM per program: q/k/v/do
+# strips (4S bf16, S = T*W*itemsize), dq/dk/dv outs (3S), (T, W) f32
+# dq scratch (2S), narrow (T, hpg) f32 lse/delta (negligible, ~S/16) —
+# ~9S, roughly doubled by block double-buffering; 512 KiB keeps the
+# worst case ~9 MiB under the ~16 MiB/core budget with headroom for
+# Mosaic's own temporaries. W=128 bf16 -> T <= 2048.
+GROUP_STRIP_BYTES = 512 * 1024
+
+
+def _group_geometry(C: int, n_head: int):
+    """(D, heads_per_group, W, n_groups) for the head-group family, or
+    None when heads cannot form lane-aligned groups."""
+    if C % n_head != 0:
+        return None
+    D = C // n_head
+    if D not in (32, 64, 128, 256):
+        return None
+    hpg = max(1, 128 // D)
+    if n_head % hpg != 0:
+        return None
+    return D, hpg, hpg * D, n_head // hpg
+
+
+def packed_group_supported(T: int, C: int, n_head: int,
+                           itemsize: int) -> bool:
+    """Envelope for the head-group packed family (see GROUP_STRIP_BYTES)."""
+    geo = _group_geometry(C, n_head)
+    return (geo is not None and T >= 128 and T % 128 == 0
+            and T * geo[2] * itemsize <= GROUP_STRIP_BYTES)
+
+
+def _fwd_kernel_group(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      scale, causal, n_head, head_dim, heads_per_group,
+                      seq_len, block_q, block_k, dropout_rate):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    jb = pl.program_id(2)
+    D = head_dim
+    q_first = jb * block_q
+    # jb is a grid index (traced), so the causal kv bound is a traced
+    # fori_loop bound with pl.ds row slices, as in _fwd_kernel
+    if causal:
+        n_kv = (q_first + block_q + block_k - 1) // block_k
+    else:
+        n_kv = seq_len // block_k
+    lses = []
+    for s in range(heads_per_group):
+        cols = slice(s * D, (s + 1) * D)
+        q = q_ref[:, cols]
+        bh = b * n_head + g * heads_per_group + s
+
+        def body(kb, carry, q=q, bh=bh, cols=cols):
+            acc, m, l = carry
+            k = k_ref[pl.ds(kb * block_k, block_k), cols]
+            v = v_ref[pl.ds(kb * block_k, block_k), cols]
+            return _fwd_tile(q, k, v, acc, m, l, scale=scale,
+                             causal=causal, q_first=q_first,
+                             k_first=kb * block_k, block_q=block_q,
+                             block_k=block_k, seed=seed_ref[0], bh=bh,
+                             dropout_rate=dropout_rate)
+
+        acc = jnp.zeros((block_q, D), jnp.float32)
+        m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        acc, m, l = jax.lax.fori_loop(0, n_kv, body, (acc, m0, l0))
+        l = jnp.maximum(l, 1e-30)
+        o_ref[:, cols] = (acc / l).astype(o_ref.dtype)
+        lses.append(m + jnp.log(l))
+    lse_ref[...] = jnp.concatenate(lses, axis=1)
+
+
+def _group_fwd(qkv, seed, scale, causal, n_head, block_q, block_k,
+               dropout_rate):
+    B, T, C3 = qkv.shape
+    C = C3 // 3
+    D, hpg, W, G = _group_geometry(C, n_head)
+    kernel = functools.partial(
+        _fwd_kernel_group, scale=scale, causal=causal, n_head=n_head,
+        head_dim=D, heads_per_group=hpg, seq_len=T, block_q=block_q,
+        block_k=block_k, dropout_rate=dropout_rate)
+    kw = {}
+    cp = _compiler_params(3, 3)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, G, T // block_q),
+        in_specs=[
+            _smem_spec(),
+            # three W-wide last-dim-blocked views of the one (B, T, 3C)
+            # array: q strip g, k strip G + g, v strip 2G + g. K/V maps
+            # ignore the q axis, so their fetches amortize across it.
+            _vmem_spec((None, block_q, W), lambda b, g, j: (b, j, g)),
+            _vmem_spec((None, T, W), lambda b, g, j: (b, 0, G + g)),
+            _vmem_spec((None, T, W), lambda b, g, j: (b, 0, 2 * G + g)),
+        ],
+        out_specs=[
+            _vmem_spec((None, block_q, W), lambda b, g, j: (b, j, g)),
+            _vmem_spec((None, None, block_q, hpg),
+                       lambda b, g, j: (b, g, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, C), qkv.dtype),
+            jax.ShapeDtypeStruct((B, G, T, hpg), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+        **kw,
+    )(seed, qkv, qkv, qkv)
+    # (B, G, T, hpg) -> (B, H, T) for the residual
+    lse_c = lse.transpose(0, 1, 3, 2).reshape(B, n_head, T)
+    return o, lse_c
+
+
+def _group_stats(x, hpg):
+    """(B, H, T) per-head rows -> the (B, G, T, hpg) column-per-sub-head
+    layout the group kernels read."""
+    B, H, T = x.shape
+    return x.reshape(B, H // hpg, hpg, T).transpose(0, 1, 3, 2)
+
+
+def _bwd_kernel_group(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dk_ref, dv_ref, dq_scratch, *,
+                      scale, causal, n_head, head_dim, heads_per_group,
+                      seq_len, block_q, block_k, dropout_rate):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    D, hpg = head_dim, heads_per_group
+    W = hpg * D
+    n_q = seq_len // block_q
+    n_kv = seq_len // block_k
+    dq_scratch[...] = jnp.zeros((seq_len, W), jnp.float32)
+    for kb in range(n_kv):
+        k_first = kb * block_k
+        krows = slice(kb * block_k, (kb + 1) * block_k)
+        for s in range(hpg):
+            cols = slice(s * D, (s + 1) * D)
+            k = k_ref[krows, cols]
+            v = v_ref[krows, cols]
+            dk_acc = jnp.zeros((block_k, D), jnp.float32)
+            dv_acc = jnp.zeros((block_k, D), jnp.float32)
+            bh = b * n_head + g * hpg + s
+            jb0 = (k_first // block_q) if causal else 0
+            for jb in range(jb0, n_q):
+                rows = slice(jb * block_q, (jb + 1) * block_q)
+                dk_c, dv_c, dsc = _dkv_tile(
+                    q_ref[rows, cols], k, v, do_ref[rows, cols],
+                    lse_ref[rows, s:s + 1],
+                    delta_ref[rows, s:s + 1], scale=scale,
+                    causal=causal, q_first=jb * block_q, k_first=k_first,
+                    block_q=block_q, block_k=block_k, seed=seed_ref[0],
+                    bh=bh, dropout_rate=dropout_rate)
+                dk_acc += dk_c
+                dv_acc += dv_c
+                dq_c = jax.lax.dot_general(
+                    dsc, k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                dq_scratch[rows, cols] += dq_c
+            dk_ref[krows, cols] = dk_acc.astype(dk_ref.dtype)
+            dv_ref[krows, cols] = dv_acc.astype(dv_ref.dtype)
+    dq_ref[...] = dq_scratch[...].astype(dq_ref.dtype)
+
+
+def _group_bwd(qkv, do, lse_c, delta_c, seed, scale, causal, n_head,
+               block_q, block_k, dropout_rate):
+    B, T, C3 = qkv.shape
+    C = C3 // 3
+    D, hpg, W, G = _group_geometry(C, n_head)
+    lse4 = _group_stats(lse_c, hpg)
+    delta4 = _group_stats(delta_c, hpg)
+    kernel = functools.partial(
+        _bwd_kernel_group, scale=scale, causal=causal, n_head=n_head,
+        head_dim=D, heads_per_group=hpg, seq_len=T, block_q=block_q,
+        block_k=block_k, dropout_rate=dropout_rate)
+    kw = {}
+    cp = _compiler_params(2, 2)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    strip = lambda blk: _vmem_spec((None, T, W), lambda b, g: (b, 0, blk(g)))
+    stat = _vmem_spec((None, None, T, hpg), lambda b, g: (b, g, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(B, G),
+        in_specs=[_smem_spec(),
+                  strip(lambda g: g), strip(lambda g: G + g),
+                  strip(lambda g: 2 * G + g), strip(lambda g: g),
+                  stat, stat],
+        out_specs=[strip(lambda g: g)] * 3,
+        out_shape=[jax.ShapeDtypeStruct((B, T, C), qkv.dtype)] * 3,
+        scratch_shapes=[_scratch((T, W))],
+        interpret=_interpret_mode(),
+        **kw,
+    )(seed, qkv, qkv, qkv, do, lse4, delta4)
+    return jnp.concatenate([dq, dk, dv], axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _flash_packed_group(qkv, seed, scale, causal, n_head, block_q, block_k,
+                        dropout_rate):
+    o, _ = _group_fwd(qkv, seed, scale, causal, n_head, block_q, block_k,
+                      dropout_rate)
+    return o
+
+
+def _flash_packed_group_fwd_rule(qkv, seed, scale, causal, n_head, block_q,
+                                 block_k, dropout_rate):
+    o, lse_c = _group_fwd(qkv, seed, scale, causal, n_head, block_q,
+                          block_k, dropout_rate)
+    return o, (qkv, seed, o, lse_c)
+
+
+def _flash_packed_group_bwd_rule(scale, causal, n_head, block_q, block_k,
+                                 dropout_rate, residuals, g):
+    qkv, seed, o, lse_c = residuals
+    B, T, C = o.shape
+    D = C // n_head
+    # delta = rowsum(do * o) per head, straight off the packed layout
+    delta_c = (g.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+        B, T, n_head, D).sum(-1).transpose(0, 2, 1)
+    dqkv = _group_bwd(qkv, g.astype(qkv.dtype), lse_c, delta_c, seed,
+                      scale, causal, n_head, block_q, block_k, dropout_rate)
+    return dqkv, None
+
+
+_flash_packed_group.defvjp(_flash_packed_group_fwd_rule,
+                           _flash_packed_group_bwd_rule)
+
+
 def pallas_flash_attention_packed(qkv: jnp.ndarray, n_head: int, *,
                                   scale: Optional[float] = None,
                                   causal: bool = True,
                                   block_q: Optional[int] = None,
                                   block_k: Optional[int] = None,
                                   dropout_rate: float = 0.0,
-                                  dropout_rng: Optional[jax.Array] = None
+                                  dropout_rng: Optional[jax.Array] = None,
+                                  family: Optional[str] = None
                                   ) -> jnp.ndarray:
     """Packed-heads flash attention. qkv: (B, T, 3C) — the fused QKV
     projection output, untouched. Returns the merged (B, T, C) attention
     output, ready for the output projection. Numerics (including the
     in-kernel dropout stream) are bit-identical to
-    ``pallas_flash_attention`` on the same logical q/k/v."""
+    ``pallas_flash_attention`` on the same logical q/k/v.
+
+    Routes by residency: the fully-resident family while (T, 3C) fits
+    PACKED_QKV_BYTES (short-T/many-head, e.g. char-GPT), the head-group
+    family while (T, W) strips fit GROUP_STRIP_BYTES (GPT-2-scale
+    T=1024). ``family`` ('resident' | 'group') overrides the routing —
+    for parity tests and for benchmarking the families against each
+    other on shapes both support."""
     B, T, C3 = qkv.shape
     C = C3 // 3
     D = C // n_head
     scale, rate, seed = _flash_prologue(D, scale, dropout_rate, dropout_rng)
     block_q = _block_for(T, block_q)
     block_k = _block_for(T, block_k)
-    return _flash_packed(qkv, seed, scale, bool(causal), n_head, block_q,
-                         block_k, rate)
+    itemsize = jnp.dtype(qkv.dtype).itemsize
+    if family is None:
+        family = ("resident" if packed_supported(T, C, n_head, itemsize)
+                  else "group" if packed_group_supported(T, C, n_head,
+                                                        itemsize)
+                  else None)
+    if family == "resident":
+        return _flash_packed(qkv, seed, scale, bool(causal), n_head,
+                             block_q, block_k, rate)
+    if family == "group":
+        if _group_geometry(C, n_head) is None:
+            raise ValueError(f"no lane-aligned head groups for C={C}, "
+                             f"n_head={n_head}")
+        return _flash_packed_group(qkv, seed, scale, bool(causal), n_head,
+                                   block_q, block_k, rate)
+    raise ValueError(
+        f"packed families do not support T={T}, C={C}, n_head={n_head}; "
+        "gate callers on ops.flash_attention.packed_envelope_ok")

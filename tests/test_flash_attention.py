@@ -554,3 +554,144 @@ def test_model_block_routes_packed(monkeypatch):
     assert calls, "packed path was not routed"
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4,
                                rtol=2e-4)
+
+
+# --- packed head-group family (GPT-2-scale shapes past the resident bound) --
+
+
+def test_group_fwd_bit_identical_to_unpacked():
+    """hpg=4 (D=32): four sub-heads lane-sliced per 128-wide strip."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 4, 32
+    qkv, C = _packed_inputs(B=2, T=128, H=H, D=D, seed=21)
+    B, T = qkv.shape[:2]
+    q, k, v = jnp.split(qkv, 3, -1)
+    ref = pallas_flash_attention(_heads(q, H), _heads(k, H), _heads(v, H))
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, C)
+    got = pallas_flash_attention_packed(qkv, H, family="group")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_group_fwd_single_head_groups():
+    """hpg=1 (D=128): strip == head, no in-kernel sub-head loop."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 2, 128
+    qkv, C = _packed_inputs(B=1, T=128, H=H, D=D, seed=22)
+    B, T = qkv.shape[:2]
+    q, k, v = jnp.split(qkv, 3, -1)
+    ref = pallas_flash_attention(_heads(q, H), _heads(k, H), _heads(v, H))
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, C)
+    got = pallas_flash_attention_packed(qkv, H, family="group")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_group_matches_resident_packed():
+    """Both packed families on the same in-envelope shape must agree
+    exactly (same tile math, same bh counter stream)."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H = 6
+    qkv, _ = _packed_inputs(B=2, T=256, H=H, D=64, seed=23)
+    res = pallas_flash_attention_packed(qkv, H, family="resident")
+    grp = pallas_flash_attention_packed(qkv, H, family="group")
+    np.testing.assert_array_equal(np.asarray(grp), np.asarray(res))
+
+
+def test_group_dropout_bit_identical_to_unpacked():
+    """Sub-head s of group g keys dropout off bh = b*H + g*hpg + s — the
+    global head counter — so masks must equal the unpacked family's."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 4, 32
+    qkv, C = _packed_inputs(B=2, T=128, H=H, D=D, seed=24)
+    B, T = qkv.shape[:2]
+    rng = jax.random.PRNGKey(9)
+    got = pallas_flash_attention_packed(qkv, H, family="group",
+                                        dropout_rate=0.2, dropout_rng=rng)
+    q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+    ref = pallas_flash_attention(q, k, v, dropout_rate=0.2, dropout_rng=rng)
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, C)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_group_grads_match_unpacked():
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 4, 32
+    qkv, C = _packed_inputs(B=1, T=256, H=H, D=D, seed=25)
+    B, T = qkv.shape[:2]
+
+    def loss_group(qkv):
+        o = pallas_flash_attention_packed(qkv, H, family="group")
+        return jnp.sum(o ** 2)
+
+    def loss_unpacked(qkv):
+        q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+        o = pallas_flash_attention(q, k, v)
+        return jnp.sum(o.transpose(0, 2, 1, 3).reshape(B, T, C) ** 2)
+
+    gp = jax.grad(loss_group)(qkv)
+    gu = jax.grad(loss_unpacked)(qkv)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gu), atol=2e-4,
+                               rtol=2e-4)
+
+
+def test_group_grads_with_dropout_match_unpacked():
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 2, 64
+    qkv, C = _packed_inputs(B=1, T=128, H=H, D=D, seed=26)
+    B, T = qkv.shape[:2]
+    rng = jax.random.PRNGKey(15)
+
+    def loss_group(qkv):
+        o = pallas_flash_attention_packed(qkv, H, family="group",
+                                          dropout_rate=0.25, dropout_rng=rng)
+        return jnp.sum(o ** 2)
+
+    def loss_unpacked(qkv):
+        q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+        o = pallas_flash_attention(q, k, v, dropout_rate=0.25,
+                                   dropout_rng=rng)
+        return jnp.sum(o.transpose(0, 2, 1, 3).reshape(B, T, C) ** 2)
+
+    gp = jax.grad(loss_group)(qkv)
+    gu = jax.grad(loss_unpacked)(qkv)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gu), atol=2e-4,
+                               rtol=2e-4)
+
+
+def test_group_supported_envelope():
+    from replicatinggpt_tpu.ops.flash_pallas import (GROUP_STRIP_BYTES,
+                                                     packed_group_supported)
+    assert packed_group_supported(1024, 768, 12, 2)    # GPT-2 124M bf16
+    assert packed_group_supported(1024, 1024, 16, 2)   # GPT-2 350M bf16
+    assert packed_group_supported(2048, 768, 12, 2)    # T at the W=128 cap
+    assert not packed_group_supported(4096, 768, 12, 2)   # past the cap
+    assert not packed_group_supported(1024, 1600, 25, 2)  # H=25 % hpg=2
+    assert not packed_group_supported(1024, 768, 7, 2)    # C % H != 0
+    assert not packed_group_supported(192, 768, 12, 2)    # T % 128 != 0
+    t_max = GROUP_STRIP_BYTES // (128 * 2) // 128 * 128
+    assert packed_group_supported(t_max, 768, 12, 2)
+    assert not packed_group_supported(t_max + 128, 768, 12, 2)
+
+
+def test_packed_entry_routes_group_past_resident_bound():
+    """At 124M shapes (T=1024, C=768) the resident family is off-envelope
+    and the entry must route to the group family; the envelope gate in
+    ops.flash_attention must agree."""
+    from replicatinggpt_tpu.ops.flash_attention import packed_envelope_ok
+    from replicatinggpt_tpu.ops.flash_pallas import (packed_group_supported,
+                                                     packed_supported)
+    assert not packed_supported(1024, 768, 12, 2)
+    assert packed_group_supported(1024, 768, 12, 2)
+    import replicatinggpt_tpu.ops.flash_attention as fa
+    orig = fa._packed_backend_ok
+    fa._packed_backend_ok = lambda: True
+    try:
+        qkv = jnp.zeros((1, 1024, 3 * 768), jnp.bfloat16)
+        assert packed_envelope_ok(qkv, 12)
+    finally:
+        fa._packed_backend_ok = orig
