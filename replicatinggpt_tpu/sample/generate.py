@@ -47,6 +47,61 @@ class GenerateConfig:
     greedy: bool = False
 
 
+def _sortable_f32(x: jnp.ndarray) -> jnp.ndarray:
+    """float32 -> uint32 with the same total order (monotone bijection):
+    flip all bits of negatives, set the sign bit of non-negatives. -inf
+    maps near 0, +inf near 2^32-1."""
+    u = jax.lax.bitcast_convert_type(x, jnp.int32)
+    return jnp.where(u < 0, ~u, u | jnp.int32(-2 ** 31)).astype(jnp.uint32)
+
+
+def _unsortable_f32(u: jnp.ndarray) -> jnp.ndarray:
+    i = u.astype(jnp.int32)
+    back = jnp.where(i < 0, i & jnp.int32(2 ** 31 - 1), ~i)
+    return jax.lax.bitcast_convert_type(back, jnp.float32)
+
+
+def _kth_largest(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Exact per-row k-th largest of (B, V) float32 via radix select in
+    sortable bit space: 8 passes of 4 bits, each counting elements >= 16
+    candidate thresholds with a fused compare+reduce. Replaces
+    ``lax.top_k`` for the top-k *filter*, where only the k-th value is
+    needed: XLA lowers top_k to a full (B, V) sort, measured 377 us per
+    decode step at B=1/V=50304 on v5e vs ~20 us for this select (the
+    sort was 44% of the 124M decode step). Returns (B,) float32."""
+    u = _sortable_f32(logits)
+    B = logits.shape[0]
+    lo = jnp.zeros((B,), jnp.uint32)
+    for shift in range(28, -1, -4):
+        cand = (lo[:, None]
+                + (jnp.arange(16, dtype=jnp.uint32)[None, :] << shift))
+        counts = jnp.sum((u[:, :, None] >= cand[:, None, :])
+                         .astype(jnp.int32), axis=1)
+        # candidates are ascending, so counts are non-increasing: the
+        # chosen bucket is the largest whose count still reaches k.
+        # count(u >= lo) >= k holds at every pass (lo starts at 0 and
+        # only advances to satisfying prefixes), so sel >= 0 always.
+        sel = jnp.sum((counts >= k).astype(jnp.int32), axis=1) - 1
+        lo = lo + (sel.astype(jnp.uint32) << shift)
+    return _unsortable_f32(lo)
+
+
+def _top_k_filter(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask logits strictly below the k-th largest to -inf — the
+    reference's filter semantics (``logits < v[:, [-1]]``,
+    /root/reference/GPT-2.py:245-247; ties at the k-th value are kept).
+    Bit-identical to the ``lax.top_k`` formulation (asserted in
+    tests/test_generate.py), without the full-vocab sort. Small vocabs
+    keep the sort: the radix select's 8 fixed passes only pay off once
+    the sort is the bigger cost (char-GPT's V=65 sort is trivial; the
+    win is GPT-2's V=50257)."""
+    if logits.dtype != jnp.float32 or logits.shape[-1] < 1024:
+        kth = jax.lax.top_k(logits, k)[0][:, -1:]
+        return jnp.where(logits < kth, -jnp.inf, logits)
+    t = _kth_largest(logits, k)
+    return jnp.where(logits < t[:, None], -jnp.inf, logits)
+
+
 def _top_p_filter(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     """Nucleus filter: keep the smallest prefix of the descending-softmax
     distribution whose cumulative probability reaches ``p`` (always
@@ -77,8 +132,7 @@ def _sample_token(rng: jax.Array, logits: jnp.ndarray,
     logits = logits / jnp.maximum(gcfg.temperature, 1e-6)
     if gcfg.top_k and gcfg.top_k > 0:
         k = min(gcfg.top_k, logits.shape[-1])
-        kth = jax.lax.top_k(logits, k)[0][:, -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        logits = _top_k_filter(logits, k)
     if gcfg.top_p and gcfg.top_p > 0.0:
         logits = _top_p_filter(logits, gcfg.top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

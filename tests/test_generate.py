@@ -230,3 +230,52 @@ def test_generate_top_p_end_to_end():
                   GenerateConfig(max_new_tokens=8, greedy=True),
                   rng=jax.random.PRNGKey(3))
     assert bool(jnp.all(g1 == g2))
+
+
+def test_top_k_filter_radix_matches_sort():
+    """The radix-select top-k filter must be bit-identical to the
+    lax.top_k formulation (same kept set, same tie semantics) — across
+    random rows, heavy ties, -inf entries, and the k=1 / k=V edges."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from replicatinggpt_tpu.sample.generate import _top_k_filter
+
+    def ref_filter(logits, k):
+        kth = jax.lax.top_k(logits, k)[0][:, -1:]
+        return jnp.where(logits < kth, -jnp.inf, logits)
+
+    rng = np.random.default_rng(0)
+    V = 1031  # not a multiple of anything convenient
+    cases = []
+    cases.append(rng.normal(size=(3, V)).astype(np.float32))
+    tied = rng.normal(size=(2, V)).astype(np.float32)
+    tied[:, : V // 2] = tied[:, :1]            # half the row ties at one value
+    cases.append(tied)
+    winf = rng.normal(size=(2, V)).astype(np.float32)
+    winf[:, ::3] = -np.inf                     # -inf entries survive bitspace
+    cases.append(winf)
+    cases.append(np.full((1, V), 2.5, np.float32))   # fully tied row
+    neg = -np.abs(rng.normal(size=(2, V))).astype(np.float32)  # all negative
+    cases.append(neg)
+    for x in cases:
+        xj = jnp.asarray(x)
+        for k in (1, 7, 50, V):
+            got = np.asarray(_top_k_filter(xj, k))
+            want = np.asarray(ref_filter(xj, k))
+            np.testing.assert_array_equal(got, want)
+
+
+def test_kth_largest_exact_values():
+    import jax.numpy as jnp
+    import numpy as np
+    from replicatinggpt_tpu.sample.generate import _kth_largest
+
+    x = jnp.asarray([[5.0, -1.0, 3.0, 3.0, 0.0, -jnp.inf],
+                     [0.5, 0.25, 0.125, -0.5, -0.25, -0.125]], jnp.float32)
+    np.testing.assert_array_equal(np.asarray(_kth_largest(x, 1)),
+                                  np.asarray([5.0, 0.5], np.float32))
+    np.testing.assert_array_equal(np.asarray(_kth_largest(x, 3)),
+                                  np.asarray([3.0, 0.125], np.float32))
+    np.testing.assert_array_equal(np.asarray(_kth_largest(x, 6)),
+                                  np.asarray([-np.inf, -0.5], np.float32))
