@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Ring-attention long-context rehearsal: AOT-compile the full train step
+with the sequence axis sharded over a virtual mesh at a PER-DEVICE shard
+length beyond the resident chunk-kernel bound (STREAM_KV_BYTES: 16k rows
+at D=64 bf16), recording compile time and the compiler's per-device
+memory accounting — the pod-planning numbers for a real long-context
+slice, in the style of benchmarks/SCALING_*.json.
+
+The per-hop kernels themselves cannot run under this CPU rehearsal (the
+Pallas interpreter unrolls the streamed grid at trace time — a 256x256
+tile grid is untraceable), so the rehearsed program uses the q-chunked
+einsum hop body; on TPU hardware `_flash_hop_supported` routes the same
+hops through the streamed chunk kernels, which are proven on the real
+chip separately (benchmarks/RESULTS.md "Ring hops" round-4 section:
+fwd+bwd at Tl=32k/64k). What this artifact pins down is the *program*:
+the ppermute ring over the seq axis at T_global = n x Tl, its
+memory footprint per device, and that it compiles end to end.
+
+Usage:
+    python benchmarks/ring_longctx_rehearsal.py --devices 8 \
+        --t-local 32768 --out benchmarks/SCALING_ring_longctx.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_CHILD = r"""
+import json, sys, time
+import jax
+
+n = int(sys.argv[1])
+t_local = int(sys.argv[2])
+compile_only = sys.argv[3] == "1"
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", n)
+
+import numpy as np
+
+from replicatinggpt_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from replicatinggpt_tpu.parallel import select_attention_fn
+from replicatinggpt_tpu.parallel.mesh import (make_batch_sharding, make_mesh,
+                                              shard_train_state)
+from replicatinggpt_tpu.train.state import create_train_state
+from replicatinggpt_tpu.train.steps import make_train_step
+
+T = n * t_local
+mcfg = ModelConfig(vocab_size=256, block_size=T, n_layer=4, n_head=4,
+                   n_embd=256, dropout=0.0, attn_dropout=0.0,
+                   dtype="bfloat16", remat=True, attention_impl="ring")
+tcfg = TrainConfig(batch_size=1, lr=1e-3)
+mesh_cfg = MeshConfig(data=1, seq=n, model=1)
+mesh = make_mesh(mesh_cfg)
+attention_fn = select_attention_fn(mcfg, mesh_cfg, mesh)
+assert attention_fn is not None, "ring attention_fn not selected"
+
+state = shard_train_state(
+    lambda: create_train_state(jax.random.PRNGKey(0), mcfg, tcfg),
+    mesh, mesh_cfg)
+toks = np.random.default_rng(0).integers(0, 256, (1, T + 1), dtype=np.int32)
+bs = make_batch_sharding(mesh)
+batch = (jax.device_put(toks[:, :-1], bs), jax.device_put(toks[:, 1:], bs))
+
+step = make_train_step(mcfg, tcfg, donate=False, attention_fn=attention_fn)
+t0 = time.perf_counter()
+lowered = step.lower(state, batch)
+compiled = lowered.compile()
+compile_s = time.perf_counter() - t0
+
+try:
+    ma = compiled.memory_analysis()
+    gb = 1024 ** 3
+    mem = {
+        "temp_gb_per_device": round(ma.temp_size_in_bytes / n / gb, 2),
+        "args_gb_per_device": round(ma.argument_size_in_bytes / n / gb, 2),
+        "output_gb_per_device": round(ma.output_size_in_bytes / n / gb, 2),
+    }
+except Exception as e:
+    mem = {"memory_analysis_error": str(e)[:120]}
+
+row = {"devices": n, "t_local": t_local, "t_global": T,
+       "compile_s": round(compile_s, 1), "compile_only": compile_only,
+       "hop_body_rehearsed": "einsum (interpret-mode streamed grid is "
+                             "untraceable; TPU routes flash)",
+       **mem}
+if not compile_only:
+    t0 = time.perf_counter()
+    state, m = compiled(state, batch)
+    loss = float(np.asarray(jax.device_get(m["loss"])))
+    row["step_s"] = round(time.perf_counter() - t0, 1)
+    row["loss_finite"] = bool(np.isfinite(loss))
+print(json.dumps(row))
+"""
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--t-local", type=int, default=32768)
+    ap.add_argument("--compile-only", action="store_true", default=True)
+    ap.add_argument("--execute", dest="compile_only", action="store_false")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-c", _CHILD, str(args.devices), str(args.t_local),
+         "1" if args.compile_only else "0"],
+        capture_output=True, text=True, env=env)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-4000:])
+        sys.exit(1)
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    out = {"metric": "ring_longctx_rehearsal", **row}
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

