@@ -52,6 +52,13 @@ class ModelConfig:
     attention_impl: str = "auto"  # 'auto' | 'einsum' | 'flash' | 'ring' |
                                   # 'ulysses' (seq-parallel all-to-all)
     remat: bool = False           # jax.checkpoint each block (HBM <-> FLOPs)
+    remat_policy: str = "full"    # 'full' (save nothing) | 'dots' (save
+                                  # matmul outputs, recompute elementwise:
+                                  # jax.checkpoint_policies.dots_saveable) |
+                                  # 'dots_no_batch' (…with_no_batch_dims…).
+                                  # Measured at 350M B=8 on v5e-16G: 'full'
+                                  # wins — see benchmarks/RESULTS.md
+                                  # selective-remat table
     scan_layers: Optional[bool] = None
     # lax.scan over stacked layer params. None = auto: on TPU, unroll
     # shallow stacks (n_layer <= 16) — measured on v5e, unrolling the
@@ -83,6 +90,8 @@ class ModelConfig:
         assert self.activation in ("gelu", "relu"), self.activation
         assert self.attention_impl in ("auto", "einsum", "flash", "ring",
                                        "ulysses")
+        assert self.remat_policy in ("full", "dots", "dots_no_batch"), (
+            self.remat_policy)
         return self
 
 
@@ -301,6 +310,11 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
                    help="disable the preset's remat (e.g. 350M+ presets "
                         "default remat on for single-chip HBM; a pod-slice "
                         "FSDP run may not need it)")
+    p.add_argument("--remat-policy", dest="remat_policy", default=None,
+                   choices=["full", "dots", "dots_no_batch"],
+                   help="what jax.checkpoint saves per block: 'full' "
+                        "recomputes everything (v5e-measured default), "
+                        "'dots'/'dots_no_batch' save matmul outputs")
     # train overrides
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
@@ -339,7 +353,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         ("n_layer", args.n_layer), ("n_head", args.n_head),
         ("n_embd", args.n_embd), ("dropout", args.dropout),
         ("dtype", args.dtype), ("attention_impl", args.attention_impl),
-        ("remat", args.remat),
+        ("remat", args.remat), ("remat_policy", args.remat_policy),
     ) if v is not None}
     if args.dropout is not None:
         mk["attn_dropout"] = args.dropout

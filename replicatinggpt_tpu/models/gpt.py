@@ -201,6 +201,19 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
     return x + _dropout(h, cfg.dropout, r_drop2, train)
 
 
+def _remat_policy(name: str):
+    """Resolve cfg.remat_policy to a jax.checkpoint policy (None = save
+    nothing, recompute the whole block — the 'full' default)."""
+    if name == "full":
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    if name == "dots_no_batch":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"remat_policy must be 'full', 'dots' or "
+                     f"'dots_no_batch', got {name!r}")
+
+
 def _run_blocks(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
                 cfg: ModelConfig, *, rng: Optional[jax.Array], train: bool,
                 attention_fn=None) -> jnp.ndarray:
@@ -210,11 +223,11 @@ def _run_blocks(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
         lp, layer_idx = inputs
         r = (jax.random.fold_in(rng, layer_idx)
              if rng is not None else None)
-        fn = _block
         if cfg.remat:
             fn = jax.checkpoint(
                 lambda c, p: _block(c, p, cfg, rng=r, train=train,
-                                    attention_fn=attention_fn))
+                                    attention_fn=attention_fn),
+                policy=_remat_policy(cfg.remat_policy))
             return fn(carry, lp), None
         return _block(carry, lp, cfg, rng=r, train=train,
                       attention_fn=attention_fn), None

@@ -163,3 +163,42 @@ def test_bf16_forward_finite():
     logits, loss = forward(params, _batch(cfg), cfg, targets=_batch(cfg))
     assert logits.dtype == jnp.float32  # loss path always f32
     assert np.isfinite(float(loss))
+
+
+def test_remat_policy_numerics_and_validation():
+    """remat_policy only changes what is saved vs recomputed — loss and
+    grads must match the full-remat path exactly; bad names fail loudly."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    from replicatinggpt_tpu.config import ModelConfig
+    from replicatinggpt_tpu.models.gpt import forward, init_params
+
+    base = ModelConfig(vocab_size=64, block_size=32, n_layer=2, n_head=2,
+                       n_embd=64, dropout=0.0, attn_dropout=0.0,
+                       dtype="float32", remat=True)
+    params = init_params(jax.random.PRNGKey(0), base)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    y = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0, 64)
+
+    def loss_for(policy):
+        cfg = dataclasses.replace(base, remat_policy=policy)
+
+        def loss(p):
+            _, l = forward(p, x, cfg, targets=y)
+            return l
+
+        return loss
+
+    l_full, g_full = jax.value_and_grad(loss_for("full"))(params)
+    for policy in ("dots", "dots_no_batch"):
+        l_p, g_p = jax.value_and_grad(loss_for(policy))(params)
+        np.testing.assert_allclose(float(l_p), float(l_full), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            g_p, g_full)
+
+    with _pytest.raises(ValueError, match="remat_policy"):
+        jax.value_and_grad(loss_for("typo"))(params)
