@@ -279,3 +279,39 @@ def test_kth_largest_exact_values():
                                   np.asarray([3.0, 0.125], np.float32))
     np.testing.assert_array_equal(np.asarray(_kth_largest(x, 6)),
                                   np.asarray([-np.inf, -0.5], np.float32))
+
+
+def test_prefill_matches_sequential_decode():
+    """The parallel prefill must build the same KV cache (and leave the
+    decode continuation identical) as teacher-forcing the prompt through
+    sequential decode_steps."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.models.gpt import (decode_step, init_kv_cache,
+                                               prefill)
+    from replicatinggpt_tpu.train.state import create_train_state
+
+    cfg = get_config("test-tiny").model
+    state = create_train_state(jax.random.PRNGKey(0), cfg,
+                               get_config("test-tiny").train)
+    B, P = 2, 12
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, P), 0,
+                                cfg.vocab_size)
+    cache_p = prefill(state.params, prompt, init_kv_cache(cfg, B), cfg)
+    cache_s = init_kv_cache(cfg, B)
+    for pos in range(P):
+        logits_s, cache_s = decode_step(state.params, prompt[:, pos],
+                                        jnp.int32(pos), cache_s, cfg)
+    for key in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(cache_p[key][:, :, :, :P], np.float32),
+            np.asarray(cache_s[key][:, :, :, :P], np.float32),
+            atol=2e-5, rtol=2e-5)
+    # continuations agree: next decode step from either cache matches
+    nxt = jnp.argmax(logits_s, -1).astype(jnp.int32)
+    lp, _ = decode_step(state.params, nxt, jnp.int32(P), cache_p, cfg)
+    ls, _ = decode_step(state.params, nxt, jnp.int32(P), cache_s, cfg)
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(ls), atol=2e-5,
+                               rtol=2e-5)
