@@ -397,8 +397,9 @@ def prefill(params: Params, idx: jnp.ndarray,
     <= p (causal attention, per-position projections), so positions at
     or beyond the true prompt length may hold padding-derived values —
     harmless: the decode scan overwrites position p before attending it
-    and masks everything beyond. Attention core follows the same
-    flash/einsum choice as the training forward (no dropout at decode).
+    and masks everything beyond. Attention core is the einsum path on
+    purpose (see the inline comment: the segment is GSPMD-partitioned
+    under sharded decode, where a bare pallas_call cannot partition).
     """
     cd = _dtype(cfg.dtype)
     B, P = idx.shape

@@ -315,3 +315,45 @@ def test_prefill_matches_sequential_decode():
     ls, _ = decode_step(state.params, nxt, jnp.int32(P), cache_s, cfg)
     np.testing.assert_allclose(np.asarray(lp), np.asarray(ls), atol=2e-5,
                                rtol=2e-5)
+
+
+def test_refresh_group_matches_sequential_segments():
+    """One _refresh_group(n_seg=2) dispatch must produce exactly the
+    tokens of two sequential _decode_segment calls (same ordinal-keyed
+    rngs, same window sliding) — non-greedy, so rng threading is
+    covered too."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.sample.generate import (GenerateConfig,
+                                                    _decode_segment,
+                                                    _refresh_group)
+    from replicatinggpt_tpu.train.state import create_train_state
+
+    cfg = get_config("test-tiny")
+    m = cfg.model
+    state = create_train_state(jax.random.PRNGKey(0), m, cfg.train)
+    gcfg = GenerateConfig(max_new_tokens=0, top_k=5)
+    S = m.block_size
+    Pw, n_mid = S // 2, S // 2 + 1
+    B = 2
+    window = jax.random.randint(jax.random.PRNGKey(3), (B, Pw), 0,
+                                m.vocab_size)
+    base = jax.random.PRNGKey(11)
+
+    grouped, gw = _refresh_group(state.params, window, 2, jnp.int32(0),
+                                 base, m, gcfg)
+
+    seq_chunks = []
+    w = window
+    for ordinal in range(2):
+        sub = jax.random.fold_in(base, ordinal)
+        toks = _decode_segment(state.params, w, Pw, n_mid, sub, m, gcfg)
+        seq_chunks.append(toks)
+        w = jnp.concatenate([w, toks], axis=1)[:, -Pw:]
+    sequential = jnp.concatenate(seq_chunks, axis=1)
+
+    np.testing.assert_array_equal(np.asarray(grouped),
+                                  np.asarray(sequential))
+    np.testing.assert_array_equal(np.asarray(gw), np.asarray(w))
