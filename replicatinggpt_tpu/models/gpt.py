@@ -323,6 +323,12 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_len: Optional[int] = None,
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def _fused_decode_backend_ok() -> bool:
+    """Pallas lowering gate for the fused decode kernel (tests
+    monkeypatch this to exercise the interpret-mode kernel on CPU)."""
+    return jax.default_backend() == "tpu"
+
+
 def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
                 cache: Dict[str, jnp.ndarray], cfg: ModelConfig
                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
@@ -330,12 +336,29 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     int32 position. Returns (logits (B, V) float32, updated cache).
 
     Replaces the reference's full re-forward per generated token
-    (GPT1.py:200-202) with O(T) work per token.
+    (GPT1.py:200-202) with O(T) work per token. Single-stream (B=1)
+    steps on TPU route the whole layer loop through the fused Pallas
+    decode kernel (ops/decode_pallas.py) when the per-layer weights fit
+    its VMEM envelope — one launch instead of ~125 op dispatches.
     """
     cd = _dtype(cfg.dtype)
     B = idx_t.shape[0]
     x = params["wte"].astype(cd)[idx_t] + params["wpe"].astype(cd)[pos]
     x = x[:, None, :]  # (B, 1, C)
+
+    from ..ops.decode_pallas import fused_decode_layers, fused_decode_supported
+    # the envelope gates on the CACHE actually handed in (its length and
+    # dtype may differ from cfg.block_size / the compute dtype via
+    # init_kv_cache's max_len/dtype overrides)
+    use_fused = (_fused_decode_backend_ok()
+                 and cache["k"].dtype == cd
+                 and fused_decode_supported(
+                     cfg, B, jnp.dtype(cd).itemsize,
+                     seq_len=cache["k"].shape[3]))
+    if use_fused:
+        x_row, cache = fused_decode_layers(x[:, 0, :], params["blocks"],
+                                           pos, cache, cfg)
+        return _decode_head(x_row[:, None, :], params, cfg, cd), cache
 
     def body(carry, inputs):
         # Caches ride the carry as the full stacked (L, B, H, S, D)
@@ -377,12 +400,17 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
             lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
             carry, _ = body(carry, (lp, i))
         x, new_k, new_v = carry
+    return _decode_head(x, params, cfg, cd), {"k": new_k, "v": new_v}
+
+
+def _decode_head(x, params: Params, cfg: ModelConfig, cd) -> jnp.ndarray:
+    """Final layernorm + (tied/untied) head over a (B, 1, C) decode
+    state — one source of truth for the fused and XLA decode tails."""
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                     cfg.layernorm_eps)
     head = (params["wte"].astype(cd).T if cfg.tied_head
             else params["lm_head"].astype(cd))
-    logits = (x[:, 0, :] @ head).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    return (x[:, 0, :] @ head).astype(jnp.float32)
 
 
 def prefill(params: Params, idx: jnp.ndarray,

@@ -1,0 +1,213 @@
+"""Fused single-stream decode-step kernel: every transformer layer of one
+autoregressive token in ONE Pallas call.
+
+Why: the XLA decode step at char-GPT scale is op-issue-latency-bound, not
+bandwidth-bound — ~125 device ops per token (per-layer ln/matvec/attention
+/mlp fusions) at ~0.4 us issue latency each ≈ 102 us/token against a
+~28 us parameter-byte floor (benchmarks/RESULTS.md decode roofline,
+round 4). This kernel replaces the whole layer loop with one launch:
+grid over layers ("arbitrary" = sequential), the residual-stream row
+carried in VMEM scratch across grid steps, per-layer weights and the
+layer's KV cache fetched as double-buffered blocks — so the per-token
+cost approaches the parameter stream time instead of the op count. The
+reference's decode ancestry is the O(T^2) full re-forward per token
+(GPT1.py:196-212); the XLA cache path replaced the re-forward, this
+kernel replaces the op soup.
+
+Scope: B == 1 (the single-stream latency workload, BASELINE config 5);
+batched decode stays on the XLA path where per-op work is large enough
+to hide issue latency. The kernel computes attention against the STALE
+cache block masked to positions < pos plus an explicit fresh-KV column
+(bit-equivalent to write-then-attend: cache[pos] would equal the fresh
+k/v), and emits the fresh per-layer K/V rows; the caller scatters them
+into the cache at ``pos`` with one dynamic_update_slice over all layers.
+
+Numerics mirror the XLA decode body (models/gpt.py decode_step /
+ops/attention.cached_attention): LN statistics in f32, matmuls on
+compute-dtype operands with f32 accumulation, attention scores and
+softmax in f32, probabilities cast to the cache dtype for the PV
+product. Parity with decode_step is asserted in tests/test_generate.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_pallas import (NEG_INF, _compiler_params, _interpret_mode,
+                           _smem_spec, _vmem_spec, pltpu)
+
+# Per-layer VMEM budget for the fused kernel: weights (qkv C*3C + proj
+# C*C + mlp 2*C*4C), the (H, S, D) k/v cache blocks, and the (S, lanes)
+# score temporaries, double-buffered across layer grid steps. 6 MiB
+# covers char-GPT (3.7 MiB at C=384, S=256 bf16) with margin and
+# excludes GPT-2 124M (14+ MiB), whose decode is byte-floor-bound on
+# the XLA path anyway (RESULTS.md roofline: 1.29x of floor).
+FUSED_LAYER_BYTES = 6 * 1024 * 1024
+
+
+def fused_decode_supported(cfg, batch: int, itemsize: int = 2,
+                           seq_len: int = 0) -> bool:
+    """Envelope: single stream, lane-aligned head dim, per-layer weights
+    + cache within FUSED_LAYER_BYTES. ``seq_len`` is the ACTUAL cache
+    length (init_kv_cache callers may override max_len past
+    cfg.block_size); 0 means cfg.block_size."""
+    C, H = cfg.n_embd, cfg.n_head
+    S = seq_len or cfg.block_size
+    if batch != 1 or C % H != 0:
+        return False
+    D = C // H
+    if D not in (32, 64, 128, 256) or S % 8 != 0:
+        return False
+    weights = (C * 3 * C + C * C + 2 * C * 4 * C) * itemsize
+    cache = 2 * H * S * D * itemsize
+    return weights + cache <= FUSED_LAYER_BYTES
+
+
+def _ln_row(x, scale, bias, eps):
+    """(1, C) layernorm, f32 statistics, result in x.dtype — mirrors
+    models.gpt._layer_norm."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _row_matmul(h, w, b):
+    """(1, Cin) @ (Cin, Cout) + (1, Cout) on compute-dtype operands with
+    f32 accumulation, result in h.dtype — mirrors `h @ W + b`."""
+    y = jax.lax.dot_general(h, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return (y + b.astype(jnp.float32)).astype(h.dtype)
+
+
+def _decode_kernel(pos_ref, x0_ref, ln1s_ref, ln1b_ref, wqkv_ref, bqkv_ref,
+                   wproj_ref, bproj_ref, ln2s_ref, ln2b_ref, wup_ref,
+                   bup_ref, wdown_ref, bdown_ref, kc_ref, vc_ref,
+                   xout_ref, newk_ref, newv_ref, x_ref, *, n_layer, n_head,
+                   head_dim, seq_len, eps, scale, activation):
+    l = pl.program_id(0)
+    H, D, S = n_head, head_dim, seq_len
+    C = H * D
+    pos = pos_ref[0]
+
+    @pl.when(l == 0)
+    def _init():
+        x_ref[...] = x0_ref[...]
+
+    x = x_ref[...]                                   # (1, C) compute dtype
+    h = _ln_row(x, ln1s_ref[...], ln1b_ref[...], eps)
+    qkv = _row_matmul(h, wqkv_ref[...], bqkv_ref[...])   # (1, 3C)
+
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0)
+    outs = []
+    for i in range(H):
+        q = qkv[:, i * D:(i + 1) * D].astype(jnp.float32)       # (1, D)
+        k_new = qkv[:, C + i * D:C + (i + 1) * D]               # (1, D)
+        v_new = qkv[:, 2 * C + i * D:2 * C + (i + 1) * D]
+        newk_ref[:, i * D:(i + 1) * D] = k_new
+        newv_ref[:, i * D:(i + 1) * D] = v_new
+        kc = kc_ref[i]                                          # (S, D)
+        vc = vc_ref[i]
+        # scores vs the stale cache, masked to positions < pos; the
+        # fresh position's score rides a separate column (write-then-
+        # attend equivalence: cache[pos] would hold exactly k_new)
+        s = jnp.sum(kc.astype(jnp.float32) * q, axis=-1,
+                    keepdims=True) * scale                      # (S, 1)
+        s = jnp.where(kpos < pos, s, NEG_INF)
+        s_new = jnp.sum(k_new.astype(jnp.float32) * q) * scale  # scalar
+        m = jnp.maximum(jnp.max(s), s_new)
+        p = jnp.exp(s - m)                                      # (S, 1)
+        p_new = jnp.exp(s_new - m)
+        denom = jnp.sum(p) + p_new
+        w = (p / denom).astype(vc.dtype)
+        pv = jnp.sum(w * vc, axis=0, keepdims=True)             # (1, D)
+        out = pv + ((p_new / denom).astype(v_new.dtype) * v_new)
+        outs.append(out.astype(x.dtype))
+    attn = jnp.concatenate(outs, axis=1)                        # (1, C)
+    attn = _row_matmul(attn, wproj_ref[...], bproj_ref[...])
+    x_mid = x + attn
+    h = _ln_row(x_mid, ln2s_ref[...], ln2b_ref[...], eps)
+    h = _row_matmul(h, wup_ref[...], bup_ref[...])
+    h = (jax.nn.gelu(h) if activation == "gelu" else jax.nn.relu(h))
+    h = _row_matmul(h.astype(x.dtype), wdown_ref[...], bdown_ref[...])
+    x_ref[...] = x_mid + h
+
+    @pl.when(l == n_layer - 1)
+    def _finalize():
+        xout_ref[...] = x_ref[...]
+
+
+def fused_decode_layers(x0: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
+                        pos: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+                        cfg) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Run all n_layer blocks for one (B=1) decode token in one Pallas
+    call. x0: (1, C) embedded input row (compute dtype); blocks: the
+    layer-stacked param dict (weights will be cast to x0.dtype —
+    hoisted out of the token scan by XLA exactly like the unfused
+    path's per-use casts); cache: {"k","v"} (L, 1, H, S, D). Returns
+    (x_out (1, C), updated cache)."""
+    L, _, H, S, D = cache["k"].shape
+    C = H * D
+    cd = x0.dtype
+    w = {k: v.astype(cd) for k, v in blocks.items()}
+    # (L, width) row vectors -> (L, 1, width) so in-kernel refs are 2-d
+    vec = lambda name: w[name].reshape(L, 1, -1)
+    kernel = functools.partial(
+        _decode_kernel, n_layer=L, n_head=H, head_dim=D, seq_len=S,
+        eps=cfg.layernorm_eps, scale=D ** -0.5, activation=cfg.activation)
+    row = lambda width: _vmem_spec((None, 1, width), lambda l: (l, 0, 0))
+    mat = lambda a, b: _vmem_spec((None, a, b), lambda l: (l, 0, 0))
+    cache_spec = _vmem_spec((None, None, H, S, D), lambda l: (l, 0, 0, 0, 0))
+    kw = {}
+    cp = _compiler_params(0, 1)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    xout, newk, newv = pl.pallas_call(
+        kernel,
+        grid=(L,),
+        in_specs=[
+            _smem_spec(),
+            _vmem_spec((1, C), lambda l: (0, 0)),
+            row(C), row(C), mat(C, 3 * C), row(3 * C),
+            mat(C, C), row(C), row(C), row(C),
+            mat(C, 4 * C), row(4 * C), mat(4 * C, C), row(C),
+            cache_spec, cache_spec,
+        ],
+        out_specs=[
+            _vmem_spec((1, C), lambda l: (0, 0)),
+            row(C), row(C),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, C), cd),
+            jax.ShapeDtypeStruct((L, 1, C), cd),
+            jax.ShapeDtypeStruct((L, 1, C), cd),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, C), cd) if pltpu is not None
+                        else None],
+        interpret=_interpret_mode(),
+        **kw,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), x0,
+      vec("ln1_scale"), vec("ln1_bias"), w["qkv_kernel"], vec("qkv_bias"),
+      w["attn_out_kernel"], vec("attn_out_bias"), vec("ln2_scale"),
+      vec("ln2_bias"), w["mlp_up_kernel"], vec("mlp_up_bias"),
+      w["mlp_down_kernel"], vec("mlp_down_bias"), cache["k"], cache["v"])
+    # scatter every layer's fresh K/V row into the cache at pos — ONE
+    # dynamic_update_slice per array for all layers
+    zero = jnp.int32(0)
+    p = jnp.asarray(pos, jnp.int32)
+    newk5 = newk.reshape(L, 1, H, 1, D)
+    newv5 = newv.reshape(L, 1, H, 1, D)
+    ck = jax.lax.dynamic_update_slice(
+        cache["k"], newk5.astype(cache["k"].dtype), (zero, zero, zero, p,
+                                                     zero))
+    cv = jax.lax.dynamic_update_slice(
+        cache["v"], newv5.astype(cache["v"].dtype), (zero, zero, zero, p,
+                                                     zero))
+    return xout, {"k": ck, "v": cv}

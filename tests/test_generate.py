@@ -357,3 +357,49 @@ def test_refresh_group_matches_sequential_segments():
     np.testing.assert_array_equal(np.asarray(grouped),
                                   np.asarray(sequential))
     np.testing.assert_array_equal(np.asarray(gw), np.asarray(w))
+
+
+def test_fused_decode_step_matches_unfused(monkeypatch):
+    """The fused Pallas decode kernel (interpret mode on CPU) must match
+    the XLA layer-loop decode_step: logits and cache, across positions
+    including pos=0 and a mid-sequence pos with a warm cache."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import replicatinggpt_tpu.models.gpt as gpt
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.models.gpt import decode_step, init_kv_cache
+    from replicatinggpt_tpu.ops.decode_pallas import fused_decode_supported
+    from replicatinggpt_tpu.train.state import create_train_state
+
+    from replicatinggpt_tpu.config import ModelConfig
+
+    m = ModelConfig(vocab_size=64, block_size=64, n_layer=2, n_head=2,
+                    n_embd=128, dropout=0.0, attn_dropout=0.0,
+                    dtype="float32")
+    assert fused_decode_supported(m, 1, 4)
+    assert not fused_decode_supported(m, 2, 4)          # B != 1
+    assert not fused_decode_supported(
+        get_config("test-tiny").model, 1, 4)            # D=16 unsupported
+    state = create_train_state(jax.random.PRNGKey(0), m,
+                               get_config("test-tiny").train)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (6,), 0, m.vocab_size)
+
+    def run(fused):
+        monkeypatch.setattr(gpt, "_fused_decode_backend_ok", lambda: fused)
+        cache = init_kv_cache(m, 1)
+        outs = []
+        for pos in range(toks.shape[0]):
+            logits, cache = decode_step(state.params, toks[pos:pos + 1],
+                                        jnp.int32(pos), cache, m)
+            outs.append(logits)
+        return jnp.stack(outs), cache
+
+    lf, cf = run(True)
+    lu, cu = run(False)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(lu), atol=2e-5,
+                               rtol=2e-5)
+    for key in ("k", "v"):
+        np.testing.assert_allclose(np.asarray(cf[key], np.float32),
+                                   np.asarray(cu[key], np.float32),
+                                   atol=2e-5, rtol=2e-5)
