@@ -340,6 +340,15 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     steps on TPU route the whole layer loop through the fused Pallas
     decode kernel (ops/decode_pallas.py) when the per-layer weights fit
     its VMEM envelope — one launch instead of ~125 op dispatches.
+
+    The cache may be shorter than cfg.block_size (``init_kv_cache``'s
+    max_len): every step streams the whole buffer, so callers that know
+    ``pos`` stays small keep the buffer small — sample.generate grows it
+    chunk-by-chunk instead of paying the full static bucket from token 1
+    (a static prefix *slice* here instead was measured 10x WORSE at
+    124M B=8: slicing the scan-carried buffer defeats XLA's in-place
+    aliasing of the dynamic_update_slice writes and copies the cache
+    every step).
     """
     cd = _dtype(cfg.dtype)
     B = idx_t.shape[0]

@@ -138,6 +138,35 @@ def _sample_token(rng: jax.Array, logits: jnp.ndarray,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+ATTEND_GRANULE = 128
+
+
+def _decode_chunks(P_pad: int, n_new: int, S: int):
+    """Static (n_steps, cache_len) chunks covering an ``n_new``-step
+    decode scan whose step i writes position <= P_pad - 1 + i. The KV
+    cache buffer starts at the first chunk's cache_len (a multiple of
+    ATTEND_GRANULE, capped at S) and is zero-padded up between chunks,
+    so early steps stop paying for the whole static bucket — at B >= 8
+    the cache read dominates decode step bytes and a 1k-token sample
+    from a short prompt otherwise streams all S slots from token 1
+    (measured 2.8-3.0x above the full-cache byte floor at 124M; the
+    chunked scan reads ~0.56x the bytes on that workload). Growing the
+    *buffer* keeps the in-chunk loop byte-identical to the plain
+    fixed-bucket scan — a static prefix slice of the carried buffer
+    instead was measured 10x worse (see models.gpt.decode_step). All
+    chunks compile into the ONE jitted segment — more scan bodies, zero
+    extra dispatches."""
+    g = ATTEND_GRANULE
+    chunks = []
+    i = 0
+    while i < n_new:
+        a = min(-(-(P_pad + i) // g) * g, S)
+        n_c = n_new - i if a >= S else min(n_new - i, a - (P_pad - 1) - i)
+        chunks.append((n_c, a))
+        i += n_c
+    return chunks
+
+
 def _segment_core(params, prompt: jnp.ndarray, prompt_len, n_new: int,
                   rng: jax.Array, cfg: ModelConfig, gcfg: GenerateConfig
                   ) -> jnp.ndarray:
@@ -150,9 +179,16 @@ def _segment_core(params, prompt: jnp.ndarray, prompt_len, n_new: int,
     prompt array may be right-padded to a bucketed width, so true length
     does not force a recompile; padding-derived cache entries at
     positions >= prompt_len are overwritten before being attended.
-    Requires P_pad + n_new <= block_size + 1."""
+    Requires P_pad + n_new <= block_size + 1.
+
+    The scan is split into ``_decode_chunks`` with a cache buffer grown
+    chunk-by-chunk (see there); the rng-split sequence per step is
+    unchanged and the padded slots are masked exactly like unfilled
+    bucket slots, so the sampled trajectory matches a single full-bucket
+    scan (asserted in tests/test_generate.py)."""
     B, P_pad = prompt.shape
-    cache = init_kv_cache(cfg, B)
+    chunks = _decode_chunks(P_pad, n_new, cfg.block_size)
+    cache = init_kv_cache(cfg, B, max_len=chunks[0][1])
     prompt_len = jnp.asarray(prompt_len, jnp.int32)
     cache = prefill(params, prompt, cache, cfg)
     start = prompt_len - 1
@@ -165,8 +201,21 @@ def _segment_core(params, prompt: jnp.ndarray, prompt_len, n_new: int,
         next_tok = _sample_token(sub, logits, gcfg)
         return (next_tok, cache, rng), next_tok
 
-    (_, _, _), toks = jax.lax.scan(
-        body, (first, cache, rng), jnp.arange(n_new))
+    carry = (first, cache, rng)
+    parts = []
+    i = 0
+    for n_c, a_len in chunks:
+        tok, cache, crng = carry
+        if cache["k"].shape[3] < a_len:
+            grow = a_len - cache["k"].shape[3]
+            cache = {key: jnp.pad(val, ((0, 0),) * 3 + ((0, grow), (0, 0)))
+                     for key, val in cache.items()}
+        carry, toks_c = jax.lax.scan(body, (tok, cache, crng),
+                                     jnp.arange(i, i + n_c))
+        parts.append(toks_c)
+        i += n_c
+    toks = (parts[0] if len(parts) == 1
+            else jnp.concatenate(parts, axis=0))
     return toks.T
 
 

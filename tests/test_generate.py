@@ -359,6 +359,79 @@ def test_refresh_group_matches_sequential_segments():
     np.testing.assert_array_equal(np.asarray(gw), np.asarray(w))
 
 
+def test_decode_chunks_cover_exactly():
+    """_decode_chunks partitions [0, n_new) with attend_len always a
+    valid bound for every position its chunk writes (pos <= P_pad-1+i
+    < attend_len) and never exceeding S."""
+    from replicatinggpt_tpu.sample.generate import _decode_chunks
+    for P_pad, n_new, S in [(1, 1024, 1024), (512, 513, 1024),
+                            (1, 1, 32), (32, 1, 32), (7, 250, 256),
+                            (128, 897, 1024)]:
+        chunks = _decode_chunks(P_pad, n_new, S)
+        i = 0
+        for n_c, a in chunks:
+            from replicatinggpt_tpu.sample.generate import ATTEND_GRANULE
+            assert n_c >= 1 and a <= S
+            assert a % ATTEND_GRANULE == 0 or a == S
+            last_pos = P_pad - 1 + i + n_c - 1
+            assert last_pos < a, (P_pad, n_new, S, chunks)
+            i += n_c
+        assert i == n_new
+        assert P_pad - 1 + n_new - 1 <= S - 1
+
+
+def test_chunked_segment_matches_monolithic(monkeypatch):
+    """The chunked-attend decode scan must produce the bit-identical
+    sampled trajectory of a single full-S scan (the rng-split sequence
+    per step is unchanged; the cache prefix slice only drops slots the
+    mask already zeroed)."""
+    import importlib
+    # the package re-exports the `generate` function under the same name,
+    # shadowing the submodule attribute — resolve the module itself
+    G = importlib.import_module("replicatinggpt_tpu.sample.generate")
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompt = np.array([[1, 5, 9], [3, 3, 3]], dtype=np.int32)
+    gcfg = GenerateConfig(max_new_tokens=60, temperature=0.9, top_k=8)
+    rng = jax.random.PRNGKey(42)
+    # granule S = one chunk at full attend width (the old monolithic scan)
+    monkeypatch.setattr(G, "ATTEND_GRANULE", CFG.block_size)
+    G._decode_segment.clear_cache()
+    G._refresh_group.clear_cache()
+    mono = np.asarray(generate(params, prompt, CFG, gcfg, rng=rng))
+    # granule 8 engages real chunking at block_size=32
+    monkeypatch.setattr(G, "ATTEND_GRANULE", 8)
+    G._decode_segment.clear_cache()
+    G._refresh_group.clear_cache()
+    chunked = np.asarray(generate(params, prompt, CFG, gcfg, rng=rng))
+    G._decode_segment.clear_cache()
+    G._refresh_group.clear_cache()
+    np.testing.assert_array_equal(mono, chunked)
+
+
+def test_decode_step_short_cache_parity():
+    """decode_step on a shorter cache buffer (init_kv_cache max_len)
+    returns the same logits and cache writes as the full bucket while
+    pos stays inside it — the invariant the chunked grow-as-you-go
+    decode relies on."""
+    from replicatinggpt_tpu.models.gpt import decode_step, init_kv_cache
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    B = 2
+    rng = jax.random.PRNGKey(5)
+    cache_a = init_kv_cache(CFG, B)                  # full block_size=32
+    cache_b = init_kv_cache(CFG, B, max_len=16)      # short buffer
+    toks = jax.random.randint(rng, (B, 10), 0, CFG.vocab_size)
+    for pos in range(10):
+        la, cache_a = decode_step(params, toks[:, pos], jnp.int32(pos),
+                                  cache_a, CFG)
+        lb, cache_b = decode_step(params, toks[:, pos], jnp.int32(pos),
+                                  cache_b, CFG)
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for key in ("k", "v"):
+        np.testing.assert_array_equal(
+            np.asarray(cache_a[key][:, :, :, :16]),
+            np.asarray(cache_b[key]))
+
+
 def test_fused_decode_step_matches_unfused(monkeypatch):
     """The fused Pallas decode kernel (interpret mode on CPU) must match
     the XLA layer-loop decode_step: logits and cache, across positions
