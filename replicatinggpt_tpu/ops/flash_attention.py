@@ -54,11 +54,13 @@ def packed_envelope_ok(qkv: jnp.ndarray, n_head: int) -> bool:
     so a gate added here can never diverge the two paths."""
     if not _packed_backend_ok():
         return False
-    from .flash_pallas import packed_group_supported, packed_supported
+    from .flash_pallas import (packed_group_stream_supported,
+                               packed_group_supported, packed_supported)
     _, T, C3 = qkv.shape
     itemsize = jnp.dtype(qkv.dtype).itemsize
     return (packed_supported(T, C3 // 3, n_head, itemsize)
-            or packed_group_supported(T, C3 // 3, n_head, itemsize))
+            or packed_group_supported(T, C3 // 3, n_head, itemsize)
+            or packed_group_stream_supported(T, C3 // 3, n_head, itemsize))
 
 
 def packed_qkv_attention(qkv: jnp.ndarray, n_head: int, *,

@@ -2285,6 +2285,300 @@ _flash_packed_group.defvjp(_flash_packed_group_fwd_rule,
                            _flash_packed_group_bwd_rule)
 
 
+# ---------------------------------------------------------------------------
+# streamed head-group family: the packed layout past GROUP_STRIP_BYTES
+#
+# The group family above still holds one (T, W) K/V strip resident per
+# (b, g) program, capping it at T <= 2048 (W=128 bf16) — past that, the
+# packed path fell back to the unpacked streamed family and long-context
+# runs paid the (B,T,H,D)<->(B,H,T,D) layout round trips again. This
+# family combines the two existing techniques: the kv axis joins the
+# pallas grid with the online-softmax state carried in VMEM scratch
+# (exactly the streamed family, _fwd_kernel_stream) while the q/k/v
+# operands stay W-wide last-dim BlockSpec strips of the untouched
+# (B, T, 3C) array (exactly the group family). VMEM is O(block*W)
+# regardless of T, so packed long-T is bounded by HBM only.
+#
+# Per-sub-head m/l state rides the (block_q, W) scratch broadcast across
+# each sub-head's D-column slice (the D-narrow analogue of the unpacked
+# stream family's LANES-broadcast stats); dq accumulates across kv grid
+# steps in a (block_q, W) scratch, dk/dv across q grid steps in
+# (block_k, W) scratches — the dq/dkv kernel split of the streamed
+# family, since a kv-major fused dq scratch would be (T, W) f32 and
+# grow with T again. Tile math and the bh = b*H + g*hpg + s dropout
+# counter are shared with every other family: outputs are bit-identical
+# (asserted in tests/test_flash_attention.py group_stream section).
+# Causal tiles skip their matmuls via pl.when on the rectangular grid
+# (the fetch still happens; the triangular tile-map optimization of the
+# unpacked streamed family is not replicated here).
+# ---------------------------------------------------------------------------
+
+
+def packed_group_stream_supported(T: int, C: int, n_head: int,
+                                  itemsize: int) -> bool:
+    """Envelope for the streamed head-group family: lane-aligned groups
+    and block-divisible T — no residency bound (state is O(block*W))."""
+    del itemsize
+    return (_group_geometry(C, n_head) is not None
+            and T >= 128 and T % 128 == 0)
+
+
+def _fwd_kernel_group_stream(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                             acc_ref, m_ref, l_ref, *, scale, causal,
+                             n_head, head_dim, heads_per_group, seq_len,
+                             block_q, block_k, dropout_rate):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    j = pl.program_id(2)
+    kb = pl.program_id(3)
+    D, hpg = head_dim, heads_per_group
+    n_kv = seq_len // block_k
+    q_first = j * block_q
+    k_first = kb * block_k
+    last_kb = (((j + 1) * block_q - 1) // block_k) if causal else n_kv - 1
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    needed = (k_first <= q_first + block_q - 1) if causal else kb >= 0
+
+    @pl.when(needed)
+    def _update():
+        for s in range(hpg):
+            cols = slice(s * D, (s + 1) * D)
+            acc, m_new, l_new = _fwd_tile(
+                q_ref[:, cols], k_ref[:, cols], v_ref[:, cols],
+                acc_ref[:, cols], m_ref[:, cols][:, :1],
+                l_ref[:, cols][:, :1], scale=scale, causal=causal,
+                q_first=q_first, k_first=k_first, block_q=block_q,
+                block_k=block_k, seed=seed_ref[0],
+                bh=b * n_head + g * hpg + s, dropout_rate=dropout_rate)
+            acc_ref[:, cols] = acc
+            m_ref[:, cols] = jnp.broadcast_to(m_new, (block_q, D))
+            l_ref[:, cols] = jnp.broadcast_to(l_new, (block_q, D))
+
+    @pl.when(kb == last_kb)
+    def _finalize():
+        lses = []
+        for s in range(hpg):
+            cols = slice(s * D, (s + 1) * D)
+            m = m_ref[:, cols][:, :1]
+            l = jnp.maximum(l_ref[:, cols][:, :1], 1e-30)
+            o_ref[:, cols] = (acc_ref[:, cols] / l).astype(o_ref.dtype)
+            lses.append(m + jnp.log(l))
+        lse_ref[...] = jnp.concatenate(lses, axis=1)
+
+
+def _group_fwd_stream(qkv, seed, scale, causal, n_head, block_q, block_k,
+                      dropout_rate):
+    B, T, C3 = qkv.shape
+    C = C3 // 3
+    D, hpg, W, G = _group_geometry(C, n_head)
+    kernel = functools.partial(
+        _fwd_kernel_group_stream, scale=scale, causal=causal,
+        n_head=n_head, head_dim=D, heads_per_group=hpg, seq_len=T,
+        block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
+    kw = {}
+    cp = _compiler_params(3, 4)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, G, T // block_q, T // block_k),
+        in_specs=[
+            _smem_spec(),
+            _vmem_spec((None, block_q, W), lambda b, g, j, kb: (b, j, g)),
+            _vmem_spec((None, block_k, W),
+                       lambda b, g, j, kb: (b, kb, G + g)),
+            _vmem_spec((None, block_k, W),
+                       lambda b, g, j, kb: (b, kb, 2 * G + g)),
+        ],
+        out_specs=[
+            _vmem_spec((None, block_q, W), lambda b, g, j, kb: (b, j, g)),
+            _vmem_spec((None, None, block_q, hpg),
+                       lambda b, g, j, kb: (b, g, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, C), qkv.dtype),
+            jax.ShapeDtypeStruct((B, G, T, hpg), jnp.float32),
+        ],
+        scratch_shapes=[_scratch((block_q, W)), _scratch((block_q, W)),
+                        _scratch((block_q, W))],
+        interpret=_interpret_mode(),
+        **kw,
+    )(seed, qkv, qkv, qkv)
+    lse_c = lse.transpose(0, 1, 3, 2).reshape(B, n_head, T)
+    return o, lse_c
+
+
+def _bwd_dq_kernel_group_stream(seed_ref, q_ref, k_ref, v_ref, do_ref,
+                                lse_ref, delta_ref, dq_ref, dq_acc_ref, *,
+                                scale, causal, n_head, head_dim,
+                                heads_per_group, seq_len, block_q, block_k,
+                                dropout_rate):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    j = pl.program_id(2)
+    kb = pl.program_id(3)
+    D, hpg = head_dim, heads_per_group
+    n_kv = seq_len // block_k
+    q_first = j * block_q
+    k_first = kb * block_k
+    last_kb = (((j + 1) * block_q - 1) // block_k) if causal else n_kv - 1
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    needed = (k_first <= q_first + block_q - 1) if causal else kb >= 0
+
+    @pl.when(needed)
+    def _update():
+        for s in range(hpg):
+            cols = slice(s * D, (s + 1) * D)
+            dq_acc_ref[:, cols] = dq_acc_ref[:, cols] + _dq_tile(
+                q_ref[:, cols], k_ref[:, cols], v_ref[:, cols],
+                do_ref[:, cols], lse_ref[:, s:s + 1],
+                delta_ref[:, s:s + 1], scale=scale, causal=causal,
+                q_first=q_first, k_first=k_first, block_q=block_q,
+                block_k=block_k, seed=seed_ref[0],
+                bh=b * n_head + g * hpg + s, dropout_rate=dropout_rate)
+
+    @pl.when(kb == last_kb)
+    def _finalize():
+        dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_group_stream(seed_ref, q_ref, k_ref, v_ref, do_ref,
+                                 lse_ref, delta_ref, dk_ref, dv_ref,
+                                 dk_acc_ref, dv_acc_ref, *, scale, causal,
+                                 n_head, head_dim, heads_per_group, seq_len,
+                                 block_q, block_k, dropout_rate):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    kb = pl.program_id(2)
+    jb = pl.program_id(3)
+    D, hpg = head_dim, heads_per_group
+    n_q = seq_len // block_q
+    k_first = kb * block_k
+    q_first = jb * block_q
+
+    @pl.when(jb == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    needed = (q_first + block_q - 1 >= k_first) if causal else jb >= 0
+
+    @pl.when(needed)
+    def _update():
+        for s in range(hpg):
+            cols = slice(s * D, (s + 1) * D)
+            dk_c, dv_c, _ = _dkv_tile(
+                q_ref[:, cols], k_ref[:, cols], v_ref[:, cols],
+                do_ref[:, cols], lse_ref[:, s:s + 1],
+                delta_ref[:, s:s + 1], scale=scale, causal=causal,
+                q_first=q_first, k_first=k_first, block_q=block_q,
+                block_k=block_k, seed=seed_ref[0],
+                bh=b * n_head + g * hpg + s, dropout_rate=dropout_rate)
+            dk_acc_ref[:, cols] = dk_acc_ref[:, cols] + dk_c
+            dv_acc_ref[:, cols] = dv_acc_ref[:, cols] + dv_c
+
+    @pl.when(jb == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _group_bwd_stream(qkv, do, lse_c, delta_c, seed, scale, causal, n_head,
+                      block_q, block_k, dropout_rate):
+    B, T, C3 = qkv.shape
+    C = C3 // 3
+    D, hpg, W, G = _group_geometry(C, n_head)
+    lse4 = _group_stats(lse_c, hpg)
+    delta4 = _group_stats(delta_c, hpg)
+    common = dict(scale=scale, causal=causal, n_head=n_head, head_dim=D,
+                  heads_per_group=hpg, seq_len=T, block_q=block_q,
+                  block_k=block_k, dropout_rate=dropout_rate)
+    kw = {}
+    cp = _compiler_params(3, 4)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    qs = lambda blk: _vmem_spec((None, block_q, W),
+                                lambda b, g, j, kb: (b, j, blk(g)))
+    ks = lambda blk: _vmem_spec((None, block_k, W),
+                                lambda b, g, j, kb: (b, kb, blk(g)))
+    stat_q = _vmem_spec((None, None, block_q, hpg),
+                        lambda b, g, j, kb: (b, g, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_group_stream, **common),
+        grid=(B, G, T // block_q, T // block_k),
+        in_specs=[_smem_spec(), qs(lambda g: g), ks(lambda g: G + g),
+                  ks(lambda g: 2 * G + g), qs(lambda g: g), stat_q, stat_q],
+        out_specs=qs(lambda g: g),
+        out_shape=jax.ShapeDtypeStruct((B, T, C), qkv.dtype),
+        scratch_shapes=[_scratch((block_q, W))],
+        interpret=_interpret_mode(),
+        **kw,
+    )(seed, qkv, qkv, qkv, do, lse4, delta4)
+
+    # kv-major grid: q/do/stat maps swap roles (kb outer, jb carried)
+    qs2 = lambda blk: _vmem_spec((None, block_q, W),
+                                 lambda b, g, kb, jb: (b, jb, blk(g)))
+    ks2 = lambda blk: _vmem_spec((None, block_k, W),
+                                 lambda b, g, kb, jb: (b, kb, blk(g)))
+    stat_q2 = _vmem_spec((None, None, block_q, hpg),
+                         lambda b, g, kb, jb: (b, g, jb, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_group_stream, **common),
+        grid=(B, G, T // block_k, T // block_q),
+        in_specs=[_smem_spec(), qs2(lambda g: g), ks2(lambda g: G + g),
+                  ks2(lambda g: 2 * G + g), qs2(lambda g: g), stat_q2,
+                  stat_q2],
+        out_specs=[ks2(lambda g: g), ks2(lambda g: g)],
+        out_shape=[jax.ShapeDtypeStruct((B, T, C), qkv.dtype)] * 2,
+        scratch_shapes=[_scratch((block_k, W)), _scratch((block_k, W))],
+        interpret=_interpret_mode(),
+        **kw,
+    )(seed, qkv, qkv, qkv, do, lse4, delta4)
+    return jnp.concatenate([dq, dk, dv], axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _flash_packed_group_stream(qkv, seed, scale, causal, n_head, block_q,
+                               block_k, dropout_rate):
+    o, _ = _group_fwd_stream(qkv, seed, scale, causal, n_head, block_q,
+                             block_k, dropout_rate)
+    return o
+
+
+def _flash_packed_group_stream_fwd_rule(qkv, seed, scale, causal, n_head,
+                                        block_q, block_k, dropout_rate):
+    o, lse_c = _group_fwd_stream(qkv, seed, scale, causal, n_head, block_q,
+                                 block_k, dropout_rate)
+    return o, (qkv, seed, o, lse_c)
+
+
+def _flash_packed_group_stream_bwd_rule(scale, causal, n_head, block_q,
+                                        block_k, dropout_rate, residuals, g):
+    qkv, seed, o, lse_c = residuals
+    B, T, C = o.shape
+    D = C // n_head
+    delta_c = (g.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+        B, T, n_head, D).sum(-1).transpose(0, 2, 1)
+    dqkv = _group_bwd_stream(qkv, g.astype(qkv.dtype), lse_c, delta_c,
+                             seed, scale, causal, n_head, block_q, block_k,
+                             dropout_rate)
+    return dqkv, None
+
+
+_flash_packed_group_stream.defvjp(_flash_packed_group_stream_fwd_rule,
+                                  _flash_packed_group_stream_bwd_rule)
+
+
 def pallas_flash_attention_packed(qkv: jnp.ndarray, n_head: int, *,
                                   scale: Optional[float] = None,
                                   causal: bool = True,
@@ -2303,9 +2597,11 @@ def pallas_flash_attention_packed(qkv: jnp.ndarray, n_head: int, *,
     Routes by residency: the fully-resident family while (T, 3C) fits
     PACKED_QKV_BYTES (short-T/many-head, e.g. char-GPT), the head-group
     family while (T, W) strips fit GROUP_STRIP_BYTES (GPT-2-scale
-    T=1024). ``family`` ('resident' | 'group') overrides the routing —
-    for parity tests and for benchmarking the families against each
-    other on shapes both support."""
+    T=1024), and the streamed head-group family past that (long-T:
+    state in VMEM scratch, T bounded by HBM only). ``family``
+    ('resident' | 'group' | 'group_stream') overrides the routing — for
+    parity tests and for benchmarking the families against each other
+    on shapes both support."""
     B, T, C3 = qkv.shape
     C = C3 // 3
     D = C // n_head
@@ -2317,16 +2613,20 @@ def pallas_flash_attention_packed(qkv: jnp.ndarray, n_head: int, *,
         family = ("resident" if packed_supported(T, C, n_head, itemsize)
                   else "group" if packed_group_supported(T, C, n_head,
                                                         itemsize)
+                  else "group_stream" if packed_group_stream_supported(
+                      T, C, n_head, itemsize)
                   else None)
     if family == "resident":
         return _flash_packed(qkv, seed, scale, bool(causal), n_head,
                              block_q, block_k, rate)
-    if family == "group":
+    if family in ("group", "group_stream"):
         if _group_geometry(C, n_head) is None:
             raise ValueError(f"no lane-aligned head groups for C={C}, "
                              f"n_head={n_head}")
-        return _flash_packed_group(qkv, seed, scale, bool(causal), n_head,
-                                   block_q, block_k, rate)
+        fn = (_flash_packed_group if family == "group"
+              else _flash_packed_group_stream)
+        return fn(qkv, seed, scale, bool(causal), n_head, block_q, block_k,
+                  rate)
     raise ValueError(
         f"packed families do not support T={T}, C={C}, n_head={n_head}; "
         "gate callers on ops.flash_attention.packed_envelope_ok")

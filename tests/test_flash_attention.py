@@ -678,6 +678,116 @@ def test_group_supported_envelope():
     assert not packed_group_supported(t_max + 128, 768, 12, 2)
 
 
+# --- streamed head-group family (packed long-T past GROUP_STRIP_BYTES) -----
+
+
+def test_group_stream_fwd_bit_identical_to_group():
+    """Same strips, kv axis moved to the grid with scratch state: must
+    reproduce the resident group family exactly (shared tile math,
+    shared bh counter stream)."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 4, 32
+    qkv, C = _packed_inputs(B=2, T=256, H=H, D=D, seed=31)
+    grp = pallas_flash_attention_packed(qkv, H, family="group")
+    strm = pallas_flash_attention_packed(qkv, H, family="group_stream")
+    np.testing.assert_array_equal(np.asarray(strm), np.asarray(grp))
+
+
+def test_group_stream_fwd_matches_unpacked():
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 2, 64
+    qkv, C = _packed_inputs(B=1, T=128, H=H, D=D, seed=32)
+    B, T = qkv.shape[:2]
+    q, k, v = jnp.split(qkv, 3, -1)
+    ref = pallas_flash_attention(_heads(q, H), _heads(k, H), _heads(v, H))
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, C)
+    got = pallas_flash_attention_packed(qkv, H, family="group_stream")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_group_stream_dropout_bit_identical_to_unpacked():
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 4, 32
+    qkv, C = _packed_inputs(B=2, T=128, H=H, D=D, seed=33)
+    B, T = qkv.shape[:2]
+    rng = jax.random.PRNGKey(29)
+    got = pallas_flash_attention_packed(qkv, H, family="group_stream",
+                                        dropout_rate=0.2, dropout_rng=rng)
+    q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+    ref = pallas_flash_attention(q, k, v, dropout_rate=0.2, dropout_rng=rng)
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, C)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_group_stream_grads_match_unpacked():
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 4, 32
+    qkv, C = _packed_inputs(B=1, T=256, H=H, D=D, seed=34)
+    B, T = qkv.shape[:2]
+
+    def loss_stream(qkv):
+        o = pallas_flash_attention_packed(qkv, H, family="group_stream")
+        return jnp.sum(o ** 2)
+
+    def loss_unpacked(qkv):
+        q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+        o = pallas_flash_attention(q, k, v)
+        return jnp.sum(o.transpose(0, 2, 1, 3).reshape(B, T, C) ** 2)
+
+    gs = jax.grad(loss_stream)(qkv)
+    gu = jax.grad(loss_unpacked)(qkv)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gu), atol=2e-4,
+                               rtol=2e-4)
+
+
+def test_group_stream_grads_with_dropout_match_group():
+    """The two group families' backwards recompute the same dropout
+    masks from the same counters — grads must agree exactly."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 2, 64
+    qkv, C = _packed_inputs(B=1, T=128, H=H, D=D, seed=35)
+    rng = jax.random.PRNGKey(41)
+
+    def loss(qkv, family):
+        o = pallas_flash_attention_packed(qkv, H, family=family,
+                                          dropout_rate=0.25,
+                                          dropout_rng=rng)
+        return jnp.sum(o ** 2)
+
+    gs = jax.grad(lambda x: loss(x, "group_stream"))(qkv)
+    gg = jax.grad(lambda x: loss(x, "group"))(qkv)
+    np.testing.assert_array_equal(np.asarray(gs), np.asarray(gg))
+
+
+def test_group_stream_envelope_and_routing():
+    """Past GROUP_STRIP_BYTES the entry must route group_stream; the
+    envelope gate in ops.flash_attention must agree."""
+    from replicatinggpt_tpu.ops.flash_attention import packed_envelope_ok
+    from replicatinggpt_tpu.ops.flash_pallas import (
+        packed_group_stream_supported, packed_group_supported)
+    # 124M shapes at T=4096: group is off-envelope, stream is on
+    assert not packed_group_supported(4096, 768, 12, 2)
+    assert packed_group_stream_supported(4096, 768, 12, 2)
+    # longctx bench shapes (T=32k, C=256, H=4 -> D=64)
+    assert packed_group_stream_supported(32768, 256, 4, 2)
+    # geometry failures still excluded
+    assert not packed_group_stream_supported(4096, 1600, 25, 2)
+    assert not packed_group_stream_supported(192, 768, 12, 2)
+    import replicatinggpt_tpu.ops.flash_attention as fa
+    orig = fa._packed_backend_ok
+    fa._packed_backend_ok = lambda: True
+    try:
+        qkv = jnp.zeros((1, 4096, 3 * 768), jnp.bfloat16)
+        assert packed_envelope_ok(qkv, 12)
+    finally:
+        fa._packed_backend_ok = orig
+
+
 def test_packed_entry_routes_group_past_resident_bound():
     """At 124M shapes (T=1024, C=768) the resident family is off-envelope
     and the entry must route to the group family; the envelope gate in
