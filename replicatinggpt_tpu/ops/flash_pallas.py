@@ -2308,9 +2308,11 @@ _flash_packed_group.defvjp(_flash_packed_group_fwd_rule,
 # grow with T again. Tile math and the bh = b*H + g*hpg + s dropout
 # counter are shared with every other family: outputs are bit-identical
 # (asserted in tests/test_flash_attention.py group_stream section).
-# Causal tiles skip their matmuls via pl.when on the rectangular grid
-# (the fetch still happens; the triangular tile-map optimization of the
-# unpacked streamed family is not replicated here).
+# Causal with block_q == block_k (the default) takes the
+# scalar-prefetched triangular tile map (further below) — masked tiles'
+# fetches and grid steps disappear, as in the unpacked tri kernels; the
+# rectangular grid remains for non-causal / unequal-block calls, where
+# pl.when skips masked tiles' matmuls but not their fetches.
 # ---------------------------------------------------------------------------
 
 
@@ -2547,18 +2549,267 @@ def _group_bwd_stream(qkv, do, lse_c, delta_c, seed, scale, causal, n_head,
     return jnp.concatenate([dq, dk, dv], axis=-1)
 
 
+# --- triangular causal grid for the streamed group family ------------------
+#
+# Same optimization as the unpacked tri kernels above: the rectangular
+# (B, G, n_q, n_kv) grid fetches K/V strips for every tile including the
+# ~half causal masking discards. For causal with block_q == block_k the
+# tile axis flattens to the lower triangle via the scalar-prefetched
+# (2, M) tile map — fetches and grid steps for masked tiles disappear.
+
+
+def _fwd_kernel_group_tri(tmap_ref, seed_ref, q_ref, k_ref, v_ref, o_ref,
+                          lse_ref, acc_ref, m_ref, l_ref, *, scale, n_head,
+                          head_dim, heads_per_group, block, dropout_rate):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    t = pl.program_id(2)
+    j = tmap_ref[0, t]
+    kb = tmap_ref[1, t]
+    D, hpg = head_dim, heads_per_group
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    for s in range(hpg):
+        cols = slice(s * D, (s + 1) * D)
+        acc, m_new, l_new = _fwd_tile(
+            q_ref[:, cols], k_ref[:, cols], v_ref[:, cols],
+            acc_ref[:, cols], m_ref[:, cols][:, :1], l_ref[:, cols][:, :1],
+            scale=scale, causal=True, q_first=j * block, k_first=kb * block,
+            block_q=block, block_k=block, seed=seed_ref[0],
+            bh=b * n_head + g * hpg + s, dropout_rate=dropout_rate)
+        acc_ref[:, cols] = acc
+        m_ref[:, cols] = jnp.broadcast_to(m_new, (block, D))
+        l_ref[:, cols] = jnp.broadcast_to(l_new, (block, D))
+
+    @pl.when(kb == j)
+    def _finalize():
+        lses = []
+        for s in range(hpg):
+            cols = slice(s * D, (s + 1) * D)
+            m = m_ref[:, cols][:, :1]
+            l = jnp.maximum(l_ref[:, cols][:, :1], 1e-30)
+            o_ref[:, cols] = (acc_ref[:, cols] / l).astype(o_ref.dtype)
+            lses.append(m + jnp.log(l))
+        lse_ref[...] = jnp.concatenate(lses, axis=1)
+
+
+def _group_fwd_tri(qkv, seed, scale, n_head, block, dropout_rate):
+    B, T, C3 = qkv.shape
+    C = C3 // 3
+    D, hpg, W, G = _group_geometry(C, n_head)
+    n = T // block
+    tmap = jnp.asarray(_tri_tile_map(n, kv_major=False))
+    kernel = functools.partial(
+        _fwd_kernel_group_tri, scale=scale, n_head=n_head, head_dim=D,
+        heads_per_group=hpg, block=block, dropout_rate=dropout_rate)
+    kw = {}
+    cp = _compiler_params(2, 3)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, G, tmap.shape[1]),
+        in_specs=[
+            _vmem_spec((None, block, W),
+                       lambda b, g, t, tm, sd: (b, tm[0, t], g)),
+            _vmem_spec((None, block, W),
+                       lambda b, g, t, tm, sd: (b, tm[1, t], G + g)),
+            _vmem_spec((None, block, W),
+                       lambda b, g, t, tm, sd: (b, tm[1, t], 2 * G + g)),
+        ],
+        out_specs=[
+            _vmem_spec((None, block, W),
+                       lambda b, g, t, tm, sd: (b, tm[0, t], g)),
+            _vmem_spec((None, None, block, hpg),
+                       lambda b, g, t, tm, sd: (b, g, tm[0, t], 0)),
+        ],
+        scratch_shapes=[_scratch((block, W)), _scratch((block, W)),
+                        _scratch((block, W))],
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, C), qkv.dtype),
+            jax.ShapeDtypeStruct((B, G, T, hpg), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+        **kw,
+    )(tmap, seed, qkv, qkv, qkv)
+    lse_c = lse.transpose(0, 1, 3, 2).reshape(B, n_head, T)
+    return o, lse_c
+
+
+def _bwd_dq_kernel_group_tri(tmap_ref, seed_ref, q_ref, k_ref, v_ref,
+                             do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
+                             *, scale, n_head, head_dim, heads_per_group,
+                             block, dropout_rate):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    t = pl.program_id(2)
+    j = tmap_ref[0, t]
+    kb = tmap_ref[1, t]
+    D, hpg = head_dim, heads_per_group
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    for s in range(hpg):
+        cols = slice(s * D, (s + 1) * D)
+        dq_acc_ref[:, cols] = dq_acc_ref[:, cols] + _dq_tile(
+            q_ref[:, cols], k_ref[:, cols], v_ref[:, cols], do_ref[:, cols],
+            lse_ref[:, s:s + 1], delta_ref[:, s:s + 1], scale=scale,
+            causal=True, q_first=j * block, k_first=kb * block,
+            block_q=block, block_k=block, seed=seed_ref[0],
+            bh=b * n_head + g * hpg + s, dropout_rate=dropout_rate)
+
+    @pl.when(kb == j)
+    def _finalize():
+        dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_group_tri(tmap_ref, seed_ref, q_ref, k_ref, v_ref,
+                              do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                              dk_acc_ref, dv_acc_ref, *, scale, n_head,
+                              head_dim, heads_per_group, block, n_q,
+                              dropout_rate):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    t = pl.program_id(2)
+    kb = tmap_ref[0, t]
+    jb = tmap_ref[1, t]
+    D, hpg = head_dim, heads_per_group
+
+    @pl.when(jb == kb)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    for s in range(hpg):
+        cols = slice(s * D, (s + 1) * D)
+        dk_c, dv_c, _ = _dkv_tile(
+            q_ref[:, cols], k_ref[:, cols], v_ref[:, cols], do_ref[:, cols],
+            lse_ref[:, s:s + 1], delta_ref[:, s:s + 1], scale=scale,
+            causal=True, q_first=jb * block, k_first=kb * block,
+            block_q=block, block_k=block, seed=seed_ref[0],
+            bh=b * n_head + g * hpg + s, dropout_rate=dropout_rate)
+        dk_acc_ref[:, cols] = dk_acc_ref[:, cols] + dk_c
+        dv_acc_ref[:, cols] = dv_acc_ref[:, cols] + dv_c
+
+    @pl.when(jb == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _group_bwd_tri(qkv, do, lse_c, delta_c, seed, scale, n_head, block,
+                   dropout_rate):
+    B, T, C3 = qkv.shape
+    C = C3 // 3
+    D, hpg, W, G = _group_geometry(C, n_head)
+    n = T // block
+    lse4 = _group_stats(lse_c, hpg)
+    delta4 = _group_stats(delta_c, hpg)
+    common = dict(scale=scale, n_head=n_head, head_dim=D,
+                  heads_per_group=hpg, block=block,
+                  dropout_rate=dropout_rate)
+    kw = {}
+    cp = _compiler_params(2, 3)
+    if cp is not None:
+        kw["compiler_params"] = cp
+
+    tmap_q = jnp.asarray(_tri_tile_map(n, kv_major=False))
+    # tm[0] = q-block (carried), tm[1] = kv-block
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_group_tri, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, G, tmap_q.shape[1]),
+            in_specs=[
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[0, t], g)),
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[1, t], G + g)),
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[1, t], 2 * G + g)),
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[0, t], g)),
+                _vmem_spec((None, None, block, hpg),
+                           lambda b, g, t, tm, sd: (b, g, tm[0, t], 0)),
+                _vmem_spec((None, None, block, hpg),
+                           lambda b, g, t, tm, sd: (b, g, tm[0, t], 0)),
+            ],
+            out_specs=_vmem_spec((None, block, W),
+                                 lambda b, g, t, tm, sd: (b, tm[0, t], g)),
+            scratch_shapes=[_scratch((block, W))],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, T, C), qkv.dtype),
+        interpret=_interpret_mode(),
+        **kw,
+    )(tmap_q, seed, qkv, qkv, qkv, do, lse4, delta4)
+
+    tmap_kv = jnp.asarray(_tri_tile_map(n, kv_major=True))
+    # tm[0] = kv-block (carried), tm[1] = q-block
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_group_tri, n_q=n, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, G, tmap_kv.shape[1]),
+            in_specs=[
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[1, t], g)),
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[0, t], G + g)),
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[0, t], 2 * G + g)),
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[1, t], g)),
+                _vmem_spec((None, None, block, hpg),
+                           lambda b, g, t, tm, sd: (b, g, tm[1, t], 0)),
+                _vmem_spec((None, None, block, hpg),
+                           lambda b, g, t, tm, sd: (b, g, tm[1, t], 0)),
+            ],
+            out_specs=[
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[0, t], g)),
+                _vmem_spec((None, block, W),
+                           lambda b, g, t, tm, sd: (b, tm[0, t], g)),
+            ],
+            scratch_shapes=[_scratch((block, W)), _scratch((block, W))],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B, T, C), qkv.dtype)] * 2,
+        interpret=_interpret_mode(),
+        **kw,
+    )(tmap_kv, seed, qkv, qkv, qkv, do, lse4, delta4)
+    return jnp.concatenate([dq, dk, dv], axis=-1)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
 def _flash_packed_group_stream(qkv, seed, scale, causal, n_head, block_q,
                                block_k, dropout_rate):
-    o, _ = _group_fwd_stream(qkv, seed, scale, causal, n_head, block_q,
-                             block_k, dropout_rate)
+    if _tri_eligible(causal, block_q, block_k):
+        o, _ = _group_fwd_tri(qkv, seed, scale, n_head, block_q,
+                              dropout_rate)
+    else:
+        o, _ = _group_fwd_stream(qkv, seed, scale, causal, n_head, block_q,
+                                 block_k, dropout_rate)
     return o
 
 
 def _flash_packed_group_stream_fwd_rule(qkv, seed, scale, causal, n_head,
                                         block_q, block_k, dropout_rate):
-    o, lse_c = _group_fwd_stream(qkv, seed, scale, causal, n_head, block_q,
-                                 block_k, dropout_rate)
+    if _tri_eligible(causal, block_q, block_k):
+        o, lse_c = _group_fwd_tri(qkv, seed, scale, n_head, block_q,
+                                  dropout_rate)
+    else:
+        o, lse_c = _group_fwd_stream(qkv, seed, scale, causal, n_head,
+                                     block_q, block_k, dropout_rate)
     return o, (qkv, seed, o, lse_c)
 
 
@@ -2569,9 +2820,13 @@ def _flash_packed_group_stream_bwd_rule(scale, causal, n_head, block_q,
     D = C // n_head
     delta_c = (g.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
         B, T, n_head, D).sum(-1).transpose(0, 2, 1)
-    dqkv = _group_bwd_stream(qkv, g.astype(qkv.dtype), lse_c, delta_c,
-                             seed, scale, causal, n_head, block_q, block_k,
-                             dropout_rate)
+    if _tri_eligible(causal, block_q, block_k):
+        dqkv = _group_bwd_tri(qkv, g.astype(qkv.dtype), lse_c, delta_c,
+                              seed, scale, n_head, block_q, dropout_rate)
+    else:
+        dqkv = _group_bwd_stream(qkv, g.astype(qkv.dtype), lse_c, delta_c,
+                                 seed, scale, causal, n_head, block_q,
+                                 block_k, dropout_rate)
     return dqkv, None
 
 

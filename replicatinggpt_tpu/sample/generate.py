@@ -157,6 +157,9 @@ def _decode_chunks(P_pad: int, n_new: int, S: int):
     chunks compile into the ONE jitted segment — more scan bodies, zero
     extra dispatches."""
     g = ATTEND_GRANULE
+    if n_new <= 0:
+        # one zero-step chunk: callers still get a valid cache bound
+        return [(0, min(-(-P_pad // g) * g, S))]
     chunks = []
     i = 0
     while i < n_new:

@@ -722,6 +722,7 @@ def test_group_stream_dropout_bit_identical_to_unpacked():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_group_stream_grads_match_unpacked():
     from replicatinggpt_tpu.ops.flash_pallas import \
         pallas_flash_attention_packed
@@ -762,6 +763,98 @@ def test_group_stream_grads_with_dropout_match_group():
     gs = jax.grad(lambda x: loss(x, "group_stream"))(qkv)
     gg = jax.grad(lambda x: loss(x, "group"))(qkv)
     np.testing.assert_array_equal(np.asarray(gs), np.asarray(gg))
+
+
+def test_group_stream_tri_multiblock_matches_unpacked():
+    """Explicit block=128 at T=512 -> a 4x4 lower triangle (10 tiles) on
+    the scalar-prefetched tile map; auto blocks would pick 512 and
+    collapse the map to one tile, leaving the carried-state path
+    untested. Bit-parity vs the unpacked kernel at the same tiles."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 4, 32
+    qkv, C = _packed_inputs(B=1, T=512, H=H, D=D, seed=38)
+    B, T = qkv.shape[:2]
+    got = pallas_flash_attention_packed(qkv, H, family="group_stream",
+                                        block_q=128, block_k=128)
+    q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+    ref = pallas_flash_attention(q, k, v, block_q=128, block_k=128)
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, C)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.slow
+def test_group_stream_tri_multiblock_grads_with_dropout():
+    """Multi-block triangular backward (dq carried over kv steps, dk/dv
+    over q steps) with the in-kernel dropout stream, vs the unpacked
+    kernel at the same tiles."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 2, 64
+    qkv, C = _packed_inputs(B=1, T=384, H=H, D=D, seed=39)
+    B, T = qkv.shape[:2]
+    rng = jax.random.PRNGKey(53)
+
+    def loss_tri(qkv):
+        o = pallas_flash_attention_packed(qkv, H, family="group_stream",
+                                          block_q=128, block_k=128,
+                                          dropout_rate=0.25,
+                                          dropout_rng=rng)
+        return jnp.sum(o ** 2)
+
+    def loss_unpacked(qkv):
+        q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+        o = pallas_flash_attention(q, k, v, block_q=128, block_k=128,
+                                   dropout_rate=0.25, dropout_rng=rng)
+        return jnp.sum(o.transpose(0, 2, 1, 3).reshape(B, T, C) ** 2)
+
+    gt = jax.grad(loss_tri)(qkv)
+    gu = jax.grad(loss_unpacked)(qkv)
+    np.testing.assert_allclose(np.asarray(gt), np.asarray(gu), atol=2e-4,
+                               rtol=2e-4)
+
+
+def test_group_stream_rect_unequal_blocks():
+    """block_q != block_k keeps the rectangular grid (the triangular
+    tile map needs equal blocks); with identical tile sizes the unpacked
+    kernel runs the same update sequence, so outputs are bit-equal."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 4, 32
+    qkv, C = _packed_inputs(B=1, T=256, H=H, D=D, seed=36)
+    B, T = qkv.shape[:2]
+    got = pallas_flash_attention_packed(qkv, H, family="group_stream",
+                                        block_q=128, block_k=64)
+    q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+    ref = pallas_flash_attention(q, k, v, block_q=128, block_k=64)
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, C)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_group_stream_rect_grads_match_unpacked():
+    """Backward through the rectangular streamed-group grid (forced via
+    unequal blocks) against the unpacked kernel at the same tile
+    sizes."""
+    from replicatinggpt_tpu.ops.flash_pallas import \
+        pallas_flash_attention_packed
+    H, D = 2, 64
+    qkv, C = _packed_inputs(B=1, T=128, H=H, D=D, seed=37)
+    B, T = qkv.shape[:2]
+
+    def loss_rect(qkv):
+        o = pallas_flash_attention_packed(qkv, H, family="group_stream",
+                                          block_q=128, block_k=64)
+        return jnp.sum(o ** 2)
+
+    def loss_unpacked(qkv):
+        q, k, v = (_heads(t, H) for t in jnp.split(qkv, 3, -1))
+        o = pallas_flash_attention(q, k, v, block_q=128, block_k=64)
+        return jnp.sum(o.transpose(0, 2, 1, 3).reshape(B, T, C) ** 2)
+
+    gr = jax.grad(loss_rect)(qkv)
+    gu = jax.grad(loss_unpacked)(qkv)
+    np.testing.assert_allclose(np.asarray(gr), np.asarray(gu), atol=2e-4,
+                               rtol=2e-4)
 
 
 def test_group_stream_envelope_and_routing():

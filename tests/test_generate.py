@@ -380,6 +380,7 @@ def test_decode_chunks_cover_exactly():
         assert P_pad - 1 + n_new - 1 <= S - 1
 
 
+@pytest.mark.slow
 def test_chunked_segment_matches_monolithic(monkeypatch):
     """The chunked-attend decode scan must produce the bit-identical
     sampled trajectory of a single full-S scan (the rng-split sequence
@@ -408,6 +409,7 @@ def test_chunked_segment_matches_monolithic(monkeypatch):
     np.testing.assert_array_equal(mono, chunked)
 
 
+@pytest.mark.slow
 def test_decode_step_short_cache_parity():
     """decode_step on a shorter cache buffer (init_kv_cache max_len)
     returns the same logits and cache writes as the full bucket while
