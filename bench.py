@@ -633,10 +633,14 @@ def main() -> None:
               "train": "char_gpt_train_tokens_per_sec_per_chip"}[args.mode]
     unit = ("tokens/sec" if args.mode in ("generate", "decode")
             else "ms" if args.mode == "kernel" else "tokens/sec/chip")
-    start_watchdog(args.watchdog, metric, unit)
-
     try:
+        # probe first, watchdog after: the probe phase is already
+        # hard-bounded (tries x (120s timeout + wait)) and a wedged
+        # tunnel can eat many retries — starting the watchdog before it
+        # burned the whole run budget on probes and emitted a false
+        # "device hang" artifact while the device was merely unclaimed
         probe_backend(args.platform, args.probe_tries, args.probe_wait)
+        start_watchdog(args.watchdog, metric, unit)
         import jax
 
         if args.platform:
