@@ -1,0 +1,172 @@
+"""Run the queued hardware validation for the round-4 late changes, in
+order, with per-phase subprocess timeouts, appending one JSON line per
+phase to benchmarks/HW_VALIDATION.jsonl. Safe to re-run: phases are
+independent and each line carries its own timestamp-free phase id +
+outcome (re-runs append; the newest line for a phase wins).
+
+    python tools/hw_validate.py            # everything
+    python tools/hw_validate.py --only compile4k,ab_decode
+
+Phases:
+  probe       jax.devices() in a subprocess (bounded) — tunnel health
+  compile4k   group_stream fwd+bwd Mosaic compile + finite values,
+              T=4096 / 12H / 768C bf16 (124M long-T shape)
+  compile32k  same at T=32768 / 4H / 256C (longctx bench shape)
+  parity4k    HARDWARE bit-parity: group_stream output vs the unpacked
+              streamed family on the same logical q/k/v (the interpret-
+              mode assertion, re-proven on real Mosaic lowerings)
+  kernel_ab   bench.py --mode kernel --kernel-longt 16384 (A/B: packed
+              streamed-group vs unpacked streamed + layout round trip)
+  longctx     bench.py --mode longctx (T=32k end-to-end train step;
+              round-3 unpacked baseline 101,484 tok/s/chip)
+  ab_decode   benchmarks/decode_chunk_ab.py --preset gpt2-small
+              (chunked vs monolithic decode, B=1/8/32, one process)
+  ab_decode_char  same with --preset char-gpt
+  decode_sweep    bench.py --mode decode --preset gpt2-small (the
+              RESULTS.md table protocol, post-chunking)
+
+Each phase runs in a fresh subprocess so a hang cannot poison the
+orchestrator; the TPU is used by at most one phase at a time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+OUT = ROOT / "benchmarks" / "HW_VALIDATION.jsonl"
+
+COMPILE_SNIPPET = """
+import jax, jax.numpy as jnp
+from replicatinggpt_tpu.ops.flash_pallas import pallas_flash_attention_packed
+T, H, C = {T}, {H}, {C}
+qkv = jax.random.normal(jax.random.PRNGKey(0), (1, T, 3 * C), jnp.bfloat16)
+f = jax.jit(jax.value_and_grad(lambda q: jnp.sum(
+    pallas_flash_attention_packed(q, H, family="group_stream")
+    .astype(jnp.float32) ** 2)))
+import time; t0 = time.perf_counter()
+v, g = f(qkv)
+v = float(v)
+print("compile+step", round(time.perf_counter() - t0, 1), "s, loss", v,
+      "grad-shape", g.shape)
+assert v == v and abs(v) < 1e30, "non-finite loss"
+assert bool(jnp.isfinite(g.astype(jnp.float32)).all()), "non-finite grads"
+print("PASS")
+"""
+
+PARITY_SNIPPET = """
+import jax, jax.numpy as jnp, numpy as np
+from replicatinggpt_tpu.ops.flash_pallas import (
+    pallas_flash_attention, pallas_flash_attention_packed)
+T, H, D = 4096, 12, 64
+C = H * D
+qkv = jax.random.normal(jax.random.PRNGKey(1), (1, T, 3 * C), jnp.bfloat16)
+got = pallas_flash_attention_packed(qkv, H, family="group_stream")
+q, k, v = jnp.split(qkv, 3, -1)
+q, k, v = (t.reshape(1, T, H, D).transpose(0, 2, 1, 3) for t in (q, k, v))
+ref = pallas_flash_attention(q, k, v)
+ref = ref.transpose(0, 2, 1, 3).reshape(1, T, C)
+np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+print("PASS bit-equal on hardware")
+"""
+
+# bench.py phases: the orchestrator timeout MUST exceed the bench's own
+# probe bound (tries x (120s + wait)) + its --watchdog, so bench always
+# gets to exit via its graceful watchdog and this process never SIGKILLs
+# it mid-TPU-dispatch — a hard kill mid-dispatch is exactly what wedged
+# the device claim for 3+ hours (see the verify skill's wedge notes).
+# Non-bench phases get generous timeouts for the same reason: only kill
+# what is genuinely hung (at which point the device is already stuck).
+_BENCH_GUARD = ["--probe-tries", "2", "--probe-wait", "30"]  # <= 300s
+
+PHASES = [
+    ("probe", [sys.executable, "-c",
+               "import jax; d=jax.devices(); print('ok', d[0].device_kind)"],
+     150),
+    ("compile4k", [sys.executable, "-c",
+                   COMPILE_SNIPPET.format(T=4096, H=12, C=768)], 600),
+    ("compile32k", [sys.executable, "-c",
+                    COMPILE_SNIPPET.format(T=32768, H=4, C=256)], 900),
+    ("parity4k", [sys.executable, "-c", PARITY_SNIPPET], 600),
+    ("kernel_ab", [sys.executable, "bench.py", "--mode", "kernel",
+                   "--kernel-longt", "16384", "--repeats", "5",
+                   "--kernel-inner", "5", "--watchdog", "1200",
+                   *_BENCH_GUARD], 1800),
+    ("longctx", [sys.executable, "bench.py", "--mode", "longctx",
+                 "--watchdog", "1000", *_BENCH_GUARD], 1500),
+    ("ab_decode", [sys.executable, "benchmarks/decode_chunk_ab.py",
+                   "--preset", "gpt2-small", "--batch-sizes", "1,8,32",
+                   "--laps", "5"], 3600),
+    ("ab_decode_char", [sys.executable, "benchmarks/decode_chunk_ab.py",
+                        "--preset", "char-gpt", "--batch-sizes", "1,8,32",
+                        "--laps", "5"], 2400),
+    ("decode_sweep", [sys.executable, "bench.py", "--mode", "decode",
+                      "--preset", "gpt2-small", "--steps", "5",
+                      "--watchdog", "1800", *_BENCH_GUARD], 2400),
+]
+
+
+def run_phase(name: str, cmd, timeout_s: int) -> dict:
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
+                           timeout=timeout_s)
+        tail = "\n".join((r.stdout + "\n" + r.stderr).strip()
+                         .splitlines()[-15:])
+        return {"phase": name, "ok": r.returncode == 0,
+                "rc": r.returncode,
+                "wall_s": round(time.perf_counter() - t0, 1),
+                "tail": tail[-3000:]}
+    except subprocess.TimeoutExpired as e:
+        def _txt(x):
+            if isinstance(x, bytes):
+                return x.decode(errors="replace")
+            return x or ""
+        # bench progress goes to stderr (log()) — keep both streams
+        partial = (_txt(e.stdout) + "\n" + _txt(e.stderr)).strip()
+        return {"phase": name, "ok": False, "rc": "timeout",
+                "wall_s": round(time.perf_counter() - t0, 1),
+                "tail": partial[-3000:]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default="",
+                    help="comma-separated phase names (default: all)")
+    ap.add_argument("--stop-on-fail", action="store_true",
+                    help="abort the queue on the first failed phase "
+                         "(default: continue — later phases may still "
+                         "be informative)")
+    args = ap.parse_args(argv)
+    only = {s for s in args.only.split(",") if s}
+    known = {name for name, _, _ in PHASES}
+    unknown = only - known
+    if unknown:
+        ap.error(f"unknown phase(s) {sorted(unknown)}; "
+                 f"choose from {sorted(known)}")
+    failures = 0
+    for name, cmd, timeout_s in PHASES:
+        if only and name not in only:
+            continue
+        print(f"=== {name} (timeout {timeout_s}s)", flush=True)
+        rec = run_phase(name, cmd, timeout_s)
+        with OUT.open("a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(rec["tail"][-800:], flush=True)
+        print(f"=== {name}: {'OK' if rec['ok'] else 'FAIL'} "
+              f"({rec['wall_s']}s)", flush=True)
+        if not rec["ok"]:
+            failures += 1
+            if name == "probe" or args.stop_on_fail:
+                print("aborting queue", flush=True)
+                return 1
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
