@@ -857,6 +857,41 @@ def test_group_stream_rect_grads_match_unpacked():
                                rtol=2e-4)
 
 
+def test_model_block_routes_group_stream_past_strip_bound(monkeypatch):
+    """forward() end-to-end through the packed AUTO routing when both
+    residency bounds exclude the other families: the streamed group
+    family must be selected and produce the split-path logits. Bounds
+    are shrunk instead of using a real >2048-token model so the test
+    stays in the fast tier."""
+    import replicatinggpt_tpu.ops.flash_attention as fa
+    import replicatinggpt_tpu.ops.flash_pallas as fp
+    from replicatinggpt_tpu.config import ModelConfig
+    from replicatinggpt_tpu.models.gpt import forward, init_params
+
+    mcfg = ModelConfig(vocab_size=64, block_size=512, n_layer=1, n_head=4,
+                       n_embd=128, dropout=0.0, attn_dropout=0.0,
+                       dtype="float32", attention_impl="flash")
+    params = init_params(jax.random.PRNGKey(0), mcfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (1, 512), 0, 64)
+    ref, _ = forward(params, x, mcfg)  # CPU backend -> split path
+
+    calls = []
+    orig = fp._flash_packed_group_stream
+
+    def spy(*a, **kw):
+        calls.append(True)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fp, "PACKED_QKV_BYTES", 1)
+    monkeypatch.setattr(fp, "GROUP_STRIP_BYTES", 1)
+    monkeypatch.setattr(fp, "_flash_packed_group_stream", spy)
+    monkeypatch.setattr(fa, "_packed_backend_ok", lambda: True)
+    got, _ = forward(params, x, mcfg)
+    assert calls, "streamed group family was not routed"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4,
+                               rtol=2e-4)
+
+
 def test_group_stream_envelope_and_routing():
     """Past GROUP_STRIP_BYTES the entry must route group_stream; the
     envelope gate in ops.flash_attention must agree."""
