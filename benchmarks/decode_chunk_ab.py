@@ -3,7 +3,7 @@ monolithic full-bucket scan, per batch size, in ONE process — both modes
 share the model, the tunnel session and the thermal/noise environment,
 so the delta is the chunking and not run-to-run drift.
 
-The monolithic arm is the same code with ATTEND_GRANULE = block_size
+The monolithic arm is the same code with attend_granule = block_size
 (one chunk at full width — exactly the pre-chunking program). Repro:
 
     python benchmarks/decode_chunk_ab.py --preset gpt2-small \
@@ -19,7 +19,6 @@ bit-parity), so this measures bytes, not semantics.
 from __future__ import annotations
 
 import argparse
-import importlib
 import json
 import sys
 import time
@@ -40,20 +39,18 @@ def main(argv=None):
     from replicatinggpt_tpu.sample import GenerateConfig, generate
     from replicatinggpt_tpu.train.state import create_train_state
 
-    gen_mod = importlib.import_module("replicatinggpt_tpu.sample.generate")
-
     cfg = get_config(args.preset)
     state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
-    gcfg = GenerateConfig(max_new_tokens=args.tokens, top_k=50)
-    shipped_granule = gen_mod.ATTEND_GRANULE  # the configuration users get
+    shipped_granule = GenerateConfig().attend_granule  # what users get
     out = {}
     for B in (int(b) for b in args.batch_sizes.split(",")):
         prompt = jnp.zeros((B, 1), jnp.int32)
         for mode, granule in (("monolithic", cfg.model.block_size),
                               ("chunked", shipped_granule)):
-            gen_mod.ATTEND_GRANULE = granule
-            gen_mod._decode_segment.clear_cache()
-            gen_mod._refresh_group.clear_cache()
+            # attend_granule is part of the static jit key, so the two
+            # arms compile as distinct programs — no cache clearing
+            gcfg = GenerateConfig(max_new_tokens=args.tokens, top_k=50,
+                                  attend_granule=granule)
             # warm/compile
             jax.device_get(generate(state.params, prompt, cfg.model, gcfg))
             laps = []

@@ -54,13 +54,17 @@ def packed_envelope_ok(qkv: jnp.ndarray, n_head: int) -> bool:
     so a gate added here can never diverge the two paths."""
     if not _packed_backend_ok():
         return False
-    from .flash_pallas import (packed_group_stream_supported,
-                               packed_group_supported, packed_supported)
+    from . import flash_pallas as fp
     _, T, C3 = qkv.shape
     itemsize = jnp.dtype(qkv.dtype).itemsize
-    return (packed_supported(T, C3 // 3, n_head, itemsize)
-            or packed_group_supported(T, C3 // 3, n_head, itemsize)
-            or packed_group_stream_supported(T, C3 // 3, n_head, itemsize))
+    # group_stream joins the envelope only behind its hardware-validation
+    # gate (fp.GROUP_STREAM_AUTOROUTE) — read dynamically so flipping the
+    # gate (hw_validate passing, or a test) takes effect here too
+    return (fp.packed_supported(T, C3 // 3, n_head, itemsize)
+            or fp.packed_group_supported(T, C3 // 3, n_head, itemsize)
+            or (fp.GROUP_STREAM_AUTOROUTE
+                and fp.packed_group_stream_supported(T, C3 // 3, n_head,
+                                                     itemsize)))
 
 
 def packed_qkv_attention(qkv: jnp.ndarray, n_head: int, *,

@@ -2316,6 +2316,19 @@ _flash_packed_group.defvjp(_flash_packed_group_fwd_rule,
 # ---------------------------------------------------------------------------
 
 
+# Auto-route gate for the streamed head-group family. False keeps the
+# family OPT-IN (family="group_stream") and off the production routing —
+# both the family=None dispatch below and ops.flash_attention's
+# packed_envelope_ok read it. Flip to True only once hw_validate's
+# compile4k / compile32k / parity4k phases PASS under real Mosaic
+# lowering: this codebase has already shipped a (T,)-stats layout that
+# interpret mode accepted and Mosaic rejected, so interpret-mode proof
+# alone must not put a kernel family on the default path (long-context
+# runs would trade the proven unpacked streamed family for a possible
+# compile failure at merge).
+GROUP_STREAM_AUTOROUTE = False
+
+
 def packed_group_stream_supported(T: int, C: int, n_head: int,
                                   itemsize: int) -> bool:
     """Envelope for the streamed head-group family: lane-aligned groups
@@ -2868,8 +2881,10 @@ def pallas_flash_attention_packed(qkv: jnp.ndarray, n_head: int, *,
         family = ("resident" if packed_supported(T, C, n_head, itemsize)
                   else "group" if packed_group_supported(T, C, n_head,
                                                         itemsize)
-                  else "group_stream" if packed_group_stream_supported(
-                      T, C, n_head, itemsize)
+                  else "group_stream" if (
+                      GROUP_STREAM_AUTOROUTE
+                      and packed_group_stream_supported(T, C, n_head,
+                                                        itemsize))
                   else None)
     if family == "resident":
         return _flash_packed(qkv, seed, scale, bool(causal), n_head,

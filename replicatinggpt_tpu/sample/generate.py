@@ -45,6 +45,14 @@ class GenerateConfig:
                                        # (beyond the reference's samplers;
                                        # composes with top_k: k-filter first)
     greedy: bool = False
+    attend_granule: int = 128          # KV-cache growth granule for the
+                                       # chunked decode scan (_decode_chunks);
+                                       # block_size = the monolithic
+                                       # full-bucket scan. Lives here (a
+                                       # static jit arg) so changing it keys
+                                       # a fresh compile — a module global
+                                       # read at trace time silently reused
+                                       # stale chunking across mutations.
 
 
 def _sortable_f32(x: jnp.ndarray) -> jnp.ndarray:
@@ -138,14 +146,11 @@ def _sample_token(rng: jax.Array, logits: jnp.ndarray,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-ATTEND_GRANULE = 128
-
-
-def _decode_chunks(P_pad: int, n_new: int, S: int):
+def _decode_chunks(P_pad: int, n_new: int, S: int, g: int):
     """Static (n_steps, cache_len) chunks covering an ``n_new``-step
     decode scan whose step i writes position <= P_pad - 1 + i. The KV
     cache buffer starts at the first chunk's cache_len (a multiple of
-    ATTEND_GRANULE, capped at S) and is zero-padded up between chunks,
+    the granule ``g``, capped at S) and is zero-padded up between chunks,
     so early steps stop paying for the whole static bucket — at B >= 8
     the cache read dominates decode step bytes and a 1k-token sample
     from a short prompt otherwise streams all S slots from token 1
@@ -156,7 +161,6 @@ def _decode_chunks(P_pad: int, n_new: int, S: int):
     instead was measured 10x worse (see models.gpt.decode_step). All
     chunks compile into the ONE jitted segment — more scan bodies, zero
     extra dispatches."""
-    g = ATTEND_GRANULE
     if n_new <= 0:
         # one zero-step chunk: callers still get a valid cache bound
         return [(0, min(-(-P_pad // g) * g, S))]
@@ -190,7 +194,8 @@ def _segment_core(params, prompt: jnp.ndarray, prompt_len, n_new: int,
     bucket slots, so the sampled trajectory matches a single full-bucket
     scan (asserted in tests/test_generate.py)."""
     B, P_pad = prompt.shape
-    chunks = _decode_chunks(P_pad, n_new, cfg.block_size)
+    chunks = _decode_chunks(P_pad, n_new, cfg.block_size,
+                            gcfg.attend_granule)
     cache = init_kv_cache(cfg, B, max_len=chunks[0][1])
     prompt_len = jnp.asarray(prompt_len, jnp.int32)
     cache = prefill(params, prompt, cache, cfg)
@@ -328,6 +333,7 @@ def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
     prompt = jnp.asarray(prompt, jnp.int32)
     assert prompt.ndim == 2 and prompt.shape[1] >= 1
     assert prompt.shape[1] <= cfg.block_size, "prompt longer than block_size"
+    assert gcfg.attend_granule >= 1, "attend_granule must be >= 1"
     S = cfg.block_size
     B, P0 = prompt.shape
     chunks = []

@@ -884,6 +884,7 @@ def test_model_block_routes_group_stream_past_strip_bound(monkeypatch):
 
     monkeypatch.setattr(fp, "PACKED_QKV_BYTES", 1)
     monkeypatch.setattr(fp, "GROUP_STRIP_BYTES", 1)
+    monkeypatch.setattr(fp, "GROUP_STREAM_AUTOROUTE", True)
     monkeypatch.setattr(fp, "_flash_packed_group_stream", spy)
     monkeypatch.setattr(fa, "_packed_backend_ok", lambda: True)
     got, _ = forward(params, x, mcfg)
@@ -892,9 +893,11 @@ def test_model_block_routes_group_stream_past_strip_bound(monkeypatch):
                                rtol=2e-4)
 
 
-def test_group_stream_envelope_and_routing():
-    """Past GROUP_STRIP_BYTES the entry must route group_stream; the
-    envelope gate in ops.flash_attention must agree."""
+def test_group_stream_envelope_and_routing(monkeypatch):
+    """Past GROUP_STRIP_BYTES the entry must route group_stream once its
+    hardware-validation gate is open; the envelope gate in
+    ops.flash_attention must agree."""
+    import replicatinggpt_tpu.ops.flash_pallas as fp
     from replicatinggpt_tpu.ops.flash_attention import packed_envelope_ok
     from replicatinggpt_tpu.ops.flash_pallas import (
         packed_group_stream_supported, packed_group_supported)
@@ -907,13 +910,30 @@ def test_group_stream_envelope_and_routing():
     assert not packed_group_stream_supported(4096, 1600, 25, 2)
     assert not packed_group_stream_supported(192, 768, 12, 2)
     import replicatinggpt_tpu.ops.flash_attention as fa
-    orig = fa._packed_backend_ok
-    fa._packed_backend_ok = lambda: True
-    try:
-        qkv = jnp.zeros((1, 4096, 3 * 768), jnp.bfloat16)
-        assert packed_envelope_ok(qkv, 12)
-    finally:
-        fa._packed_backend_ok = orig
+    monkeypatch.setattr(fa, "_packed_backend_ok", lambda: True)
+    qkv = jnp.zeros((1, 4096, 3 * 768), jnp.bfloat16)
+    monkeypatch.setattr(fp, "GROUP_STREAM_AUTOROUTE", True)
+    assert packed_envelope_ok(qkv, 12)
+
+
+def test_group_stream_gated_out_of_autoroute_by_default(monkeypatch):
+    """Until hw_validate's compile/parity phases pass on real Mosaic,
+    group_stream must stay opt-in: with the gate at its shipped default
+    the envelope excludes group_stream-only shapes (callers fall back to
+    the hardware-proven unpacked streamed family) and the family=None
+    entry refuses rather than silently picking it."""
+    import replicatinggpt_tpu.ops.flash_attention as fa
+    import replicatinggpt_tpu.ops.flash_pallas as fp
+    from replicatinggpt_tpu.ops.flash_attention import packed_envelope_ok
+    assert fp.GROUP_STREAM_AUTOROUTE is False  # shipped default
+    monkeypatch.setattr(fa, "_packed_backend_ok", lambda: True)
+    # T=4096 @ 124M widths: only group_stream covers it -> envelope closed
+    qkv = jnp.zeros((1, 4096, 3 * 768), jnp.bfloat16)
+    assert not packed_envelope_ok(qkv, 12)
+    with pytest.raises(ValueError, match="packed families"):
+        fp.pallas_flash_attention_packed(qkv, 12)
+    # explicit opt-in still addresses the family (envelope fn agrees)
+    assert fp.packed_group_stream_supported(4096, 768, 12, 2)
 
 
 def test_packed_entry_routes_group_past_resident_bound():

@@ -364,15 +364,15 @@ def test_decode_chunks_cover_exactly():
     valid bound for every position its chunk writes (pos <= P_pad-1+i
     < attend_len) and never exceeding S."""
     from replicatinggpt_tpu.sample.generate import _decode_chunks
+    GRANULE = 128
     for P_pad, n_new, S in [(1, 1024, 1024), (512, 513, 1024),
                             (1, 1, 32), (32, 1, 32), (7, 250, 256),
                             (128, 897, 1024)]:
-        chunks = _decode_chunks(P_pad, n_new, S)
+        chunks = _decode_chunks(P_pad, n_new, S, GRANULE)
         i = 0
         for n_c, a in chunks:
-            from replicatinggpt_tpu.sample.generate import ATTEND_GRANULE
             assert n_c >= 1 and a <= S
-            assert a % ATTEND_GRANULE == 0 or a == S
+            assert a % GRANULE == 0 or a == S
             last_pos = P_pad - 1 + i + n_c - 1
             assert last_pos < a, (P_pad, n_new, S, chunks)
             i += n_c
@@ -381,31 +381,24 @@ def test_decode_chunks_cover_exactly():
 
 
 @pytest.mark.slow
-def test_chunked_segment_matches_monolithic(monkeypatch):
+def test_chunked_segment_matches_monolithic():
     """The chunked-attend decode scan must produce the bit-identical
     sampled trajectory of a single full-S scan (the rng-split sequence
     per step is unchanged; the cache prefix slice only drops slots the
-    mask already zeroed)."""
-    import importlib
-    # the package re-exports the `generate` function under the same name,
-    # shadowing the submodule attribute — resolve the module itself
-    G = importlib.import_module("replicatinggpt_tpu.sample.generate")
+    mask already zeroed). attend_granule is a GenerateConfig field —
+    part of the static jit key — so the two arms compile separately
+    with no cache clearing."""
     params = init_params(jax.random.PRNGKey(0), CFG)
     prompt = np.array([[1, 5, 9], [3, 3, 3]], dtype=np.int32)
-    gcfg = GenerateConfig(max_new_tokens=60, temperature=0.9, top_k=8)
     rng = jax.random.PRNGKey(42)
     # granule S = one chunk at full attend width (the old monolithic scan)
-    monkeypatch.setattr(G, "ATTEND_GRANULE", CFG.block_size)
-    G._decode_segment.clear_cache()
-    G._refresh_group.clear_cache()
-    mono = np.asarray(generate(params, prompt, CFG, gcfg, rng=rng))
+    mono_cfg = GenerateConfig(max_new_tokens=60, temperature=0.9, top_k=8,
+                              attend_granule=CFG.block_size)
+    mono = np.asarray(generate(params, prompt, CFG, mono_cfg, rng=rng))
     # granule 8 engages real chunking at block_size=32
-    monkeypatch.setattr(G, "ATTEND_GRANULE", 8)
-    G._decode_segment.clear_cache()
-    G._refresh_group.clear_cache()
-    chunked = np.asarray(generate(params, prompt, CFG, gcfg, rng=rng))
-    G._decode_segment.clear_cache()
-    G._refresh_group.clear_cache()
+    chunk_cfg = GenerateConfig(max_new_tokens=60, temperature=0.9, top_k=8,
+                               attend_granule=8)
+    chunked = np.asarray(generate(params, prompt, CFG, chunk_cfg, rng=rng))
     np.testing.assert_array_equal(mono, chunked)
 
 

@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import pathlib
+import signal
 import subprocess
 import sys
 import time
@@ -112,26 +113,40 @@ PHASES = [
 
 
 def run_phase(name: str, cmd, timeout_s: int) -> dict:
+    """One phase in a fresh subprocess. On expiry the child gets a
+    graceful signal ladder — SIGINT (KeyboardInterrupt: bench watchdogs
+    and orbax finalizers run), then SIGTERM, then SIGKILL as the last
+    resort — because a SIGKILL mid-TPU-dispatch is exactly the hard-kill
+    mode that wedged the axon device claim for hours (comment block
+    above). A graceful exit during the ladder still reports
+    rc="timeout" — the phase exceeded its budget either way."""
     t0 = time.perf_counter()
+    proc = subprocess.Popen(cmd, cwd=ROOT, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    rc = "timeout"
     try:
-        r = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
-                           timeout=timeout_s)
-        tail = "\n".join((r.stdout + "\n" + r.stderr).strip()
-                         .splitlines()[-15:])
-        return {"phase": name, "ok": r.returncode == 0,
-                "rc": r.returncode,
-                "wall_s": round(time.perf_counter() - t0, 1),
-                "tail": tail[-3000:]}
-    except subprocess.TimeoutExpired as e:
-        def _txt(x):
-            if isinstance(x, bytes):
-                return x.decode(errors="replace")
-            return x or ""
-        # bench progress goes to stderr (log()) — keep both streams
-        partial = (_txt(e.stdout) + "\n" + _txt(e.stderr)).strip()
-        return {"phase": name, "ok": False, "rc": "timeout",
-                "wall_s": round(time.perf_counter() - t0, 1),
-                "tail": partial[-3000:]}
+        out, err = proc.communicate(timeout=timeout_s)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        for sig, grace in ((signal.SIGINT, 120), (signal.SIGTERM, 30)):
+            proc.send_signal(sig)
+            try:
+                out, err = proc.communicate(timeout=grace)
+                break
+            except subprocess.TimeoutExpired:
+                continue
+        else:
+            proc.kill()
+            out, err = proc.communicate()
+    # bench progress goes to stderr (log()) — keep both streams. On a
+    # timeout keep the full 3000-char window of partial output (where it
+    # stalled is the diagnostic); a clean exit only needs the last lines.
+    text = ((out or "") + "\n" + (err or "")).strip()
+    tail = (text if rc == "timeout"
+            else "\n".join(text.splitlines()[-15:]))
+    return {"phase": name, "ok": rc == 0, "rc": rc,
+            "wall_s": round(time.perf_counter() - t0, 1),
+            "tail": tail[-3000:]}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe loop: check TPU backend availability every 5 min, log to benchmarks/tpu_probe.log.
+# Exits 0 as soon as a probe succeeds.
+LOG=/root/repo/benchmarks/tpu_probe.log
+for i in $(seq 1 120); do
+  TS=$(date -u +%FT%TZ)
+  if timeout -s INT --kill-after=30 120 python -c "import jax; d=jax.devices(); print(d)" >>"$LOG" 2>&1; then
+    echo "$TS probe $i: OK" >> "$LOG"
+    exit 0
+  else
+    echo "$TS probe $i: timeout/fail" >> "$LOG"
+  fi
+  sleep 300
+done
+echo "gave up after 120 probes" >> "$LOG"
+exit 1
