@@ -8,6 +8,8 @@ every pipeline as a subcommand over the preset/override config system:
     python -m replicatinggpt_tpu generate --preset char-gpt --checkpoint ...
     python -m replicatinggpt_tpu import-hf --model-type gpt2
     python -m replicatinggpt_tpu eval     --preset char-gpt --checkpoint ...
+    python -m replicatinggpt_tpu export-torch --preset char-gpt \
+        --checkpoint-dir ... --out model.pth
 """
 
 from __future__ import annotations
@@ -160,6 +162,50 @@ def cmd_import_hf(args) -> int:
     return 0
 
 
+def cmd_export_torch(args) -> int:
+    """Write the reference's durable artifact — a torch ``state_dict``
+    file (``torch.save(m.state_dict(), 'model.pth')``,
+    /root/reference/GPT1.py:239-241) — from a framework checkpoint.
+    The tensors land in :class:`~.reference_torch.RefGPT`'s layout
+    ((in, out) kernels, applied as ``x @ W``), so
+    ``RefGPT(cfg).load_state_dict(torch.load(out))`` reproduces the
+    checkpointed model bit-for-bit in torch (round-trip pinned in
+    tests/test_cli.py). Closes the import/export asymmetry: import-hf
+    brings torch weights in, this takes them out."""
+    _apply_rng_impl(args)
+    import jax
+    import torch
+    cfg = config_from_args(args)
+    from .data.dataset import load_corpus
+    from .reference_torch import RefGPT, params_to_torch
+    from .tokenizers import get_tokenizer
+    from .train.checkpoint import CheckpointManager
+    from .train.runner import _resolve_vocab
+    from .train.state import create_train_state
+    text = load_corpus(cfg.dataset)
+    tokenizer = get_tokenizer(cfg.tokenizer, corpus_text=text)
+    cfg = _resolve_vocab(cfg, tokenizer)
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
+                               cfg.model, cfg.train)
+    if args.checkpoint_dir:
+        ck = CheckpointManager(args.checkpoint_dir)
+        restored = ck.restore_latest(state)
+        if restored is None:
+            print("no checkpoint found; exporting random init",
+                  file=sys.stderr)
+        else:
+            state = restored
+    else:
+        print("no --checkpoint-dir; exporting random init", file=sys.stderr)
+    model = params_to_torch(jax.device_get(state.params), RefGPT(cfg.model))
+    with open(args.out, "wb") as f:
+        torch.save(model.state_dict(), f)
+    n_params = sum(p.numel() for p in model.parameters())
+    print(f"exported {n_params:,} params (step "
+          f"{int(state.step)}) to {args.out}")
+    return 0
+
+
 def cmd_eval(args) -> int:
     _apply_rng_impl(args)
     import jax
@@ -243,6 +289,14 @@ def main(argv=None) -> int:
                     choices=["gpt2", "gpt2-medium", "gpt2-large", "gpt2-xl"])
     pi.add_argument("--save-dir", default=None)
     pi.set_defaults(fn=cmd_import_hf)
+
+    px = sub.add_parser("export-torch",
+                        help="export a checkpoint as a torch state_dict "
+                             "(the reference's model.pth artifact)")
+    add_config_flags(px)
+    px.add_argument("--checkpoint-dir", default=None)
+    px.add_argument("--out", default="model.pth")
+    px.set_defaults(fn=cmd_export_torch)
 
     pe = sub.add_parser("eval", help="estimate train/val loss")
     add_config_flags(pe)

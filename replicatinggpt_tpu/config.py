@@ -267,6 +267,28 @@ PRESETS = {
         name="gpt2-xl", model=_gpt2_ladder(48, 25, 1600, remat=True),
         mesh=MeshConfig(data=16, fsdp=True), tokenizer="bpe",
     ),
+    # The reference GPT1.py's DEFAULT tokenizer branch as intended:
+    # tiktoken o200k_base with the §8-B1 vocab bug fixed (the reference
+    # hard-coded vocab 50257 under a ~200k-token encoding, so most ids
+    # indexed past the embedding; here the tokenizer's true n_vocab
+    # (200,019) is rounded up to an MXU-friendly 200,064 = 128*1563).
+    # Giant-vocab caveat measured on v5e (benchmarks/RESULTS.md o200k
+    # row): the (B*T, C) @ (C, 200k) f32 logits matmul + softmax
+    # dominates the step at char-GPT scale. Needs tiktoken's cached BPE
+    # ranks (network once); this zero-egress image measures the
+    # giant-vocab cost via `--preset char-gpt --vocab-size 200064`.
+    "o200k-shakespeare": Config(
+        name="o200k-shakespeare",
+        model=ModelConfig(
+            vocab_size=200_064, block_size=256, n_layer=6, n_head=6,
+            n_embd=384, dropout=0.2, attn_dropout=0.2, tied_head=False,
+            activation="relu",
+        ),
+        train=TrainConfig(batch_size=64, lr=2e-4, max_iters=3000,
+                          eval_interval=200, eval_iters=200, seed=1337,
+                          sampling="random"),
+        tokenizer="tiktoken:o200k_base",
+    ),
     # Tiny config for tests / smoke runs.
     "test-tiny": Config(
         name="test-tiny",

@@ -63,6 +63,59 @@ def test_generate_from_checkpoint(ckdir, capsys):
     assert len(out.strip()) >= 40  # 40 chars sampled (char tokenizer)
 
 
+def test_export_torch_round_trip(ckdir, tmp_path, capsys):
+    """train -> export-torch -> torch.load into RefGPT: the state_dict
+    reproduces the checkpointed params exactly, and RefGPT's logits on a
+    real batch match the framework forward (the reference's artifact is
+    exactly this file, GPT1.py:239-241)."""
+    import jax
+    import numpy as np
+    import torch
+
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.models.gpt import forward
+    from replicatinggpt_tpu.reference_torch import RefGPT, torch_to_params
+    from replicatinggpt_tpu.train.checkpoint import CheckpointManager
+    from replicatinggpt_tpu.train.runner import _resolve_vocab
+    from replicatinggpt_tpu.train.state import create_train_state
+    from replicatinggpt_tpu.tokenizers import get_tokenizer
+
+    ck, _ = ckdir
+    out = str(tmp_path / "model.pth")
+    rc = main(["export-torch", "--preset", "test-tiny",
+               "--dataset", "datasets/shakespeare.txt",
+               "--checkpoint-dir", ck, "--out", out])
+    assert rc == 0
+    assert "exported" in capsys.readouterr().out
+
+    cfg = get_config("test-tiny")
+    text = open("datasets/shakespeare.txt").read()
+    cfg = _resolve_vocab(cfg, get_tokenizer(cfg.tokenizer,
+                                            corpus_text=text))
+    model = RefGPT(cfg.model)
+    model.load_state_dict(torch.load(out))
+    model.eval()
+
+    # the exported tensors ARE the checkpointed params (float32 copies)
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
+                               cfg.model, cfg.train)
+    state = CheckpointManager(ck).restore_latest(state)
+    back = torch_to_params(model)
+    np.testing.assert_array_equal(
+        back["wte"], np.asarray(state.params["wte"], np.float32))
+    np.testing.assert_array_equal(
+        back["blocks"]["qkv_kernel"],
+        np.asarray(state.params["blocks"]["qkv_kernel"], np.float32))
+
+    # and the torch model computes the same function
+    x = np.array([[1, 5, 9, 2, 0, 3, 7, 4]], np.int32)
+    jl, _ = forward(state.params, jax.numpy.asarray(x), cfg.model)
+    with torch.no_grad():
+        tl, _ = model(torch.from_numpy(x).long())
+    np.testing.assert_allclose(np.asarray(jl, np.float32), tl.numpy(),
+                               atol=1e-5, rtol=1e-5)
+
+
 def test_unknown_preset_rejected():
     with pytest.raises(SystemExit):
         main(["train", "--preset", "nope"])

@@ -4,24 +4,30 @@ Network-free: a randomly initialized local ``GPT2LMHeadModel`` (no download)
 provides the state_dict fixture, mirroring how the reference's notebook
 inspected HF weight names/shapes as its de-facto test (SURVEY.md §4 item 2).
 The decisive check is numerical: our forward on imported weights must match
-the HF model's logits."""
+the HF model's logits — proven live against ``transformers`` where it is
+installed, and HERMETICALLY against the committed synthetic golden fixture
+(tools/make_hf_fixture.py --synthetic) everywhere, torch or no torch."""
+
+import os
 
 import numpy as np
 import pytest
 
-torch = pytest.importorskip("torch")
-transformers = pytest.importorskip("transformers")
-
 import jax.numpy as jnp
 
+from replicatinggpt_tpu.config import ModelConfig
 from replicatinggpt_tpu.interop.hf import (GPT2_SIZES, config_for_model_type,
                                            import_hf_state_dict,
                                            model_config_from_hf)
 from replicatinggpt_tpu.models.gpt import forward
 
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
 
 @pytest.fixture(scope="module")
 def hf_model():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
     cfg = transformers.GPT2Config(
         vocab_size=97, n_positions=48, n_embd=64, n_layer=3, n_head=4,
         resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
@@ -52,6 +58,7 @@ def test_import_shapes(hf_model):
 
 def test_logits_parity_with_hf(hf_model):
     """Imported weights through our forward == HF forward (f32, CPU)."""
+    import torch
     mcfg = model_config_from_hf(hf_model.config)
     mcfg = mcfg.__class__(**{**mcfg.__dict__, "dtype": "float32"})
     params = import_hf_state_dict(hf_model.state_dict(), mcfg)
@@ -73,6 +80,29 @@ def test_untied_import_copies_head(hf_model):
     np.testing.assert_array_equal(params["lm_head"], params["wte"].T)
 
 
+def test_synthetic_golden_fixture_hermetic():
+    """The committed synthetic fixture (tools/make_hf_fixture.py
+    --synthetic) pins the HF-mapping numerics with NO torch/transformers
+    at test time: the npz carries a full HF-format state_dict (numpy)
+    plus the logits transformers computed from it once on this image.
+    import_hf_state_dict + our forward must reproduce them — the same
+    Conv1D-layout mapping the real from_pretrained path uses, re-proven
+    hermetically on every machine (VERDICT r4 item 5; the REAL-gpt2
+    fixture below still needs one networked run, which this zero-egress
+    image cannot perform)."""
+    fix_path = os.path.join(FIXTURES, "hf_synthetic_golden.npz")
+    fix = np.load(fix_path)
+    sd = {k[len("sd__"):]: fix[k] for k in fix.files
+          if k.startswith("sd__")}
+    mcfg = ModelConfig(vocab_size=97, block_size=48, n_layer=3, n_head=4,
+                       n_embd=64, dropout=0.0, attn_dropout=0.0,
+                       tied_head=True, activation="gelu", dtype="float32")
+    params = import_hf_state_dict(sd, mcfg)
+    got, _ = forward(params, jnp.asarray(fix["input_ids"], jnp.int32), mcfg)
+    np.testing.assert_allclose(np.asarray(got), fix["logits"], atol=2e-4,
+                               rtol=1e-4)
+
+
 def test_golden_fixture_real_gpt2():
     """Fixture-pinned import of the REAL HF gpt2 124M weights
     (VERDICT r2 item 7): tools/make_hf_fixture.py records (input ids,
@@ -81,10 +111,9 @@ def test_golden_fixture_real_gpt2():
     independent of transformers' model code. Skips until both the
     fixture and the cached weights exist (this dev image has neither —
     zero egress)."""
-    import os
-
-    fix_path = os.path.join(os.path.dirname(__file__), "fixtures",
-                            "hf_gpt2_golden.npz")
+    pytest.importorskip("torch")  # from_pretrained needs both
+    pytest.importorskip("transformers")
+    fix_path = os.path.join(FIXTURES, "hf_gpt2_golden.npz")
     if not os.path.exists(fix_path):
         pytest.skip("golden fixture not generated yet "
                     "(tools/make_hf_fixture.py needs network once)")

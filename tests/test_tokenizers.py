@@ -63,3 +63,32 @@ def test_get_tokenizer_specs(tmp_path, tiny_corpus):
     assert tok2.encode("hear me") == tok.encode("hear me")
     with pytest.raises(ValueError):
         get_tokenizer("nope", tiny_corpus)
+
+
+def test_o200k_preset_wiring():
+    """The o200k-shakespeare preset carries the reference GPT1.py default
+    tokenizer branch with the §8-B1 vocab bug FIXED: the configured vocab
+    (200,064 = 128*1563, MXU lane-padded) covers o200k_base's ~200k ids
+    instead of the reference's hard-coded 50257 (GPT1.py:29-36)."""
+    from replicatinggpt_tpu.config import get_config
+    cfg = get_config("o200k-shakespeare")
+    assert cfg.tokenizer == "tiktoken:o200k_base"
+    assert cfg.model.vocab_size == 200_064
+    assert cfg.model.vocab_size % 128 == 0
+    # char-GPT training hyperparams otherwise (the GPT1.py script)
+    assert cfg.model.block_size == 256 and cfg.train.lr == 2e-4
+
+
+def test_tiktoken_offline_error_is_actionable():
+    """Without cached BPE ranks or network, the tiktoken wrapper must
+    fail with the clear actionable error, not a raw urllib trace; where
+    ranks ARE cached it must report the true n_vocab (the §8-B1 fix)."""
+    pytest.importorskip("tiktoken")
+    try:
+        tok = get_tokenizer("tiktoken:o200k_base")
+    except RuntimeError as e:
+        assert "tiktoken" in str(e) and "bpe" in str(e).lower()
+    else:
+        assert tok.vocab_size > 200_000  # o200k's real id space
+        ids = tok.encode("hello world")
+        assert tok.decode(ids) == "hello world"

@@ -13,6 +13,10 @@ runs the framework forward on a fixed token sequence, and records
 (input ids, a logits slice, loss) so ``tests/test_hf_import.py``'s
 fixture test can re-verify the import mapping offline forever after —
 independent of ``transformers``' model code or randomness.
+
+With ``--synthetic`` it instead writes the network-free hermetic fixture
+(synthetic deterministic weights + transformers-computed logits) that
+``test_synthetic_golden_fixture_hermetic`` consumes with no torch at all.
 """
 from __future__ import annotations
 
@@ -23,11 +27,60 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def make_synthetic(out: str) -> None:
+    """Create the SYNTHETIC hermetic fixture (no network): a small
+    GPT2LMHeadModel with deterministic numpy-RNG weights, its HF-format
+    state_dict, input ids, and the logits transformers computes — all
+    recorded into one npz. ``tests/test_hf_import.py``'s hermetic test
+    then re-runs ``import_hf_state_dict`` + our forward against the
+    recorded logits with no torch/transformers dependency at test time,
+    pinning the Conv1D-layout mapping numerics forever. (The REAL-gpt2
+    fixture below still needs one networked run — this image has zero
+    egress — but the mapping itself is the same code path.)"""
+    import numpy as np
+    import torch
+    import transformers
+
+    cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=48, n_embd=64, n_layer=3, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    model = transformers.GPT2LMHeadModel(cfg)
+    model.eval()
+    rng = np.random.default_rng(20260731)
+    with torch.no_grad():
+        # named_parameters deduplicates the tied lm_head/wte pair, so
+        # each underlying tensor is assigned exactly once
+        for _, p in model.named_parameters():
+            p.copy_(torch.from_numpy(
+                (rng.standard_normal(tuple(p.shape)) * 0.05)
+                .astype(np.float32)))
+    sd = {k: v.detach().cpu().numpy()
+          for k, v in model.state_dict().items()}
+    ids = rng.integers(0, 97, (2, 32), dtype=np.int32)
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids).long()).logits.numpy()
+    np.savez_compressed(
+        out, input_ids=ids, logits=np.asarray(want, np.float32),
+        **{f"sd__{k}": v for k, v in sd.items()})
+    print(f"wrote {out}: {len(sd)} state_dict tensors, "
+          f"logits {want.shape}")
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gpt2")
     p.add_argument("--out", default="tests/fixtures/hf_gpt2_golden.npz")
+    p.add_argument("--synthetic", action="store_true",
+                   help="write the network-free synthetic fixture to "
+                        "tests/fixtures/hf_synthetic_golden.npz instead")
     args = p.parse_args()
+
+    if args.synthetic:
+        out = args.out
+        if out == "tests/fixtures/hf_gpt2_golden.npz":
+            out = "tests/fixtures/hf_synthetic_golden.npz"
+        make_synthetic(out)
+        return
 
     import jax
     import numpy as np
