@@ -223,13 +223,22 @@ def measure_generate_p50(mcfg, tcfg, steps: int = 4,
 
 def bench_decode_sweep(args) -> None:
     """Batched decode: aggregate tok/s vs batch size, one model/state
-    reused across the sweep (the RESULTS.md batched-decode table)."""
+    reused across the sweep (the RESULTS.md batched-decode table).
+    ``--decode-cache-layout`` overrides the KV-cache layout for the
+    hardware heads/packed A/B (tools/hw_validate.py
+    decode_sweep_packed)."""
+    import dataclasses
+
     import jax
 
     from replicatinggpt_tpu.config import get_config
     from replicatinggpt_tpu.train.state import create_train_state
 
     cfg = get_config(args.preset)
+    if args.decode_cache_layout:
+        cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+            cfg.model, decode_cache_layout=args.decode_cache_layout))
+        log(f"decode cache layout: {args.decode_cache_layout}")
     state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
     rows = {}
     laps = min(args.steps, 8)  # per-lap cost grows with B; 5-8 laps
@@ -582,6 +591,10 @@ def main() -> None:
     p.add_argument("--mode", default="train",
                    choices=["train", "generate", "longctx", "kernel",
                             "decode"])
+    p.add_argument("--decode-cache-layout", default="",
+                   choices=["", "heads", "packed"],
+                   help="--mode decode: KV-cache layout override "
+                        "(ModelConfig.decode_cache_layout)")
     p.add_argument("--decode-batch-sizes", default="1,8,32",
                    help="--mode decode: comma-separated batch sizes for "
                         "the aggregate-throughput sweep")

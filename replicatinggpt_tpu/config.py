@@ -59,6 +59,16 @@ class ModelConfig:
                                   # Measured at 350M B=8 on v5e-16G: 'full'
                                   # wins — see benchmarks/RESULTS.md
                                   # selective-remat table
+    decode_cache_layout: str = "heads"
+    # KV-cache memory layout for decode: 'heads' = (L, B, H, S, D) (the
+    # original layout), 'packed' = (L, B, S, C) with heads as static lane
+    # slices of the C row. At D=64 the TPU tiles a (S, D)-minor array to
+    # 128 lanes, so the heads layout physically streams ~2x the logical
+    # cache bytes per decode step — the packed layout stores fully-packed
+    # (S, C) rows and reads them through ops/decode_pallas.py's
+    # packed_decode_attention kernel (the packed-flash lane-slice trick
+    # applied to decode). 'heads' stays the default until the layout A/B
+    # validates on hardware (tools/hw_validate.py decode_sweep_packed).
     scan_layers: Optional[bool] = None
     # lax.scan over stacked layer params. None = auto: on TPU, unroll
     # shallow stacks (n_layer <= 16) — measured on v5e, unrolling the
@@ -332,6 +342,10 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
                    help="disable the preset's remat (e.g. 350M+ presets "
                         "default remat on for single-chip HBM; a pod-slice "
                         "FSDP run may not need it)")
+    p.add_argument("--decode-cache-layout", dest="decode_cache_layout",
+                   default=None, choices=["heads", "packed"],
+                   help="KV-cache memory layout for decode (see "
+                        "ModelConfig.decode_cache_layout)")
     p.add_argument("--remat-policy", dest="remat_policy", default=None,
                    choices=["full", "dots", "dots_no_batch"],
                    help="what jax.checkpoint saves per block: 'full' "
@@ -376,6 +390,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         ("n_embd", args.n_embd), ("dropout", args.dropout),
         ("dtype", args.dtype), ("attention_impl", args.attention_impl),
         ("remat", args.remat), ("remat_policy", args.remat_policy),
+        ("decode_cache_layout", getattr(args, "decode_cache_layout", None)),
     ) if v is not None}
     if args.dropout is not None:
         mk["attn_dropout"] = args.dropout

@@ -288,14 +288,21 @@ def forward(params: Params, idx: jnp.ndarray, cfg: ModelConfig, *,
 # KV-cache decode path (shared weights, single-position block body)
 # ---------------------------------------------------------------------------
 
-def _cached_qkv(h_in, lp, cfg: ModelConfig, cd):
-    """ln1 + fused QKV projection + head split — the cache-path front
-    half of a block, shared by decode_step and prefill (one source of
-    truth for the math that must produce identical K/V on both)."""
+def _cached_qkv_merged(h_in, lp, cfg: ModelConfig, cd):
+    """ln1 + fused QKV projection, heads still merged — the cache-path
+    front half of a block as (B, T, C) q/k/v rows (one source of truth
+    for the math that must produce identical K/V on decode and
+    prefill). The packed cache layout writes these rows untouched."""
     h = _layer_norm(h_in, lp["ln1_scale"], lp["ln1_bias"],
                     cfg.layernorm_eps)
     qkv = h @ lp["qkv_kernel"].astype(cd) + lp["qkv_bias"].astype(cd)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return jnp.split(qkv, 3, axis=-1)
+
+
+def _cached_qkv(h_in, lp, cfg: ModelConfig, cd):
+    """`_cached_qkv_merged` + head split — the (B, H, T, D) form the
+    einsum attention cores consume."""
+    q, k, v = _cached_qkv_merged(h_in, lp, cfg, cd)
     return tuple(_split_heads(t, cfg.n_head) for t in (q, k, v))
 
 
@@ -314,19 +321,35 @@ def _cached_block_tail(h_in, attn_merged, lp, cfg: ModelConfig, cd):
     return h_mid + h
 
 
+def cache_seq_axis(cfg: ModelConfig) -> int:
+    """Axis of the sequence dimension in the stacked KV cache — layout-
+    dependent (callers that grow/measure the cache buffer must not
+    hard-code it)."""
+    return 2 if cfg.decode_cache_layout == "packed" else 3
+
+
 def init_kv_cache(cfg: ModelConfig, batch: int, max_len: Optional[int] = None,
                   dtype=None) -> Dict[str, jnp.ndarray]:
-    """Cache layout: (L, B, H, S, D) stacked over layers for lax.scan."""
+    """Cache layout, stacked over layers for lax.scan:
+    (L, B, H, S, D) for ``decode_cache_layout='heads'``, or the fully
+    lane-packed (L, B, S, C) for ``'packed'`` (see the config field)."""
     S = max_len or cfg.block_size
     dt = dtype or _dtype(cfg.dtype)
-    shape = (cfg.n_layer, batch, cfg.n_head, S, cfg.head_dim)
+    if cfg.decode_cache_layout == "packed":
+        shape = (cfg.n_layer, batch, S, cfg.n_embd)
+    else:
+        shape = (cfg.n_layer, batch, cfg.n_head, S, cfg.head_dim)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
 def _fused_decode_backend_ok() -> bool:
     """Pallas lowering gate for the fused decode kernel (tests
-    monkeypatch this to exercise the interpret-mode kernel on CPU)."""
-    return jax.default_backend() == "tpu"
+    monkeypatch this to exercise the interpret-mode kernel on CPU).
+    Single-device only: a bare pallas_call cannot be partitioned by
+    GSPMD, and sharded decode (shard_for_decode) runs B=1 streams too —
+    those must keep the XLA layer loop (same policy as
+    ops.decode_pallas._packed_attn_backend_ok)."""
+    return jax.default_backend() == "tpu" and jax.device_count() == 1
 
 
 def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
@@ -355,19 +378,23 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     x = params["wte"].astype(cd)[idx_t] + params["wpe"].astype(cd)[pos]
     x = x[:, None, :]  # (B, 1, C)
 
+    S_actual = cache["k"].shape[cache_seq_axis(cfg)]
     from ..ops.decode_pallas import fused_decode_layers, fused_decode_supported
     # the envelope gates on the CACHE actually handed in (its length and
     # dtype may differ from cfg.block_size / the compute dtype via
     # init_kv_cache's max_len/dtype overrides)
-    use_fused = (_fused_decode_backend_ok()
+    use_fused = (cfg.decode_cache_layout == "heads"
+                 and _fused_decode_backend_ok()
                  and cache["k"].dtype == cd
                  and fused_decode_supported(
-                     cfg, B, jnp.dtype(cd).itemsize,
-                     seq_len=cache["k"].shape[3]))
+                     cfg, B, jnp.dtype(cd).itemsize, seq_len=S_actual))
     if use_fused:
         x_row, cache = fused_decode_layers(x[:, 0, :], params["blocks"],
                                            pos, cache, cfg)
         return _decode_head(x_row[:, None, :], params, cfg, cd), cache
+
+    if cfg.decode_cache_layout == "packed":
+        return _decode_step_packed(params, x, pos, cache, cfg, cd)
 
     def body(carry, inputs):
         # Caches ride the carry as the full stacked (L, B, H, S, D)
@@ -412,6 +439,76 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     return _decode_head(x, params, cfg, cd), {"k": new_k, "v": new_v}
 
 
+def _decode_step_packed(params: Params, x, pos, cache, cfg: ModelConfig,
+                        cd) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """decode_step body for the (L, B, S, C) packed cache layout.
+
+    The fresh K/V rows are written as (B, 1, C) rows — no head split, no
+    D-minor tile padding in the carried buffer. Attention reads the
+    layer's (B, S, C) slice through the packed decode kernel
+    (ops/decode_pallas.py: per-head static lane slices of fully-packed
+    rows) on TPU, or the reshape->einsum fallback elsewhere; both attend
+    the stale cache masked to positions < pos plus the fresh column,
+    which is bit-equivalent to write-then-attend (cache[pos] would hold
+    exactly the fresh k/v)."""
+    from ..ops.decode_pallas import (_packed_attn_backend_ok,
+                                     packed_decode_attention,
+                                     packed_decode_supported)
+    H = cfg.n_head
+    S = cache["k"].shape[2]
+    use_kernel = (_packed_attn_backend_ok()
+                  and packed_decode_supported(
+                      cfg, jnp.dtype(cache["k"].dtype).itemsize, seq_len=S))
+
+    def body(carry, inputs):
+        h_in, ck, cv = carry
+        lp, layer_idx = inputs
+        q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)  # (B, 1, C)
+        if use_kernel:
+            # kernel attends the STALE cache + fresh column, so the
+            # write can land after (bit-equivalent final cache)
+            k_cache = jax.lax.dynamic_index_in_dim(ck, layer_idx, 0,
+                                                   keepdims=False)
+            v_cache = jax.lax.dynamic_index_in_dim(cv, layer_idx, 0,
+                                                   keepdims=False)
+            attn_merged = packed_decode_attention(
+                q_m[:, 0, :], k_m[:, 0, :], v_m[:, 0, :],
+                k_cache, v_cache, pos, n_head=H)[:, None, :]
+            write_first = False
+        else:
+            write_first = True
+        zero = jnp.int32(0)
+        start = (layer_idx, zero, pos, zero)
+        ck = jax.lax.dynamic_update_slice(ck, k_m.astype(ck.dtype)[None],
+                                          start)
+        cv = jax.lax.dynamic_update_slice(cv, v_m.astype(cv.dtype)[None],
+                                          start)
+        if write_first:
+            k_cache = jax.lax.dynamic_index_in_dim(ck, layer_idx, 0,
+                                                   keepdims=False)
+            v_cache = jax.lax.dynamic_index_in_dim(cv, layer_idx, 0,
+                                                   keepdims=False)
+            attn = cached_attention(_split_heads(q_m, H),
+                                    _split_heads(k_cache, H),
+                                    _split_heads(v_cache, H), pos)
+            attn_merged = _merge_heads(attn)
+        return (_cached_block_tail(h_in, attn_merged, lp, cfg, cd),
+                ck, cv), None
+
+    if cfg.use_layer_scan:
+        layer_ids = jnp.arange(cfg.n_layer)
+        (x, new_k, new_v), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], layer_ids))
+    else:
+        carry = (x, cache["k"], cache["v"])
+        for i in range(cfg.n_layer):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            carry, _ = body(carry, (lp, i))
+        x, new_k, new_v = carry
+    return _decode_head(x, params, cfg, cd), {"k": new_k, "v": new_v}
+
+
 def _decode_head(x, params: Params, cfg: ModelConfig, cd) -> jnp.ndarray:
     """Final layernorm + (tied/untied) head over a (B, 1, C) decode
     state — one source of truth for the fused and XLA decode tails."""
@@ -442,16 +539,27 @@ def prefill(params: Params, idx: jnp.ndarray,
     B, P = idx.shape
     x = params["wte"].astype(cd)[idx] + params["wpe"].astype(cd)[:P]
 
+    packed = cfg.decode_cache_layout == "packed"
+
     def body(carry, inputs):
         h_in, ck, cv = carry
         lp, layer_idx = inputs
-        q, k, v = _cached_qkv(h_in, lp, cfg, cd)
+        q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)
+        q, k, v = (_split_heads(t, cfg.n_head) for t in (q_m, k_m, v_m))
         zero = jnp.int32(0)
-        start = (layer_idx, zero, zero, zero, zero)
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype)[None],
-                                          start)
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype)[None],
-                                          start)
+        if packed:
+            # merged (B, P, C) rows straight into the lane-packed cache
+            start = (layer_idx, zero, zero, zero)
+            ck = jax.lax.dynamic_update_slice(
+                ck, k_m.astype(ck.dtype)[None], start)
+            cv = jax.lax.dynamic_update_slice(
+                cv, v_m.astype(cv.dtype)[None], start)
+        else:
+            start = (layer_idx, zero, zero, zero, zero)
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype)[None],
+                                              start)
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype)[None],
+                                              start)
         # einsum core on purpose: this runs inside the jitted decode
         # segment, which sharded decodes partition with GSPMD
         # (shard_for_decode) — a bare pallas_call cannot partition

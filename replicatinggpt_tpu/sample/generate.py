@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..models.gpt import decode_step, init_kv_cache, prefill
+from ..models.gpt import cache_seq_axis, decode_step, init_kv_cache, prefill
 
 
 @dataclass(frozen=True)
@@ -212,12 +212,14 @@ def _segment_core(params, prompt: jnp.ndarray, prompt_len, n_new: int,
     carry = (first, cache, rng)
     parts = []
     i = 0
+    seq_ax = cache_seq_axis(cfg)  # layout-dependent (packed vs heads)
     for n_c, a_len in chunks:
         tok, cache, crng = carry
-        if cache["k"].shape[3] < a_len:
-            grow = a_len - cache["k"].shape[3]
-            cache = {key: jnp.pad(val, ((0, 0),) * 3 + ((0, grow), (0, 0)))
-                     for key, val in cache.items()}
+        if cache["k"].shape[seq_ax] < a_len:
+            grow = a_len - cache["k"].shape[seq_ax]
+            pad = [(0, 0)] * cache["k"].ndim
+            pad[seq_ax] = (0, grow)
+            cache = {key: jnp.pad(val, pad) for key, val in cache.items()}
         carry, toks_c = jax.lax.scan(body, (tok, cache, crng),
                                      jnp.arange(i, i + n_c))
         parts.append(toks_c)

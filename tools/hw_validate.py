@@ -24,6 +24,8 @@ Phases:
   ab_decode_char  same with --preset char-gpt
   decode_sweep    bench.py --mode decode --preset gpt2-small (the
               RESULTS.md table protocol, post-chunking)
+  decode_sweep_packed  same sweep with --decode-cache-layout packed
+              (the (L,B,S,C) lane-packed cache A/B, round-5)
 
 Each phase runs in a fresh subprocess so a hang cannot poison the
 orchestrator; the TPU is used by at most one phase at a time.
@@ -109,6 +111,13 @@ PHASES = [
     ("decode_sweep", [sys.executable, "bench.py", "--mode", "decode",
                       "--preset", "gpt2-small", "--steps", "5",
                       "--watchdog", "1800", *_BENCH_GUARD], 2400),
+    # packed KV-cache layout A/B (round-5): same sweep with the
+    # (L, B, S, C) lane-packed cache + the per-layer packed decode
+    # kernel; compare against decode_sweep's heads-layout rows
+    ("decode_sweep_packed", [sys.executable, "bench.py", "--mode", "decode",
+                             "--preset", "gpt2-small", "--steps", "5",
+                             "--decode-cache-layout", "packed",
+                             "--watchdog", "1800", *_BENCH_GUARD], 2400),
 ]
 
 
