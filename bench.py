@@ -460,6 +460,10 @@ def bench_train(args) -> None:
 
     cfg = get_config(args.preset)
     mcfg, tcfg = cfg.model, cfg.train
+    if args.loss_chunk is not None:
+        import dataclasses
+        mcfg = dataclasses.replace(mcfg, loss_chunk=args.loss_chunk)
+        log(f"loss_chunk: {args.loss_chunk}")
     B, T = args.batch_size, mcfg.block_size
     dev = jax.devices()[0]
     log(f"benchmark device: {dev.platform} ({dev.device_kind}), "
@@ -591,6 +595,9 @@ def main() -> None:
     p.add_argument("--mode", default="train",
                    choices=["train", "generate", "longctx", "kernel",
                             "decode"])
+    p.add_argument("--loss-chunk", type=int, default=None,
+                   help="train modes: chunked CE head override "
+                        "(ModelConfig.loss_chunk; 0 = one-shot logits)")
     p.add_argument("--decode-cache-layout", default="",
                    choices=["", "heads", "packed"],
                    help="--mode decode: KV-cache layout override "

@@ -59,6 +59,14 @@ class ModelConfig:
                                   # Measured at 350M B=8 on v5e-16G: 'full'
                                   # wins — see benchmarks/RESULTS.md
                                   # selective-remat table
+    loss_chunk: int = 0
+    # Rows of the flattened (B*T, V) logits computed per lax.scan step in
+    # the training loss head; 0 = the plain one-shot head. Non-zero never
+    # materializes the full f32 logits array (models.gpt._chunked_ce_loss)
+    # — at GPT-2 vocab that array is the step's largest HBM tenant. With
+    # loss_chunk on, forward(targets=...) returns (None, loss): callers
+    # that need logits keep the default. Opt-in until the hardware A/B
+    # (tools/hw_validate.py ce_chunk_off/ce_chunk_on) sizes the win.
     decode_cache_layout: str = "heads"
     # KV-cache memory layout for decode: 'heads' = (L, B, H, S, D) (the
     # original layout), 'packed' = (L, B, S, C) with heads as static lane
@@ -342,6 +350,9 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
                    help="disable the preset's remat (e.g. 350M+ presets "
                         "default remat on for single-chip HBM; a pod-slice "
                         "FSDP run may not need it)")
+    p.add_argument("--loss-chunk", dest="loss_chunk", type=int, default=None,
+                   help="chunked training CE head: rows per scan step "
+                        "(0 = one-shot logits; see ModelConfig.loss_chunk)")
     p.add_argument("--decode-cache-layout", dest="decode_cache_layout",
                    default=None, choices=["heads", "packed"],
                    help="KV-cache memory layout for decode (see "
@@ -391,6 +402,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         ("dtype", args.dtype), ("attention_impl", args.attention_impl),
         ("remat", args.remat), ("remat_policy", args.remat_policy),
         ("decode_cache_layout", getattr(args, "decode_cache_layout", None)),
+        ("loss_chunk", getattr(args, "loss_chunk", None)),
     ) if v is not None}
     if args.dropout is not None:
         mk["attn_dropout"] = args.dropout

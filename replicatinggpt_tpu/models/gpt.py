@@ -256,7 +256,9 @@ def forward(params: Params, idx: jnp.ndarray, cfg: ModelConfig, *,
     Always returns ``(logits, loss)``; loss is None without targets — the
     reference's asymmetric return (GPT-2.py:124-128) is normalized away.
     Cross-entropy is computed in float32 over flattened (B*T) positions
-    (GPT1.py:186-192 semantics). ``blocks_fn`` replaces the whole block
+    (GPT1.py:186-192 semantics). Exception: with ``cfg.loss_chunk`` set
+    and targets given, the chunked CE head returns ``(None, loss)`` —
+    the full logits array is exactly what that mode avoids building. ``blocks_fn`` replaces the whole block
     stack (the pipeline-parallel schedule plugs in here); ``attention_fn``
     replaces just the attention core inside the default stack.
     """
@@ -275,6 +277,15 @@ def forward(params: Params, idx: jnp.ndarray, cfg: ModelConfig, *,
                     cfg.layernorm_eps)
     head = (params["wte"].astype(cd).T if cfg.tied_head
             else params["lm_head"].astype(cd))
+    if targets is not None and cfg.loss_chunk:
+        if (B * T) % cfg.loss_chunk != 0:
+            # a silent fallback here would let an A/B arm measure the
+            # one-shot head while claiming the chunked one (and forfeit
+            # the HBM saving a config was chosen for) — fail loudly
+            raise ValueError(
+                f"loss_chunk={cfg.loss_chunk} must divide B*T="
+                f"{B * T}; pick a divisor or set loss_chunk=0")
+        return None, _chunked_ce_loss(x, head, targets, cfg.loss_chunk)
     logits = (x @ head).astype(jnp.float32)
     if targets is None:
         return logits, None
@@ -282,6 +293,37 @@ def forward(params: Params, idx: jnp.ndarray, cfg: ModelConfig, *,
     loss = optax.softmax_cross_entropy_with_integer_labels(
         logits.reshape(B * T, -1), targets.reshape(B * T)).mean()
     return logits, loss
+
+
+def _chunked_ce_loss(x, head, targets, chunk: int) -> jnp.ndarray:
+    """Cross-entropy without materializing the full (B*T, V) f32 logits:
+    a lax.scan over ``chunk``-row slices computes each chunk's logits +
+    per-row CE and accumulates the sum; the chunk body is jax.checkpoint
+    so the backward recomputes chunk logits instead of storing them as
+    scan residuals (full-logits storage is exactly what this avoids).
+    Per-row math is identical to the unchunked head — rows are
+    independent under softmax-CE — so only the final mean's reduction
+    order differs (f32 sum). At GPT-2 vocab (V=50304, B=32, T=1024) the
+    unchunked head round-trips a ~6.6 GB f32 logits array through HBM
+    for loss + backward; chunked, the working set is chunk*V bytes.
+    Trades one extra head matmul in the backward (~+10% model FLOPs at
+    124M) for that traffic — measure before defaulting
+    (cfg.loss_chunk=0 keeps the unchunked head)."""
+    import optax
+    N = x.shape[0] * x.shape[1]
+    C = x.shape[-1]
+    xf = x.reshape(N // chunk, chunk, C)
+    tf = targets.reshape(N // chunk, chunk)
+
+    @jax.checkpoint
+    def body(acc, xs):
+        xc, tc = xs
+        lg = (xc @ head).astype(jnp.float32)
+        return acc + optax.softmax_cross_entropy_with_integer_labels(
+            lg, tc).sum(), None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xf, tf))
+    return acc / N
 
 
 # ---------------------------------------------------------------------------
@@ -344,16 +386,23 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_len: Optional[int] = None,
 
 def _fused_decode_backend_ok() -> bool:
     """Pallas lowering gate for the fused decode kernel (tests
-    monkeypatch this to exercise the interpret-mode kernel on CPU).
-    Single-device only: a bare pallas_call cannot be partitioned by
-    GSPMD, and sharded decode (shard_for_decode) runs B=1 streams too —
-    those must keep the XLA layer loop (same policy as
-    ops.decode_pallas._packed_attn_backend_ok)."""
-    return jax.default_backend() == "tpu" and jax.device_count() == 1
+    monkeypatch this to exercise the interpret-mode kernel on CPU)."""
+    return jax.default_backend() == "tpu"
+
+
+def _default_allow_pallas() -> bool:
+    """Conservative default for direct decode_step callers: a bare
+    pallas_call cannot be partitioned by GSPMD, so the decode kernels
+    are only safe when the program cannot be mesh-sharded. generate()
+    passes the precise answer (it inspects the real params' shardings
+    eagerly); direct callers on a multi-device process that KNOW their
+    inputs are single-device can pass allow_pallas=True."""
+    return jax.device_count() == 1
 
 
 def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
-                cache: Dict[str, jnp.ndarray], cfg: ModelConfig
+                cache: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
+                allow_pallas: Optional[bool] = None
                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """One autoregressive step. idx_t: (B,) int32 current tokens; pos: scalar
     int32 position. Returns (logits (B, V) float32, updated cache).
@@ -378,12 +427,15 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     x = params["wte"].astype(cd)[idx_t] + params["wpe"].astype(cd)[pos]
     x = x[:, None, :]  # (B, 1, C)
 
+    if allow_pallas is None:
+        allow_pallas = _default_allow_pallas()
     S_actual = cache["k"].shape[cache_seq_axis(cfg)]
     from ..ops.decode_pallas import fused_decode_layers, fused_decode_supported
     # the envelope gates on the CACHE actually handed in (its length and
     # dtype may differ from cfg.block_size / the compute dtype via
     # init_kv_cache's max_len/dtype overrides)
-    use_fused = (cfg.decode_cache_layout == "heads"
+    use_fused = (allow_pallas
+                 and cfg.decode_cache_layout == "heads"
                  and _fused_decode_backend_ok()
                  and cache["k"].dtype == cd
                  and fused_decode_supported(
@@ -394,7 +446,8 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
         return _decode_head(x_row[:, None, :], params, cfg, cd), cache
 
     if cfg.decode_cache_layout == "packed":
-        return _decode_step_packed(params, x, pos, cache, cfg, cd)
+        return _decode_step_packed(params, x, pos, cache, cfg, cd,
+                                   allow_pallas)
 
     def body(carry, inputs):
         # Caches ride the carry as the full stacked (L, B, H, S, D)
@@ -440,7 +493,8 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
 
 
 def _decode_step_packed(params: Params, x, pos, cache, cfg: ModelConfig,
-                        cd) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+                        cd, allow_pallas: bool
+                        ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """decode_step body for the (L, B, S, C) packed cache layout.
 
     The fresh K/V rows are written as (B, 1, C) rows — no head split, no
@@ -456,7 +510,12 @@ def _decode_step_packed(params: Params, x, pos, cache, cfg: ModelConfig,
                                      packed_decode_supported)
     H = cfg.n_head
     S = cache["k"].shape[2]
-    use_kernel = (_packed_attn_backend_ok()
+    # same cache-dtype gate as the fused path: the kernel attends the
+    # fresh column at compute precision, so write-then-attend
+    # bit-equivalence needs the stored value to round-trip losslessly
+    use_kernel = (allow_pallas
+                  and _packed_attn_backend_ok()
+                  and cache["k"].dtype == cd
                   and packed_decode_supported(
                       cfg, jnp.dtype(cache["k"].dtype).itemsize, seq_len=S))
 

@@ -78,14 +78,9 @@ PACKED_DECODE_BYTES = 8 * 1024 * 1024
 def _packed_attn_backend_ok() -> bool:
     """Pallas lowering gate for the packed decode-attention kernel
     (tests monkeypatch this to exercise the interpret-mode kernel on
-    CPU). Single-device only: a bare pallas_call cannot be partitioned
-    by GSPMD (parallel/__init__ policy), so sharded decode
-    (shard_for_decode on a multi-chip mesh) must keep the einsum
-    fallback — device topology is fixed per process, so a trace-time
-    check is sound."""
-    import jax as _jax
-    return (_jax.default_backend() == "tpu"
-            and _jax.device_count() == 1)
+    CPU). Sharding safety (a bare pallas_call cannot be partitioned by
+    GSPMD) is the caller's allow_pallas gate — models.gpt.decode_step."""
+    return jax.default_backend() == "tpu"
 
 
 def packed_decode_supported(cfg, itemsize: int = 2,

@@ -174,9 +174,23 @@ def _decode_chunks(P_pad: int, n_new: int, S: int, g: int):
     return chunks
 
 
+def _all_single_device(tree) -> bool:
+    """True when every array leaf lives on one device (no NamedSharding
+    over a mesh) — evaluated EAGERLY on the real params, before jit, so
+    the decode kernels' GSPMD-safety gate gets a precise answer instead
+    of a process-topology guess (a bare pallas_call cannot be
+    partitioned; shard_for_decode outputs must keep the einsum path)."""
+    from jax.sharding import SingleDeviceSharding
+    for leaf in jax.tree_util.tree_leaves(tree):
+        s = getattr(leaf, "sharding", None)
+        if s is not None and not isinstance(s, SingleDeviceSharding):
+            return False
+    return True
+
+
 def _segment_core(params, prompt: jnp.ndarray, prompt_len, n_new: int,
-                  rng: jax.Array, cfg: ModelConfig, gcfg: GenerateConfig
-                  ) -> jnp.ndarray:
+                  rng: jax.Array, cfg: ModelConfig, gcfg: GenerateConfig,
+                  allow_pallas: bool = False) -> jnp.ndarray:
     """One prefill + decode scan: fill the KV cache for the whole padded
     prompt in ONE parallel forward (``models.gpt.prefill`` — the previous
     formulation teacher-forced the prompt through ``P_pad - 1``
@@ -204,7 +218,8 @@ def _segment_core(params, prompt: jnp.ndarray, prompt_len, n_new: int,
 
     def body(carry, i):
         tok, cache, rng = carry
-        logits, cache = decode_step(params, tok, start + i, cache, cfg)
+        logits, cache = decode_step(params, tok, start + i, cache, cfg,
+                                    allow_pallas=allow_pallas)
         rng, sub = jax.random.split(rng)
         next_tok = _sample_token(sub, logits, gcfg)
         return (next_tok, cache, rng), next_tok
@@ -229,19 +244,21 @@ def _segment_core(params, prompt: jnp.ndarray, prompt_len, n_new: int,
     return toks.T
 
 
-@partial(jax.jit, static_argnames=("n_new", "cfg", "gcfg"))
+@partial(jax.jit, static_argnames=("n_new", "cfg", "gcfg", "allow_pallas"))
 def _decode_segment(params, prompt: jnp.ndarray, prompt_len, n_new: int,
-                    rng: jax.Array, cfg: ModelConfig, gcfg: GenerateConfig
-                    ) -> jnp.ndarray:
+                    rng: jax.Array, cfg: ModelConfig, gcfg: GenerateConfig,
+                    allow_pallas: bool = False) -> jnp.ndarray:
     """Jitted ``_segment_core`` — compiled shapes are keyed on
-    (P_pad, n_new) buckets only; see ``generate`` for the bucketing."""
-    return _segment_core(params, prompt, prompt_len, n_new, rng, cfg, gcfg)
+    (P_pad, n_new) buckets only (plus the static allow_pallas kernel
+    gate); see ``generate`` for the bucketing."""
+    return _segment_core(params, prompt, prompt_len, n_new, rng, cfg, gcfg,
+                         allow_pallas)
 
 
-@partial(jax.jit, static_argnames=("n_seg", "cfg", "gcfg"))
+@partial(jax.jit, static_argnames=("n_seg", "cfg", "gcfg", "allow_pallas"))
 def _refresh_group(params, window: jnp.ndarray, n_seg: int, first_ord,
                    base_rng: jax.Array, cfg: ModelConfig,
-                   gcfg: GenerateConfig):
+                   gcfg: GenerateConfig, allow_pallas: bool = False):
     """``n_seg`` window-refresh segments in ONE dispatch: an on-device
     ``lax.scan`` whose body is a full segment (prefill the (B, S//2)
     window, sample S//2 + 1 tokens, slide the window). The host loop
@@ -260,7 +277,8 @@ def _refresh_group(params, window: jnp.ndarray, n_seg: int, first_ord,
 
     def seg(window, i):
         sub = jax.random.fold_in(base_rng, first_ord + i)
-        toks = _segment_core(params, window, Pw, n_mid, sub, cfg, gcfg)
+        toks = _segment_core(params, window, Pw, n_mid, sub, cfg, gcfg,
+                             allow_pallas)
         window = jnp.concatenate([window, toks], axis=1)[:, -Pw:]
         return window, toks
 
@@ -348,6 +366,10 @@ def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
     import dataclasses as _dc
     gcfg = _dc.replace(gcfg, max_new_tokens=0)
 
+    # decode kernels (fused / packed attention) only where GSPMD cannot
+    # shard the segment — decided on the REAL params, outside jit
+    allow_pallas = _all_single_device(params) and _all_single_device(prompt)
+
     # first segment: bucketed prompt pad + bucketed decode count
     P_pad = min(_pow2_at_least(P0), S)
     padded = (prompt if P_pad == P0 else jnp.pad(
@@ -355,7 +377,8 @@ def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
     room = S - P_pad + 1
     n1 = min(_pow2_at_least(remaining), room)
     rng, sub = jax.random.split(rng)
-    toks = _decode_segment(params, padded, P0, n1, sub, cfg, gcfg)
+    toks = _decode_segment(params, padded, P0, n1, sub, cfg, gcfg,
+                           allow_pallas)
     take = min(n1, remaining)
     chunks.append(toks[:, :take])
     remaining -= take
@@ -388,7 +411,7 @@ def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
                 if g <= k:
                     toks, window = _refresh_group(params, window, g,
                                                   jnp.int32(ordinal), base,
-                                                  cfg, gcfg)
+                                                  cfg, gcfg, allow_pallas)
                     take = min(g * n_mid, remaining)
                     chunks.append(toks[:, :take])
                     remaining -= take
@@ -399,7 +422,7 @@ def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
             while remaining > 0:
                 sub = jax.random.fold_in(base, ordinal)
                 toks = _decode_segment(params, window, Pw, n_mid, sub, cfg,
-                                       gcfg)
+                                       gcfg, allow_pallas)
                 take = min(n_mid, remaining)
                 chunks.append(toks[:, :take])
                 remaining -= take

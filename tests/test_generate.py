@@ -458,8 +458,11 @@ def test_fused_decode_step_matches_unfused(monkeypatch):
         cache = init_kv_cache(m, 1)
         outs = []
         for pos in range(toks.shape[0]):
+            # allow_pallas=True: the conftest's 8-device CPU mesh makes
+            # the direct-call default conservative-False
             logits, cache = decode_step(state.params, toks[pos:pos + 1],
-                                        jnp.int32(pos), cache, m)
+                                        jnp.int32(pos), cache, m,
+                                        allow_pallas=True)
             outs.append(logits)
         return jnp.stack(outs), cache
 

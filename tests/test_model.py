@@ -202,3 +202,82 @@ def test_remat_policy_numerics_and_validation():
 
     with _pytest.raises(ValueError, match="remat_policy"):
         jax.value_and_grad(loss_for("typo"))(params)
+
+
+def test_chunked_ce_loss_matches_one_shot():
+    """cfg.loss_chunk computes the same training loss AND gradients as
+    the one-shot logits head (per-row CE is independent under softmax;
+    only the final mean's f32 reduction order differs), returning
+    (None, loss) — the full logits array is never built."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from replicatinggpt_tpu.config import ModelConfig
+    from replicatinggpt_tpu.models.gpt import forward, init_params
+
+    cfg = ModelConfig(vocab_size=97, block_size=16, n_layer=2, n_head=2,
+                      n_embd=64, dropout=0.0, attn_dropout=0.0,
+                      dtype="float32")
+    ccfg = dataclasses.replace(cfg, loss_chunk=8)  # B*T=64 rows, 8 chunks
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.integers(0, 97, (4, 16)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, 97, (4, 16)), jnp.int32)
+
+    def loss(p, c):
+        lg, l = forward(p, x, c, targets=y)
+        if c.loss_chunk:
+            assert lg is None
+        else:
+            assert lg is not None
+        return l
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(p, cfg))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(p, ccfg))(params)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        g1, g0)
+
+    # non-divisible chunk must fail loudly: a silent fallback would let
+    # an A/B arm measure the one-shot head while claiming the chunked one
+    nd = dataclasses.replace(cfg, loss_chunk=7)
+    with pytest.raises(ValueError, match="loss_chunk"):
+        forward(params, x, nd, targets=y)
+
+
+def test_chunked_ce_through_train_step():
+    """One jitted train step with loss_chunk on: finite loss, params
+    move, loss matches the unchunked step's at the first step."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from replicatinggpt_tpu.config import ModelConfig, TrainConfig
+    from replicatinggpt_tpu.train.state import create_train_state
+    from replicatinggpt_tpu.train.steps import make_train_step
+
+    cfg = ModelConfig(vocab_size=97, block_size=16, n_layer=2, n_head=2,
+                      n_embd=64, dropout=0.0, attn_dropout=0.0,
+                      dtype="float32")
+    tcfg = TrainConfig(batch_size=4, lr=1e-3)
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.integers(0, 97, (4, 16)), jnp.int32)
+    batch = (x, jnp.asarray(rng.integers(0, 97, (4, 16)), jnp.int32))
+
+    losses = {}
+    for chunk in (0, 16):
+        c = dataclasses.replace(cfg, loss_chunk=chunk)
+        state = create_train_state(jax.random.PRNGKey(0), c, tcfg)
+        step = make_train_step(c, tcfg, donate=False)
+        new_state, metrics = step(state, batch)
+        l = float(jax.device_get(metrics["loss"]))
+        assert np.isfinite(l)
+        assert not np.allclose(np.asarray(new_state.params["wte"]),
+                               np.asarray(state.params["wte"]))
+        losses[chunk] = l
+    np.testing.assert_allclose(losses[16], losses[0], rtol=1e-6)

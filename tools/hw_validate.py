@@ -26,6 +26,9 @@ Phases:
               RESULTS.md table protocol, post-chunking)
   decode_sweep_packed  same sweep with --decode-cache-layout packed
               (the (L,B,S,C) lane-packed cache A/B, round-5)
+  ce_chunk_off/ce_chunk_on  124M train step with the one-shot vs the
+              chunked CE head (--loss-chunk 2048) — the giant-vocab
+              f32-logits-traffic A/B, round-5
 
 Each phase runs in a fresh subprocess so a hang cannot poison the
 orchestrator; the TPU is used by at most one phase at a time.
@@ -118,6 +121,17 @@ PHASES = [
                              "--preset", "gpt2-small", "--steps", "5",
                              "--decode-cache-layout", "packed",
                              "--watchdog", "1800", *_BENCH_GUARD], 2400),
+    # chunked-CE head A/B at the giant-vocab train shape (round-5):
+    # compare step_ms/mfu against the ce_chunk_off arm in the same queue
+    # drain (V=50304 is where the one-shot f32 logits array dominates)
+    ("ce_chunk_off", [sys.executable, "bench.py", "--preset", "gpt2-small",
+                      "--batch-size", "16", "--steps", "40", "--warmup",
+                      "20", "--skip-baseline", "--watchdog", "1200",
+                      *_BENCH_GUARD], 1800),
+    ("ce_chunk_on", [sys.executable, "bench.py", "--preset", "gpt2-small",
+                     "--batch-size", "16", "--steps", "40", "--warmup",
+                     "20", "--skip-baseline", "--loss-chunk", "2048",
+                     "--watchdog", "1200", *_BENCH_GUARD], 1800),
 ]
 
 
