@@ -301,6 +301,11 @@ PRESETS = {
             vocab_size=200_064, block_size=256, n_layer=6, n_head=6,
             n_embd=384, dropout=0.2, attn_dropout=0.2, tied_head=False,
             activation="relu",
+            # at V=200k the one-shot f32 logits array is B*T*V*4 =
+            # 13.1 GB — past a 16 GB chip once the backward doubles it;
+            # the chunked CE head makes this preset feasible at all
+            # (2048 divides B*T = 16384)
+            loss_chunk=2048,
         ),
         train=TrainConfig(batch_size=64, lr=2e-4, max_iters=3000,
                           eval_interval=200, eval_iters=200, seed=1337,

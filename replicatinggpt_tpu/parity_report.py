@@ -166,6 +166,12 @@ replicated (reference as committed crashes or diverges):
 - Q4 NANOGPT_SCALE_INIT tag ignored -> residual init std/sqrt(2L) real.
 - generate() beyond block_size: per-token window crop (uncacheable) ->
   half-window refresh (KV-cache compatible; documented in sample/).
+- B1's default tokenizer branch (o200k_base under a hard-coded 50257
+  vocab) -> preset `o200k-shakespeare`: vocab 200,064 covers the real
+  id space, chunked CE head keeps the giant-vocab logits off HBM.
+- The reference's model.pth epilogue (GPT1.py:239-241) -> the
+  `export-torch` subcommand writes the same torch state_dict artifact
+  from any framework checkpoint (round-trips through RefGPT).
 """
 
 
