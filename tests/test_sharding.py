@@ -485,3 +485,35 @@ def test_mesh_packed_qkv_hook_absent_with_tp():
     mesh = make_mesh(MeshConfig(data=4, seq=1, model=2))
     fn = make_sharded_flash_attention_fn(mesh)
     assert not hasattr(fn, "packed_qkv")
+
+
+def test_dp_training_with_chunked_ce_matches_single_device(tcfg):
+    """loss_chunk under 8-way DP: the chunked-CE reshape folds the
+    dp-sharded batch axis into the scan axis, and GSPMD must still
+    produce the single-device numbers (it may pay collectives — the
+    hardware A/B prices that; this pins correctness)."""
+    tcfg = dataclasses.replace(tcfg, lr=1e-3)
+    mcfg = dataclasses.replace(TINY, loss_chunk=32)  # B*T=256 -> 8 chunks
+    batch = _batch(mcfg, B=8)
+    state1 = _state_fn(mcfg, tcfg)()
+    step1 = make_train_step(mcfg, tcfg, donate=False)
+    losses1 = []
+    for _ in range(3):
+        state1, m = step1(state1, batch)
+        losses1.append(float(m["loss"]))
+    # unchunked single-device oracle: same numbers (order-of-sum only)
+    state0 = _state_fn(TINY, tcfg)()
+    step0 = make_train_step(TINY, tcfg, donate=False)
+    _, m0 = step0(state0, batch)
+    np.testing.assert_allclose(losses1[0], float(m0["loss"]), rtol=1e-5)
+    mesh = make_mesh(MeshConfig(data=8))
+    state8 = shard_train_state(_state_fn(mcfg, tcfg), mesh,
+                               MeshConfig(data=8))
+    bs = make_batch_sharding(mesh)
+    batch8 = tuple(jax.device_put(np.asarray(b), bs) for b in batch)
+    step8 = make_train_step(mcfg, tcfg, donate=False)
+    losses8 = []
+    for _ in range(3):
+        state8, m = step8(state8, batch8)
+        losses8.append(float(m["loss"]))
+    np.testing.assert_allclose(losses1, losses8, rtol=2e-4)

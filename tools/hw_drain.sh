@@ -1,0 +1,25 @@
+#!/bin/bash
+# Drain the queued hardware work after tunnel recovery, in VERDICT r4
+# priority order: (1) tools/hw_validate.py (13 phases incl. the
+# group_stream compile/parity gates, decode layout + CE-chunk A/Bs,
+# o200k vocab run), (2) driver-default bench.py, (3) the gpt2-large
+# 774M 500-step single-chip training run. One TPU process at a time;
+# graceful signals only (SIGKILL mid-dispatch wedges the tunnel).
+set -u
+cd /root/repo
+LOG=benchmarks/hw_drain.log
+echo "=== drain start $(date -u +%FT%TZ)" >> "$LOG"
+python tools/hw_validate.py >> "$LOG" 2>&1
+echo "=== hw_validate rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+timeout -s INT --kill-after=60 2400 python bench.py \
+  > benchmarks/BENCH_r05_builder.json 2>> "$LOG"
+echo "=== bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+mkdir -p benchmarks/converged_gpt2
+timeout -s INT --kill-after=60 5400 python -m replicatinggpt_tpu train \
+  --preset gpt2-large --dataset datasets/shakespeare.txt \
+  --batch-size 8 --max-iters 500 --eval-interval 0 --eval-iters 20 \
+  --log-interval 20 \
+  --log-jsonl benchmarks/converged_gpt2/gpt2_large_500.jsonl \
+  >> "$LOG" 2>&1
+echo "=== gpt2-large rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+echo "=== drain done $(date -u +%FT%TZ)" >> "$LOG"
