@@ -434,8 +434,10 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     # the envelope gates on the CACHE actually handed in (its length and
     # dtype may differ from cfg.block_size / the compute dtype via
     # init_kv_cache's max_len/dtype overrides)
+    # the fused all-layers kernel handles BOTH cache layouts (heads
+    # blocks or packed lane-sliced rows), so B=1 keeps its one-launch
+    # path if the packed layout becomes the default
     use_fused = (allow_pallas
-                 and cfg.decode_cache_layout == "heads"
                  and _fused_decode_backend_ok()
                  and cache["k"].dtype == cd
                  and fused_decode_supported(

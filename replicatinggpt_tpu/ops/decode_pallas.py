@@ -194,7 +194,7 @@ def _decode_kernel(pos_ref, x0_ref, ln1s_ref, ln1b_ref, wqkv_ref, bqkv_ref,
                    wproj_ref, bproj_ref, ln2s_ref, ln2b_ref, wup_ref,
                    bup_ref, wdown_ref, bdown_ref, kc_ref, vc_ref,
                    xout_ref, newk_ref, newv_ref, x_ref, *, n_layer, n_head,
-                   head_dim, seq_len, eps, scale, activation):
+                   head_dim, seq_len, eps, scale, activation, packed_cache):
     l = pl.program_id(0)
     H, D, S = n_head, head_dim, seq_len
     C = H * D
@@ -216,8 +216,14 @@ def _decode_kernel(pos_ref, x0_ref, ln1s_ref, ln1b_ref, wqkv_ref, bqkv_ref,
         v_new = qkv[:, 2 * C + i * D:2 * C + (i + 1) * D]
         newk_ref[:, i * D:(i + 1) * D] = k_new
         newv_ref[:, i * D:(i + 1) * D] = v_new
-        kc = kc_ref[i]                                          # (S, D)
-        vc = vc_ref[i]
+        if packed_cache:
+            # lane slice of the (S, C) packed row — same trick as
+            # packed_decode_attention below; fully-packed cache stream
+            kc = kc_ref[:, i * D:(i + 1) * D]                   # (S, D)
+            vc = vc_ref[:, i * D:(i + 1) * D]
+        else:
+            kc = kc_ref[i]                                      # (S, D)
+            vc = vc_ref[i]
         # scores vs the stale cache, masked to positions < pos; the
         # fresh position's score rides a separate column (write-then-
         # attend equivalence: cache[pos] would hold exactly k_new)
@@ -254,20 +260,32 @@ def fused_decode_layers(x0: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
     call. x0: (1, C) embedded input row (compute dtype); blocks: the
     layer-stacked param dict (weights will be cast to x0.dtype —
     hoisted out of the token scan by XLA exactly like the unfused
-    path's per-use casts); cache: {"k","v"} (L, 1, H, S, D). Returns
-    (x_out (1, C), updated cache)."""
-    L, _, H, S, D = cache["k"].shape
-    C = H * D
+    path's per-use casts); cache: {"k","v"} — (L, 1, H, S, D) heads
+    layout or (L, 1, S, C) packed layout, per
+    ``cfg.decode_cache_layout``. Returns (x_out (1, C), updated
+    cache)."""
+    packed = cfg.decode_cache_layout == "packed"
+    if packed:
+        L, _, S, C = cache["k"].shape
+        H = cfg.n_head
+        D = C // H
+    else:
+        L, _, H, S, D = cache["k"].shape
+        C = H * D
     cd = x0.dtype
     w = {k: v.astype(cd) for k, v in blocks.items()}
     # (L, width) row vectors -> (L, 1, width) so in-kernel refs are 2-d
     vec = lambda name: w[name].reshape(L, 1, -1)
     kernel = functools.partial(
         _decode_kernel, n_layer=L, n_head=H, head_dim=D, seq_len=S,
-        eps=cfg.layernorm_eps, scale=D ** -0.5, activation=cfg.activation)
+        eps=cfg.layernorm_eps, scale=D ** -0.5, activation=cfg.activation,
+        packed_cache=packed)
     row = lambda width: _vmem_spec((None, 1, width), lambda l: (l, 0, 0))
     mat = lambda a, b: _vmem_spec((None, a, b), lambda l: (l, 0, 0))
-    cache_spec = _vmem_spec((None, None, H, S, D), lambda l: (l, 0, 0, 0, 0))
+    cache_spec = (_vmem_spec((None, None, S, C), lambda l: (l, 0, 0, 0))
+                  if packed else
+                  _vmem_spec((None, None, H, S, D),
+                             lambda l: (l, 0, 0, 0, 0)))
     kw = {}
     cp = _compiler_params(0, 1)
     if cp is not None:
@@ -305,12 +323,16 @@ def fused_decode_layers(x0: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
     # dynamic_update_slice per array for all layers
     zero = jnp.int32(0)
     p = jnp.asarray(pos, jnp.int32)
-    newk5 = newk.reshape(L, 1, H, 1, D)
-    newv5 = newv.reshape(L, 1, H, 1, D)
+    if packed:
+        newk_u = newk.reshape(L, 1, 1, C)
+        newv_u = newv.reshape(L, 1, 1, C)
+        start = (zero, zero, p, zero)
+    else:
+        newk_u = newk.reshape(L, 1, H, 1, D)
+        newv_u = newv.reshape(L, 1, H, 1, D)
+        start = (zero, zero, zero, p, zero)
     ck = jax.lax.dynamic_update_slice(
-        cache["k"], newk5.astype(cache["k"].dtype), (zero, zero, zero, p,
-                                                     zero))
+        cache["k"], newk_u.astype(cache["k"].dtype), start)
     cv = jax.lax.dynamic_update_slice(
-        cache["v"], newv5.astype(cache["v"].dtype), (zero, zero, zero, p,
-                                                     zero))
+        cache["v"], newv_u.astype(cache["v"].dtype), start)
     return xout, {"k": ck, "v": cv}
