@@ -221,6 +221,34 @@ def measure_generate_p50(mcfg, tcfg, steps: int = 4,
             "batch_size": batch_size}
 
 
+# HBM bandwidth by device_kind substring, bytes/sec — for the decode
+# roofline columns (benchmarks/RESULTS.md decode table convention).
+_HBM_BW = {"v5 lite": 819e9, "v5e": 819e9, "v4": 1228e9,
+           "v5p": 2765e9, "v6": 1640e9}
+
+
+def _decode_byte_floor_us(mcfg, batch: int, device_kind: str,
+                          n_params: int):
+    """Ideal µs/token for the 1k-token decode workload: every model
+    parameter (bf16, the per-segment cast copies XLA hoists out of the
+    token scan) plus the LOGICAL valid-prefix KV bytes per step, over
+    the device's HBM bandwidth. Logical bytes on purpose: the ratio
+    then exposes layout padding (the heads layout's D-minor tile pad)
+    as excess, matching the RESULTS.md roofline convention. None when
+    the device's bandwidth is unknown (e.g. CPU)."""
+    bw = next((v for k, v in _HBM_BW.items()
+               if k in (device_kind or "").lower()), None)
+    if bw is None:
+        return None
+    weight_bytes = n_params * 2
+    # avg valid-prefix cache read per step over 1k tokens (window refresh
+    # caps pos at block_size; itemsize 2 = bf16 cache)
+    S = mcfg.block_size
+    avg_pos = sum(min(t, S) for t in range(1, 1001)) / 1000
+    kv_bytes = 2 * mcfg.n_layer * batch * avg_pos * mcfg.n_embd * 2
+    return (weight_bytes + kv_bytes) / bw * 1e6
+
+
 def bench_decode_sweep(args) -> None:
     """Batched decode: aggregate tok/s vs batch size, one model/state
     reused across the sweep (the RESULTS.md batched-decode table).
@@ -242,9 +270,18 @@ def bench_decode_sweep(args) -> None:
     state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
     rows = {}
     laps = min(args.steps, 8)  # per-lap cost grows with B; 5-8 laps
+    dev = jax.devices()[0]
+    from replicatinggpt_tpu.models.gpt import param_count
+    n_params = param_count(state.params)
     for B in (int(b) for b in args.decode_batch_sizes.split(",")):
         r = measure_generate_p50(cfg.model, cfg.train, steps=laps,
                                  batch_size=B, state=state)
+        floor = _decode_byte_floor_us(cfg.model, B, dev.device_kind,
+                                      n_params)
+        if floor is not None:
+            r["byte_floor_us_per_tok"] = round(floor, 1)
+            r["x_floor"] = round(
+                r["generate_1k_p50_s"] * 1e6 / 1000 / floor, 2)
         rows[f"B{B}"] = r
     last = rows[sorted(rows, key=lambda k: int(k[1:]))[-1]]
     emit({
