@@ -221,10 +221,32 @@ def measure_generate_p50(mcfg, tcfg, steps: int = 4,
             "batch_size": batch_size}
 
 
-# HBM bandwidth by device_kind substring, bytes/sec — for the decode
+# HBM bandwidth by device_kind pattern, bytes/sec — for the decode
 # roofline columns (benchmarks/RESULTS.md decode table convention).
-_HBM_BW = {"v5 lite": 819e9, "v5e": 819e9, "v4": 1228e9,
-           "v5p": 2765e9, "v6": 1640e9}
+# ORDERED, most-specific pattern first: matching walks the list, so a
+# generic pattern added later can never shadow a specific one (the old
+# dict relied on insertion order, and a substring like "v5" would have
+# silently captured "v5p"/"v5 lite" depending on where it was added).
+_HBM_BW = [
+    ("v5 lite", 819e9), ("v5e", 819e9), ("v5p", 2765e9),
+    ("v6", 1640e9), ("v4", 1228e9),
+]
+
+_HBM_BW_WARNED = set()
+
+
+def hbm_bw_bytes_per_sec(device_kind: str) -> float | None:
+    """First matching (pattern, bw) entry; logs once per unmatched kind
+    so sweep rows missing the roofline columns are never silent."""
+    kind = (device_kind or "").lower()
+    for pat, bw in _HBM_BW:
+        if pat in kind:
+            return bw
+    if kind not in _HBM_BW_WARNED:
+        _HBM_BW_WARNED.add(kind)
+        log(f"note: no HBM bandwidth entry for device kind "
+            f"{device_kind!r}; roofline floor columns omitted")
+    return None
 
 
 def _decode_byte_floor_us(mcfg, batch: int, device_kind: str,
@@ -236,8 +258,7 @@ def _decode_byte_floor_us(mcfg, batch: int, device_kind: str,
     then exposes layout padding (the heads layout's D-minor tile pad)
     as excess, matching the RESULTS.md roofline convention. None when
     the device's bandwidth is unknown (e.g. CPU)."""
-    bw = next((v for k, v in _HBM_BW.items()
-               if k in (device_kind or "").lower()), None)
+    bw = hbm_bw_bytes_per_sec(device_kind)
     if bw is None:
         return None
     weight_bytes = n_params * 2
@@ -291,6 +312,53 @@ def bench_decode_sweep(args) -> None:
         "unit": "tokens/sec",
         "vs_baseline": 0.0,  # reference publishes no generation numbers
         "sweep": rows,
+    })
+
+
+def bench_serve(args) -> None:
+    """Continuous-batching serving replay (serve/): a seeded Poisson
+    trace through the pooled-KV engine; artifact is the aggregate
+    decode throughput plus the TTFT/step-latency/occupancy summary and
+    the recompiles-after-warmup count (must be 0 at steady state)."""
+    import jax
+
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.serve import EngineConfig, ReplayConfig, run_replay
+    from replicatinggpt_tpu.train.state import create_train_state
+
+    cfg = get_config(args.preset)
+    dev = jax.devices()[0]
+    log(f"serve replay: {args.serve_requests} requests @ "
+        f"{args.serve_rate}/s, pool {args.serve_pool}, "
+        f"model {cfg.model.n_layer}L/{cfg.model.n_head}H/"
+        f"{cfg.model.n_embd}C on {dev.device_kind}")
+    state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
+    rcfg = ReplayConfig(n_requests=args.serve_requests,
+                        rate=args.serve_rate, seed=0,
+                        prompt_len_max=cfg.model.block_size // 2,
+                        max_new_tokens=args.serve_max_new_tokens,
+                        top_k=50)
+    summary = run_replay(state.params, cfg.model, rcfg,
+                         EngineConfig(pool_size=args.serve_pool,
+                                      max_queue=2 * args.serve_requests))
+    h = summary["histograms"]
+    log(f"serve: {summary['aggregate_tokens_per_s']} tok/s aggregate, "
+        f"TTFT p50 {h.get('ttft_s', {}).get('p50', 0) * 1e3:.1f} ms, "
+        f"{summary['recompiles_after_warmup']} recompiles after warmup")
+    emit({
+        "metric": "serve_replay_aggregate_tokens_per_sec",
+        "value": summary["aggregate_tokens_per_s"],
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,  # reference has no serving path at all
+        "n_requests": summary["n_requests"],
+        "n_completed": summary["n_completed"],
+        "ttft_p50_ms": round(h.get("ttft_s", {}).get("p50", 0) * 1e3, 2),
+        "ttft_p99_ms": round(h.get("ttft_s", {}).get("p99", 0) * 1e3, 2),
+        "step_p50_ms": round(summary["step_latency"]["p50_s"] * 1e3, 3),
+        "batch_fill_mean": round(
+            h.get("batch_fill_ratio", {}).get("mean", 0), 3),
+        "recompiles_after_warmup": summary["recompiles_after_warmup"],
+        "device_kind": dev.device_kind,
     })
 
 
@@ -631,7 +699,15 @@ def main() -> None:
     p.add_argument("--preset", default="char-gpt")
     p.add_argument("--mode", default="train",
                    choices=["train", "generate", "longctx", "kernel",
-                            "decode"])
+                            "decode", "serve"])
+    p.add_argument("--serve-requests", type=int, default=64,
+                   help="--mode serve: trace length")
+    p.add_argument("--serve-rate", type=float, default=200.0,
+                   help="--mode serve: Poisson arrival rate, req/s")
+    p.add_argument("--serve-pool", type=int, default=8,
+                   help="--mode serve: KV-cache pool slots")
+    p.add_argument("--serve-max-new-tokens", type=int, default=32,
+                   help="--mode serve: per-request decode budget")
     p.add_argument("--loss-chunk", type=int, default=None,
                    help="train modes: chunked CE head override "
                         "(ModelConfig.loss_chunk; 0 = one-shot logits)")
@@ -687,8 +763,9 @@ def main() -> None:
                          "_per_chip",
               "kernel": "flash_kernel_fwdbwd_median_ms",
               "decode": "generate_batched_aggregate_tokens_per_sec_p50",
+              "serve": "serve_replay_aggregate_tokens_per_sec",
               "train": "char_gpt_train_tokens_per_sec_per_chip"}[args.mode]
-    unit = ("tokens/sec" if args.mode in ("generate", "decode")
+    unit = ("tokens/sec" if args.mode in ("generate", "decode", "serve")
             else "ms" if args.mode == "kernel" else "tokens/sec/chip")
     try:
         # probe first, watchdog after: the probe phase is already
@@ -711,6 +788,8 @@ def main() -> None:
             bench_kernel(args)
         elif args.mode == "decode":
             bench_decode_sweep(args)
+        elif args.mode == "serve":
+            bench_serve(args)
         else:
             bench_train(args)
     except BaseException as e:  # noqa: BLE001 — artifact must still emit

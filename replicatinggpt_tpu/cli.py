@@ -10,6 +10,8 @@ every pipeline as a subcommand over the preset/override config system:
     python -m replicatinggpt_tpu eval     --preset char-gpt --checkpoint ...
     python -m replicatinggpt_tpu export-torch --preset char-gpt \
         --checkpoint-dir ... --out model.pth
+    python -m replicatinggpt_tpu serve-replay --preset char-gpt \
+        --n-requests 64 --pool-size 8
 """
 
 from __future__ import annotations
@@ -206,6 +208,54 @@ def cmd_export_torch(args) -> int:
     return 0
 
 
+def cmd_serve_replay(args) -> int:
+    """Replay a synthetic Poisson request trace through the
+    continuous-batching engine (serve/) and print the serving metrics
+    summary — the offline stand-in for real traffic (zero-egress image).
+    Random-init params by default; --checkpoint-dir serves a trained
+    model (token ids are synthetic either way, so no tokenizer/corpus
+    is needed)."""
+    _apply_rng_impl(args)
+    import json
+
+    import jax
+
+    from .config import config_from_args
+    from .serve import EngineConfig, ReplayConfig, format_summary, run_replay
+    from .train.state import create_train_state
+    cfg = config_from_args(args)
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
+                               cfg.model, cfg.train)
+    if args.checkpoint_dir:
+        from .train.checkpoint import CheckpointManager
+        restored = CheckpointManager(args.checkpoint_dir).restore_latest(state)
+        if restored is None:
+            print("no checkpoint found; serving random init",
+                  file=sys.stderr)
+        else:
+            state = restored
+    rcfg = ReplayConfig(
+        n_requests=args.n_requests, rate=args.rate, seed=args.seed or 0,
+        prompt_len_min=args.prompt_len_min,
+        prompt_len_max=args.prompt_len_max or cfg.model.block_size // 2,
+        max_new_tokens=args.request_max_new_tokens, greedy=args.greedy,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        deadline_s=args.deadline_s)
+    ecfg = EngineConfig(pool_size=args.pool_size, max_queue=args.max_queue,
+                        prefill_chunk=args.prefill_chunk)
+    dev = jax.devices()[0]
+    print(f"serve-replay: {rcfg.n_requests} requests @ {rcfg.rate}/s, "
+          f"pool {ecfg.pool_size}, queue {ecfg.max_queue}, "
+          f"model {cfg.model.n_layer}L/{cfg.model.n_head}H/"
+          f"{cfg.model.n_embd}C on {dev.platform} ({dev.device_kind})",
+          file=sys.stderr)
+    summary = run_replay(state.params, cfg.model, rcfg, ecfg)
+    print(format_summary(summary))
+    if args.json:
+        print(json.dumps(summary))
+    return 0
+
+
 def cmd_eval(args) -> int:
     _apply_rng_impl(args)
     import jax
@@ -297,6 +347,38 @@ def main(argv=None) -> int:
     px.add_argument("--checkpoint-dir", default=None)
     px.add_argument("--out", default="model.pth")
     px.set_defaults(fn=cmd_export_torch)
+
+    ps = sub.add_parser("serve-replay",
+                        help="replay a synthetic Poisson request trace "
+                             "through the continuous-batching serving "
+                             "engine and report TTFT/throughput/occupancy")
+    add_config_flags(ps)
+    ps.add_argument("--rng-impl", default=None,
+                    choices=["threefry2x32", "rbg"])
+    ps.add_argument("--checkpoint-dir", default=None)
+    ps.add_argument("--n-requests", type=int, default=64)
+    ps.add_argument("--rate", type=float, default=200.0,
+                    help="mean Poisson arrival rate, requests/sec")
+    ps.add_argument("--pool-size", type=int, default=8,
+                    help="KV-cache slots pre-allocated at engine start")
+    ps.add_argument("--max-queue", type=int, default=64,
+                    help="admission queue bound (backpressure past it)")
+    ps.add_argument("--prefill-chunk", type=int, default=0,
+                    help="prompt tokens per prefill dispatch "
+                         "(0 = min(64, block_size))")
+    ps.add_argument("--prompt-len-min", type=int, default=1)
+    ps.add_argument("--prompt-len-max", type=int, default=0,
+                    help="0 = block_size // 2")
+    ps.add_argument("--request-max-new-tokens", type=int, default=16)
+    ps.add_argument("--greedy", action="store_true")
+    ps.add_argument("--temperature", type=float, default=1.0)
+    ps.add_argument("--top-k", type=int, default=20)
+    ps.add_argument("--top-p", type=float, default=0.0)
+    ps.add_argument("--deadline-s", type=float, default=0.0,
+                    help="per-request deadline after arrival (0 = none)")
+    ps.add_argument("--json", action="store_true",
+                    help="also print the summary as one JSON line")
+    ps.set_defaults(fn=cmd_serve_replay)
 
     pe = sub.add_parser("eval", help="estimate train/val loss")
     add_config_flags(pe)

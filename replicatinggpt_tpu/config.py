@@ -342,9 +342,14 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--preset", default="char-gpt", choices=sorted(PRESETS))
     p.add_argument("--backend", default="jax", choices=["jax"],
                    help="execution backend (BASELINE.json names --backend=jax)")
-    # model overrides
+    # model overrides — each registered under BOTH spellings
+    # (--vocab_size and --vocab-size): the o200k preset's documented
+    # repro command uses the dashed form, and every other flag here is
+    # dashed, so the underscore-only registration was a paper cut
+    # (ADVICE round 5)
     for f in ("vocab_size", "block_size", "n_layer", "n_head", "n_embd"):
-        p.add_argument(f"--{f}", type=int, default=None)
+        p.add_argument(f"--{f}", f"--{f.replace('_', '-')}", type=int,
+                       default=None)
     p.add_argument("--dropout", type=float, default=None)
     p.add_argument("--dtype", type=str, default=None)
     p.add_argument("--attention", dest="attention_impl", default=None,

@@ -390,14 +390,51 @@ def _fused_decode_backend_ok() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _default_allow_pallas() -> bool:
-    """Conservative default for direct decode_step callers: a bare
-    pallas_call cannot be partitioned by GSPMD, so the decode kernels
-    are only safe when the program cannot be mesh-sharded. generate()
-    passes the precise answer (it inspects the real params' shardings
-    eagerly); direct callers on a multi-device process that KNOW their
-    inputs are single-device can pass allow_pallas=True."""
-    return jax.device_count() == 1
+def _all_single_device(tree) -> bool:
+    """True when every array leaf lives on one device (no NamedSharding
+    over a mesh) — the GSPMD-safety answer the decode kernels' gate
+    needs: a bare pallas_call cannot be partitioned, so the kernels are
+    only safe when the program cannot be mesh-sharded. Only meaningful
+    on CONCRETE arrays (tracers carry no committed sharding)."""
+    from jax.sharding import SingleDeviceSharding
+    for leaf in jax.tree_util.tree_leaves(tree):
+        s = getattr(leaf, "sharding", None)
+        if s is not None and not isinstance(s, SingleDeviceSharding):
+            return False
+    return True
+
+
+_PALLAS_GATE_LOGGED = False
+
+
+def _default_allow_pallas(*inputs) -> bool:
+    """Default kernel gate for direct decode_step callers.
+
+    When the inputs are concrete arrays, the answer is precise: inspect
+    their actual shardings (exactly what generate() does eagerly via
+    ``_all_single_device``), so single-device inputs on a multi-device
+    host keep the fused kernels. Inside a trace the shardings are
+    unknowable and the gate falls back to the conservative
+    process-topology guess (device_count()==1); callers that KNOW their
+    traced inputs are single-device pass allow_pallas=True. Logs once
+    per process when the gate turns the kernels off on a backend that
+    would otherwise run them (a silent perf cliff is worse than one
+    stderr line)."""
+    leaves = jax.tree_util.tree_leaves(inputs)
+    if any(isinstance(leaf, jax.core.Tracer) for leaf in leaves):
+        ok = jax.device_count() == 1
+    else:
+        ok = _all_single_device(inputs)
+    if not ok and _fused_decode_backend_ok():
+        global _PALLAS_GATE_LOGGED
+        if not _PALLAS_GATE_LOGGED:
+            _PALLAS_GATE_LOGGED = True
+            import sys
+            print("note: fused decode kernels gated off (multi-device "
+                  "inputs or traced call on a multi-device process); "
+                  "pass allow_pallas=True to decode_step if the inputs "
+                  "are known single-device", file=sys.stderr)
+    return ok
 
 
 def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
@@ -428,7 +465,7 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     x = x[:, None, :]  # (B, 1, C)
 
     if allow_pallas is None:
-        allow_pallas = _default_allow_pallas()
+        allow_pallas = _default_allow_pallas(params, idx_t, cache)
     S_actual = cache["k"].shape[cache_seq_axis(cfg)]
     from ..ops.decode_pallas import fused_decode_layers, fused_decode_supported
     # the envelope gates on the CACHE actually handed in (its length and
@@ -627,6 +664,173 @@ def prefill(params: Params, idx: jnp.ndarray,
         # (parallel/__init__ policy), and the einsum core is already the
         # decode path's attention everywhere else (cached_attention)
         attn = full_causal_attention(q, k, v, impl="einsum")
+        return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
+                ck, cv), None
+
+    if cfg.use_layer_scan:
+        layer_ids = jnp.arange(cfg.n_layer)
+        (_, ck, cv), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], layer_ids))
+    else:
+        carry = (x, cache["k"], cache["v"])
+        for i in range(cfg.n_layer):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            carry, _ = body(carry, (lp, i))
+        _, ck, cv = carry
+    return {"k": ck, "v": cv}
+
+
+# ---------------------------------------------------------------------------
+# Multi-slot decode (continuous batching: per-slot positions)
+# ---------------------------------------------------------------------------
+
+def decode_step_multi(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
+                      cache: Dict[str, jnp.ndarray], cfg: ModelConfig
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One autoregressive step over B independent cache slots at
+    PER-SLOT positions. idx_t: (B,) int32 current tokens; pos: (B,)
+    int32 per-slot positions. Returns (logits (B, V) float32, updated
+    cache).
+
+    This is ``decode_step`` generalized for the continuous-batching
+    serving engine (serve/engine.py): each batch row is a pool slot
+    decoding its own request at its own offset, so the K/V write is a
+    batched scatter at (layer, b, pos[b]) instead of one
+    dynamic_update_slice, and the attention mask is per-row
+    (ops.attention.cached_attention accepts a (B,) cache_index). The
+    per-row math is identical to the scalar-pos XLA path — rows are
+    independent through every op — which is what makes the engine's
+    greedy output token-identical to offline ``generate`` (pinned in
+    tests/test_serve.py). No Pallas route: the fused/packed decode
+    kernels assume one shared position; the serving engine is a
+    steady-state multi-slot batch where the XLA path is the right tool.
+    """
+    cd = _dtype(cfg.dtype)
+    B = idx_t.shape[0]
+    bidx = jnp.arange(B)
+    x = params["wte"].astype(cd)[idx_t] + params["wpe"].astype(cd)[pos]
+    x = x[:, None, :]  # (B, 1, C)
+    packed = cfg.decode_cache_layout == "packed"
+    H = cfg.n_head
+
+    def body(carry, inputs):
+        h_in, ck, cv = carry
+        lp, layer_idx = inputs
+        if packed:
+            q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)
+            ck = ck.at[layer_idx, bidx, pos, :].set(
+                k_m[:, 0, :].astype(ck.dtype))
+            cv = cv.at[layer_idx, bidx, pos, :].set(
+                v_m[:, 0, :].astype(cv.dtype))
+            k_cache = jax.lax.dynamic_index_in_dim(ck, layer_idx, 0,
+                                                   keepdims=False)
+            v_cache = jax.lax.dynamic_index_in_dim(cv, layer_idx, 0,
+                                                   keepdims=False)
+            attn = cached_attention(_split_heads(q_m, H),
+                                    _split_heads(k_cache, H),
+                                    _split_heads(v_cache, H), pos)
+        else:
+            q, k, v = _cached_qkv(h_in, lp, cfg, cd)  # (B, H, 1, D)
+            ck = ck.at[layer_idx, bidx, :, pos, :].set(
+                k[:, :, 0, :].astype(ck.dtype))
+            cv = cv.at[layer_idx, bidx, :, pos, :].set(
+                v[:, :, 0, :].astype(cv.dtype))
+            k_cache = jax.lax.dynamic_index_in_dim(ck, layer_idx, 0,
+                                                   keepdims=False)
+            v_cache = jax.lax.dynamic_index_in_dim(cv, layer_idx, 0,
+                                                   keepdims=False)
+            attn = cached_attention(q, k_cache, v_cache, pos)
+        return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
+                ck, cv), None
+
+    if cfg.use_layer_scan:
+        layer_ids = jnp.arange(cfg.n_layer)
+        (x, new_k, new_v), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], layer_ids))
+    else:
+        carry = (x, cache["k"], cache["v"])
+        for i in range(cfg.n_layer):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            carry, _ = body(carry, (lp, i))
+        x, new_k, new_v = carry
+    return _decode_head(x, params, cfg, cd), {"k": new_k, "v": new_v}
+
+
+def prefill_chunk_into_slot(params: Params, idx: jnp.ndarray,
+                            offset: jnp.ndarray, slot: jnp.ndarray,
+                            cache: Dict[str, jnp.ndarray], cfg: ModelConfig
+                            ) -> Dict[str, jnp.ndarray]:
+    """Chunked prefill into ONE slot of a pooled multi-slot KV cache.
+
+    idx: (1, Pc) int32 — a chunk of the prompt; offset: scalar int32 —
+    the chunk's first absolute position; slot: scalar int32 — the pool
+    slot. Writes the chunk's K/V rows at cache[:, slot, ..,
+    offset:offset+Pc, ..] and runs the block stack with each query at
+    position offset+i attending the slot's whole cache buffer masked to
+    j <= offset+i (write-then-attend: chunk 2's queries see chunk 1's
+    K/V through the buffer, so a long prompt prefills in fixed-size
+    chunks under ONE compiled program regardless of prompt length —
+    the serving engine's admission path). Positions beyond the true
+    prompt inside a right-padded final chunk hold padding-derived K/V;
+    same invariant as ``prefill``: decode overwrites position p before
+    attending it, and the per-query mask hides everything later.
+    Masked-out buffer entries get exactly zero softmax weight (f32
+    underflow of NEG_INF), so the math per valid row is the
+    ``full_causal_attention`` einsum's.
+    """
+    cd = _dtype(cfg.dtype)
+    _, Pc = idx.shape
+    H, S = cfg.n_head, cache["k"].shape[cache_seq_axis(cfg)]
+    scale = cfg.head_dim ** -0.5
+    x = (params["wte"].astype(cd)[idx]
+         + jax.lax.dynamic_slice_in_dim(params["wpe"].astype(cd), offset,
+                                        Pc, axis=0))
+    packed = cfg.decode_cache_layout == "packed"
+    from ..ops.attention import NEG_INF
+
+    def body(carry, inputs):
+        h_in, ck, cv = carry
+        lp, layer_idx = inputs
+        q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)  # (1, Pc, C)
+        zero = jnp.int32(0)
+        if packed:
+            start = (layer_idx, slot, offset, zero)
+            ck = jax.lax.dynamic_update_slice(
+                ck, k_m[None].astype(ck.dtype), start)
+            cv = jax.lax.dynamic_update_slice(
+                cv, v_m[None].astype(cv.dtype), start)
+            k_slot = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
+                slot, 0, False)          # (S, C)
+            v_slot = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
+                slot, 0, False)
+            k_h = _split_heads(k_slot[None].astype(cd), H)  # (1, H, S, D)
+            v_h = _split_heads(v_slot[None].astype(cd), H)
+        else:
+            k = _split_heads(k_m, H)                        # (1, H, Pc, D)
+            v = _split_heads(v_m, H)
+            start = (layer_idx, slot, zero, offset, zero)
+            ck = jax.lax.dynamic_update_slice(
+                ck, k[None].astype(ck.dtype), start)
+            cv = jax.lax.dynamic_update_slice(
+                cv, v[None].astype(cv.dtype), start)
+            k_h = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
+                slot, 0, False)[None].astype(cd)            # (1, H, S, D)
+            v_h = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
+                slot, 0, False)[None].astype(cd)
+        q = _split_heads(q_m, H)                            # (1, H, Pc, D)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_h,
+                            preferred_element_type=jnp.float32) * scale
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (Pc, S), 0) + offset
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (Pc, S), 1)
+        logits = jnp.where(kpos <= qpos, logits, NEG_INF)
+        weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v_h.dtype), v_h)
         return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
                 ck, cv), None
 

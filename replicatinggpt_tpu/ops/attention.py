@@ -97,9 +97,11 @@ def cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     """Single-position decode attention against a KV cache.
 
     q: (B, H, 1, D); caches: (B, H, S, D); cache_index: scalar int32 — the
-    position just written. Attends over positions <= cache_index. This is the
-    inner op of the lax.scan decode loop that replaces the reference's
-    O(T^2)-per-token re-forward generate (GPT1.py:196-212).
+    position just written — or a (B,) vector of per-row positions (the
+    continuous-batching engine decodes slots at independent offsets).
+    Attends over positions <= cache_index. This is the inner op of the
+    lax.scan decode loop that replaces the reference's O(T^2)-per-token
+    re-forward generate (GPT1.py:196-212).
     """
     *_, S, D = k_cache.shape
     if scale is None:
@@ -107,6 +109,9 @@ def cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache,
                         preferred_element_type=jnp.float32) * scale
     kpos = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
-    logits = jnp.where(kpos <= cache_index, logits, NEG_INF)
+    ci = jnp.asarray(cache_index)
+    if ci.ndim == 1:
+        ci = ci[:, None, None, None]  # (B,1,1,1) against (B,H,1,S) logits
+    logits = jnp.where(kpos <= ci, logits, NEG_INF)
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v_cache.dtype), v_cache)

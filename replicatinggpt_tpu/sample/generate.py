@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..models.gpt import cache_seq_axis, decode_step, init_kv_cache, prefill
+from ..models.gpt import (_all_single_device, cache_seq_axis, decode_step,
+                          init_kv_cache, prefill)
 
 
 @dataclass(frozen=True)
@@ -69,16 +70,20 @@ def _unsortable_f32(u: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(back, jnp.float32)
 
 
-def _kth_largest(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+def _kth_largest(logits: jnp.ndarray, k) -> jnp.ndarray:
     """Exact per-row k-th largest of (B, V) float32 via radix select in
     sortable bit space: 8 passes of 4 bits, each counting elements >= 16
     candidate thresholds with a fused compare+reduce. Replaces
     ``lax.top_k`` for the top-k *filter*, where only the k-th value is
     needed: XLA lowers top_k to a full (B, V) sort, measured 377 us per
     decode step at B=1/V=50304 on v5e vs ~20 us for this select (the
-    sort was 44% of the 124M decode step). Returns (B,) float32."""
+    sort was 44% of the 124M decode step). ``k`` is a python int or a
+    (B,) int32 array of per-row ranks (the serving engine's per-slot
+    top-k) — k only ever feeds the counts comparison, so the select is
+    rank-vectorized for free. Returns (B,) float32."""
     u = _sortable_f32(logits)
     B = logits.shape[0]
+    k_col = jnp.broadcast_to(jnp.asarray(k, jnp.int32), (B,))[:, None]
     lo = jnp.zeros((B,), jnp.uint32)
     for shift in range(28, -1, -4):
         cand = (lo[:, None]
@@ -89,7 +94,7 @@ def _kth_largest(logits: jnp.ndarray, k: int) -> jnp.ndarray:
         # chosen bucket is the largest whose count still reaches k.
         # count(u >= lo) >= k holds at every pass (lo starts at 0 and
         # only advances to satisfying prefixes), so sel >= 0 always.
-        sel = jnp.sum((counts >= k).astype(jnp.int32), axis=1) - 1
+        sel = jnp.sum((counts >= k_col).astype(jnp.int32), axis=1) - 1
         lo = lo + (sel.astype(jnp.uint32) << shift)
     return _unsortable_f32(lo)
 
@@ -146,6 +151,65 @@ def _sample_token(rng: jax.Array, logits: jnp.ndarray,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Batched per-row sampling (the continuous-batching engine's sampler:
+# every row is a pool slot with its OWN temperature/top-k/top-p/greedy
+# and its own rng stream — same filter math as the scalar path above,
+# vectorized over rows with per-row off-switches)
+# ---------------------------------------------------------------------------
+
+def batched_top_k_filter(logits: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Per-row top-k filter: k is (B,) int32; rows with k <= 0 or
+    k >= V pass through UNCHANGED (bit-exact off-switch — not a k=V
+    filter, which would still mask zero-probability ties differently).
+    Same kept-set semantics as ``_top_k_filter`` (ties at the k-th value
+    kept), via the radix select (``_kth_largest`` takes per-row k: it
+    only ever compares counts >= k)."""
+    V = logits.shape[-1]
+    k = jnp.asarray(k, jnp.int32)
+    off = (k <= 0) | (k >= V)
+    k_eff = jnp.where(off, 1, k)  # any valid k; rows masked back below
+    t = _kth_largest(logits.astype(jnp.float32), k_eff)
+    filtered = jnp.where(logits < t[:, None], -jnp.inf, logits)
+    return jnp.where(off[:, None], logits, filtered)
+
+
+def batched_top_p_filter(logits: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Per-row nucleus filter: p is (B,) float32; rows with p <= 0 or
+    p >= 1 pass through unchanged. Same rank-based prefix semantics as
+    ``_top_p_filter``."""
+    p = jnp.asarray(p, jnp.float32)
+    off = (p <= 0.0) | (p >= 1.0)
+    idx = jnp.argsort(logits, axis=-1)[:, ::-1]
+    sorted_logits = jnp.take_along_axis(logits, idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < jnp.where(off, 1.0, p)[:, None]
+    rows = jnp.arange(logits.shape[0])[:, None]
+    mask = jnp.zeros(logits.shape, bool).at[rows, idx].set(keep)
+    filtered = jnp.where(mask, logits, -jnp.inf)
+    return jnp.where(off[:, None], logits, filtered)
+
+
+def sample_tokens_batched(rngs: jnp.ndarray, logits: jnp.ndarray,
+                          temperature: jnp.ndarray, top_k: jnp.ndarray,
+                          top_p: jnp.ndarray, greedy: jnp.ndarray
+                          ) -> jnp.ndarray:
+    """Per-row sampling: (B,) params, (B, key) rngs, (B, V) f32 logits
+    -> (B,) int32. Greedy rows take argmax of the RAW logits (exactly
+    ``_sample_token``'s greedy mode, so a greedy slot in a mixed batch
+    is token-identical to a scalar greedy decode); stochastic rows get
+    temperature -> top-k -> top-p, each per-row, then a per-row
+    categorical draw from the row's own key."""
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(
+        jnp.asarray(temperature, jnp.float32), 1e-6)[:, None]
+    f = batched_top_k_filter(scaled, top_k)
+    f = batched_top_p_filter(f, top_p)
+    sampled = jax.vmap(jax.random.categorical)(rngs, f).astype(jnp.int32)
+    return jnp.where(jnp.asarray(greedy, bool), greedy_tok, sampled)
+
+
 def _decode_chunks(P_pad: int, n_new: int, S: int, g: int):
     """Static (n_steps, cache_len) chunks covering an ``n_new``-step
     decode scan whose step i writes position <= P_pad - 1 + i. The KV
@@ -172,20 +236,6 @@ def _decode_chunks(P_pad: int, n_new: int, S: int, g: int):
         chunks.append((n_c, a))
         i += n_c
     return chunks
-
-
-def _all_single_device(tree) -> bool:
-    """True when every array leaf lives on one device (no NamedSharding
-    over a mesh) — evaluated EAGERLY on the real params, before jit, so
-    the decode kernels' GSPMD-safety gate gets a precise answer instead
-    of a process-topology guess (a bare pallas_call cannot be
-    partitioned; shard_for_decode outputs must keep the einsum path)."""
-    from jax.sharding import SingleDeviceSharding
-    for leaf in jax.tree_util.tree_leaves(tree):
-        s = getattr(leaf, "sharding", None)
-        if s is not None and not isinstance(s, SingleDeviceSharding):
-            return False
-    return True
 
 
 def _segment_core(params, prompt: jnp.ndarray, prompt_len, n_new: int,

@@ -1,0 +1,22 @@
+"""Continuous-batching serving subsystem.
+
+- ``cache_pool``: fixed slot pool over one pre-allocated multi-slot KV
+  cache (slot assignment/free + per-slot position counters);
+- ``scheduler``: bounded admission queue with backpressure and deadline
+  dropping;
+- ``engine``: the per-step loop — admit (chunked prefill into the
+  slot's cache region) + ONE jitted multi-slot decode with per-slot
+  positions/mask/RNG/sampling params;
+- ``replay``: synthetic Poisson trace driver (`serve-replay` CLI,
+  `bench.py --mode serve`).
+"""
+
+from .cache_pool import CachePool
+from .engine import Engine, EngineConfig, compile_counts
+from .replay import ReplayConfig, format_summary, make_trace, run_replay
+from .requests import Request, RequestResult, SamplingParams
+from .scheduler import Scheduler
+
+__all__ = ["CachePool", "Engine", "EngineConfig", "compile_counts",
+           "ReplayConfig", "format_summary", "make_trace", "run_replay",
+           "Request", "RequestResult", "SamplingParams", "Scheduler"]

@@ -1,0 +1,93 @@
+"""Pooled KV cache: a fixed set of decode slots allocated once at engine
+start.
+
+The offline path allocates a fresh KV cache per ``generate`` call; a
+serving engine cannot — allocation is a compile-shape change and a
+latency spike. Here the pool is ONE stacked cache buffer
+(``models.gpt.init_kv_cache`` with batch = n_slots, either layout) whose
+batch axis is the slot axis, living on device for the engine's entire
+lifetime. Slot assignment/free is host-side bookkeeping: a free-list
+(the per-slot position counters live in the engine's step arrays,
+which feed the jitted decode directly); the device buffer itself is
+never resized or re-zeroed (stale K/V in a freed slot is harmless —
+the next occupant's prefill/decode overwrites every position before
+attending it, the same invariant ``sample.generate`` relies on for
+padded prompts).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..models.gpt import cache_seq_axis, init_kv_cache
+
+
+def commit_default(x):
+    """device_put onto an EXPLICIT device (the configured default) —
+    plain device_put without a device keeps the array *uncommitted*,
+    and the engine's jit cache keys on committed-ness: engine-owned
+    state must enter the first call exactly as it leaves every step (a
+    committed jit output), or warmup compiles one throwaway executable
+    per program (observed with checkpoint-restored, i.e. committed,
+    params)."""
+    import jax
+    dev = jax.config.jax_default_device or jax.local_devices()[0]
+    return jax.device_put(x, dev)
+
+
+class CachePool:
+    """Fixed-size slot pool over one pre-allocated multi-slot KV cache."""
+
+    def __init__(self, cfg: ModelConfig, n_slots: int,
+                 max_len: Optional[int] = None, dtype=None):
+        assert n_slots >= 1, n_slots
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len or cfg.block_size
+        # committed up front — see commit_default
+        self.cache: Dict[str, jnp.ndarray] = commit_default(init_kv_cache(
+            cfg, n_slots, max_len=self.max_len, dtype=dtype))
+        self._free: List[int] = list(range(n_slots - 1, -1, -1))
+        self._owner: Dict[int, str] = {}        # slot -> request id
+
+    @property
+    def seq_len(self) -> int:
+        return self.cache["k"].shape[cache_seq_axis(self.cfg)]
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.n_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.n_used / self.n_slots
+
+    def acquire(self, request_id: str) -> Optional[int]:
+        """Assign a free slot to ``request_id``; None when the pool is
+        exhausted (the scheduler then leaves the request queued)."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._owner[slot] = request_id
+        return slot
+
+    def release(self, slot: int) -> None:
+        owner = self._owner.pop(slot, None)
+        assert owner is not None, f"slot {slot} double-free"
+        self._free.append(slot)
+
+    def owner(self, slot: int) -> Optional[str]:
+        return self._owner.get(slot)
+
+    def slot_of(self, request_id: str) -> Optional[int]:
+        for slot, rid in self._owner.items():
+            if rid == request_id:
+                return slot
+        return None

@@ -1,0 +1,352 @@
+"""Continuous-batching inference engine.
+
+One pre-compiled multi-slot decode step, driven by a host-side
+scheduler — the serving shape both the compiler-first O(1)-caching and
+the pjit/TPU-scaling playbooks converge on (PAPERS.md): the device
+program never changes at steady state, and all request-level dynamism
+(arrivals, lengths, completions, cancellations) lives in cheap host
+bookkeeping plus small per-step input arrays.
+
+Per step the engine:
+
+1. expires deadlines (queued and active),
+2. admits queued prompts into free pool slots — chunked prefill
+   (``models.gpt.prefill_chunk_into_slot``) writes the prompt's K/V
+   into the slot's cache region under ONE compiled program regardless
+   of prompt length,
+3. runs ONE jitted ``decode_step_multi`` over ALL slots — per-slot
+   positions, per-slot active mask, per-slot RNG streams, per-slot
+   sampling params (``sample.generate.sample_tokens_batched``) — and
+   fetches the (n_slots,) sampled tokens.
+
+Zero recompiles at steady state: the decode program is keyed only on
+the (static) model config and pool shape, the prefill program only on
+the chunk shape; both are module-level jits whose cache sizes the tests
+assert stay flat across a long replay (tests/test_serve.py).
+
+Observability: per-request TTFT / decode tok/s / queue wait, engine
+counters (admissions, rejections, completions, tokens), slot-occupancy
+and queue-depth gauges, batch-fill-ratio and step-latency histograms —
+through ``utils.logging.Metrics`` and ``utils.profiling.StepTimer``,
+with ``annotate()`` spans around the prefill and decode phases.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..models.gpt import decode_step_multi, prefill_chunk_into_slot
+from ..sample.generate import sample_tokens_batched
+from ..utils.logging import Metrics
+from ..utils.profiling import StepTimer, annotate
+from .cache_pool import CachePool
+from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_LENGTH_CAP,
+                       FINISH_MAX_TOKENS, Request, RequestResult)
+from .scheduler import Scheduler
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Engine sizing. ``prefill_chunk=0`` auto-sizes to
+    min(64, block_size): small enough that short prompts don't pay a
+    huge padded chunk, large enough that long prompts take few chunk
+    dispatches — and ONE compiled prefill program either way."""
+
+    pool_size: int = 8
+    max_queue: int = 64
+    prefill_chunk: int = 0
+
+    def chunk(self, block_size: int) -> int:
+        """Effective prefill chunk: the requested (or auto) size rounded
+        DOWN to a divisor of block_size. Divisibility is a correctness
+        requirement, not a preference: the final chunk of a P-token
+        prompt is dispatched at offset (ceil(P/c)-1)*c and padded to c,
+        so a non-divisor c could push the padded chunk past the cache
+        buffer — and jax.lax.dynamic_update_slice silently CLAMPS
+        out-of-bounds starts, which would overwrite valid earlier K/V
+        instead of erroring. With c | block_size, ceil(P/c)*c <=
+        block_size for every admissible P."""
+        c = min(self.prefill_chunk or min(64, block_size), block_size)
+        while block_size % c:
+            c -= 1
+        return c
+
+
+@dataclass
+class _Active:
+    """Host-side record of a request occupying a slot."""
+
+    req: Request
+    t_submit: float
+    t_admit: float
+    cap: int                      # max new tokens this slot can produce
+    capped: bool                  # cap < req.max_new_tokens (context limit)
+    tokens: List[int] = field(default_factory=list)
+    t_first_token: float = 0.0
+    t_last_token: float = 0.0
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _engine_decode(params, tok, pos, active, cache, rngs, temp, top_k,
+                   top_p, greedy, cfg: ModelConfig):
+    """The steady-state program: one multi-slot decode + batched sample.
+
+    All request-level inputs are small (n_slots,) arrays — traced, so
+    admissions/completions/sampling changes never retrace. Inactive
+    slots run at position 0 (their writes land in cache regions the
+    next occupant's prefill overwrites before attending) and their
+    sampled token is masked to 0.
+    """
+    pos_eff = jnp.where(active, pos, 0)
+    logits, cache = decode_step_multi(params, tok, pos_eff, cache, cfg)
+    splits = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
+    nxt = sample_tokens_batched(splits[:, 0], logits, temp, top_k, top_p,
+                                greedy)
+    return jnp.where(active, nxt, 0), cache, splits[:, 1]
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _engine_prefill(params, chunk, offset, slot, cache, cfg: ModelConfig):
+    return prefill_chunk_into_slot(params, chunk, offset, slot, cache, cfg)
+
+
+def compile_counts() -> Dict[str, int]:
+    """Compiled-program counts for the two engine entry points — the
+    steady-state zero-recompile assertion reads these before/after."""
+    return {"decode": _engine_decode._cache_size(),
+            "prefill": _engine_prefill._cache_size()}
+
+
+class Engine:
+    """Continuous-batching engine over a pooled KV cache.
+
+    Host API (single-threaded by design — drive it from one loop):
+
+    - ``submit(req)`` -> None (accepted) or a rejected ``RequestResult``
+      (backpressure / validation, with the reason as finish_reason);
+    - ``cancel(request_id)`` -> bool;
+    - ``step()`` -> list of requests finishing this step;
+    - ``drain()`` -> run steps until idle, return all finishes;
+    - ``metrics_summary()`` -> counters/gauges/histograms + step-latency
+      percentiles.
+    """
+
+    def __init__(self, params, cfg: ModelConfig,
+                 ecfg: EngineConfig = EngineConfig(),
+                 clock: Callable[[], float] = time.monotonic):
+        cfg.validate()
+        self.params = params
+        self.cfg = cfg
+        self.ecfg = ecfg
+        self.clock = clock
+        self.pool = CachePool(cfg, ecfg.pool_size)
+        self.scheduler = Scheduler(ecfg.max_queue, cfg.block_size,
+                                   clock=clock)
+        self.metrics = Metrics()
+        self.step_timer = StepTimer()
+        P = ecfg.pool_size
+        self._chunk = ecfg.chunk(cfg.block_size)
+        self._tok = np.zeros((P,), np.int32)
+        self._pos = np.zeros((P,), np.int32)
+        self._active = np.zeros((P,), bool)
+        self._temp = np.ones((P,), np.float32)
+        self._top_k = np.zeros((P,), np.int32)
+        self._top_p = np.zeros((P,), np.float32)
+        self._greedy = np.zeros((P,), bool)
+        # committed up front for the same jit-key stability reason as
+        # CachePool.cache (the array becomes a committed jit output
+        # after the first step)
+        from .cache_pool import commit_default
+        self._rngs = commit_default(
+            jnp.stack([jax.random.PRNGKey(i) for i in range(P)]))
+        self._slots: Dict[int, _Active] = {}
+        self._pending: List[RequestResult] = []  # cancellations between steps
+        self.n_steps = 0
+
+    # ---------------------------------------------------------------- API
+
+    def submit(self, req: Request) -> Optional[RequestResult]:
+        self.metrics.inc("requests_submitted")
+        reason = self.scheduler.submit(req)
+        if reason is not None:
+            self.metrics.inc(reason)
+            return RequestResult(id=req.id, tokens=[], finish_reason=reason)
+        return None
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a queued or running request. The terminal
+        ``RequestResult`` (with any tokens already produced) surfaces
+        from the next ``step()``; True iff the request was found."""
+        now = self.clock()
+        if self.scheduler.cancel(request_id):
+            self.metrics.inc("finished_" + FINISH_CANCELLED)
+            self._pending.append(RequestResult(
+                id=request_id, tokens=[], finish_reason=FINISH_CANCELLED))
+            return True
+        slot = self.pool.slot_of(request_id)
+        if slot is None:
+            return False
+        self._pending.append(self._finish_slot(slot, FINISH_CANCELLED, now))
+        return True
+
+    @property
+    def idle(self) -> bool:
+        return (not self._active.any() and len(self.scheduler) == 0
+                and not self._pending)
+
+    def step(self) -> List[RequestResult]:
+        """One scheduling iteration: expire -> admit -> decode."""
+        finished: List[RequestResult] = self._pending
+        self._pending = []
+        now = self.clock()
+
+        for req, t_submit, reason in self.scheduler.drain_expired(now):
+            finished.append(self._finish_unstarted(req, t_submit, reason,
+                                                   now))
+        for slot in list(self._slots):
+            dl = self._slots[slot].req.deadline
+            if dl is not None and now >= dl:
+                finished.append(self._finish_slot(slot, FINISH_DEADLINE,
+                                                  now))
+
+        admitted, dropped = self.scheduler.admit(self.pool.n_free, now)
+        for req, t_submit, reason in dropped:
+            finished.append(self._finish_unstarted(req, t_submit, reason,
+                                                   now))
+        for req, t_submit in admitted:
+            self._admit(req, t_submit, now)
+
+        self.metrics.gauge("queue_depth", self.scheduler.depth)
+        self.metrics.gauge("slots_active", int(self._active.sum()))
+        self.metrics.gauge("slot_occupancy", self.pool.occupancy)
+
+        if self._active.any():
+            finished.extend(self._decode_once())
+        return finished
+
+    def drain(self, max_steps: int = 1_000_000) -> List[RequestResult]:
+        out: List[RequestResult] = []
+        for _ in range(max_steps):
+            if self.idle:
+                return out
+            out.extend(self.step())
+        raise RuntimeError(f"engine did not drain in {max_steps} steps")
+
+    def metrics_summary(self) -> dict:
+        s = self.metrics.summary()
+        s["step_latency"] = self.step_timer.summary(skip=1)
+        s["n_steps"] = self.n_steps
+        s["compile_counts"] = compile_counts()
+        return s
+
+    # ----------------------------------------------------------- internals
+
+    def _admit(self, req: Request, t_submit: float, now: float) -> None:
+        slot = self.pool.acquire(req.id)
+        assert slot is not None, "scheduler admitted past pool capacity"
+        P = int(req.prompt.size)
+        S = self.pool.seq_len
+        # decode step i runs at position P-1+i (the first rewrites the
+        # last prompt position), so the slot supports S - P + 1 new
+        # tokens before the write position would leave the buffer
+        room = S - P + 1
+        cap = min(req.max_new_tokens, room)
+        chunk = self._chunk
+        n_chunks = -(-P // chunk)
+        padded = np.zeros((n_chunks * chunk,), np.int32)
+        padded[:P] = req.prompt
+        cache = self.pool.cache
+        with annotate("serve/prefill"):
+            for c in range(n_chunks):
+                cache = _engine_prefill(
+                    self.params, jnp.asarray(padded[None,
+                                                    c * chunk:(c + 1) * chunk]),
+                    jnp.int32(c * chunk), jnp.int32(slot), cache, self.cfg)
+        self.pool.cache = cache
+        self._tok[slot] = req.prompt[-1]
+        self._pos[slot] = P - 1
+        self._active[slot] = True
+        sp = req.sampling
+        self._temp[slot] = sp.temperature
+        self._top_k[slot] = sp.top_k
+        self._top_p[slot] = sp.top_p
+        self._greedy[slot] = sp.greedy
+        self._rngs = self._rngs.at[slot].set(jax.random.PRNGKey(req.rng_seed))
+        self._slots[slot] = _Active(req=req, t_submit=t_submit, t_admit=now,
+                                    cap=cap,
+                                    capped=cap < req.max_new_tokens)
+        self.metrics.inc("requests_admitted")
+        self.metrics.inc("prefill_tokens", P)
+        self.metrics.observe("queue_wait_s", now - t_submit)
+
+    def _decode_once(self) -> List[RequestResult]:
+        with annotate("serve/decode"):
+            self.step_timer.start()
+            nxt, cache, rngs = _engine_decode(
+                self.params, jnp.asarray(self._tok), jnp.asarray(self._pos),
+                jnp.asarray(self._active), self.pool.cache, self._rngs,
+                jnp.asarray(self._temp), jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p), jnp.asarray(self._greedy),
+                self.cfg)
+            self.step_timer.lap(nxt)
+        self.pool.cache = cache
+        self._rngs = rngs
+        toks = np.asarray(nxt)
+        now = self.clock()
+        self.n_steps += 1
+        n_active = int(self._active.sum())
+        self.metrics.observe("batch_fill_ratio",
+                             n_active / self.ecfg.pool_size)
+        self.metrics.inc("decode_steps")
+        self.metrics.inc("decode_tokens", n_active)
+        finished: List[RequestResult] = []
+        for slot in list(self._slots):
+            if not self._active[slot]:
+                continue
+            st = self._slots[slot]
+            st.tokens.append(int(toks[slot]))
+            if len(st.tokens) == 1:
+                st.t_first_token = now
+                self.metrics.observe("ttft_s", now - st.t_submit)
+            st.t_last_token = now
+            self._tok[slot] = toks[slot]
+            self._pos[slot] += 1
+            if len(st.tokens) >= st.cap:
+                reason = (FINISH_LENGTH_CAP if st.capped
+                          else FINISH_MAX_TOKENS)
+                finished.append(self._finish_slot(slot, reason, now))
+        return finished
+
+    def _finish_slot(self, slot: int, reason: str,
+                     now: float) -> RequestResult:
+        st = self._slots.pop(slot)
+        self._active[slot] = False
+        self.pool.release(slot)
+        n = len(st.tokens)
+        decode_tps = 0.0
+        if n > 1 and st.t_last_token > st.t_first_token:
+            decode_tps = (n - 1) / (st.t_last_token - st.t_first_token)
+        res = RequestResult(
+            id=st.req.id, tokens=st.tokens, finish_reason=reason,
+            queue_wait_s=st.t_admit - st.t_submit,
+            ttft_s=(st.t_first_token - st.t_submit) if n else 0.0,
+            decode_tokens_per_s=decode_tps, total_s=now - st.t_submit)
+        self.metrics.inc(f"finished_{reason}")
+        if decode_tps:
+            self.metrics.observe("decode_tokens_per_s", decode_tps)
+        return res
+
+    def _finish_unstarted(self, req: Request, t_submit: float, reason: str,
+                          now: float) -> RequestResult:
+        self.metrics.inc(f"finished_{reason}")
+        return RequestResult(id=req.id, tokens=[], finish_reason=reason,
+                             queue_wait_s=now - t_submit,
+                             total_s=now - t_submit)

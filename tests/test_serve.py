@@ -1,0 +1,444 @@
+"""Serving-engine tests (serve/): greedy parity with offline generate
+regardless of arrival order, slot free/reuse, backpressure, deadlines,
+cancellation, per-slot sampling params, and the steady-state
+zero-recompile guarantee."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from replicatinggpt_tpu.config import ModelConfig
+from replicatinggpt_tpu.models.gpt import init_params
+from replicatinggpt_tpu.sample import GenerateConfig, generate
+from replicatinggpt_tpu.serve import (CachePool, Engine, EngineConfig,
+                                      ReplayConfig, Request, RequestResult,
+                                      SamplingParams, Scheduler,
+                                      compile_counts, run_replay)
+from replicatinggpt_tpu.serve.requests import (FINISH_CANCELLED,
+                                               FINISH_DEADLINE,
+                                               FINISH_LENGTH_CAP,
+                                               FINISH_MAX_TOKENS,
+                                               REJECT_PROMPT_TOO_LONG,
+                                               REJECT_QUEUE_FULL)
+
+CFG = ModelConfig(vocab_size=65, block_size=32, n_layer=2, n_head=2,
+                  n_embd=32, dropout=0.0, attn_dropout=0.0, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _requests(n=6, greedy=True, seed=3, max_new=None):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        P = int(rng.integers(1, CFG.block_size // 2))
+        prompt = rng.integers(0, CFG.vocab_size, (P,)).astype(np.int32)
+        out.append(Request(
+            id=f"r{i}", prompt=prompt,
+            max_new_tokens=max_new or int(rng.integers(4, 14)),
+            sampling=SamplingParams(greedy=greedy), rng_seed=i))
+    return out
+
+
+def _offline_greedy(params, reqs):
+    return {r.id: np.asarray(generate(
+        params, r.prompt[None, :], CFG,
+        GenerateConfig(max_new_tokens=r.max_new_tokens, greedy=True))
+    )[0].tolist() for r in reqs}
+
+
+# ---------------------------------------------------------------------------
+# parity
+# ---------------------------------------------------------------------------
+
+def test_greedy_parity_any_arrival_order(params):
+    """Engine greedy output must be token-identical to offline
+    generate() per request, for a pool smaller than the request count,
+    under different submission orders (continuous batching must not
+    leak anything between slots)."""
+    reqs = _requests(6)
+    want = _offline_greedy(params, reqs)
+    for order in (list(range(6)), [5, 2, 0, 4, 1, 3]):
+        eng = Engine(params, CFG, EngineConfig(pool_size=3, max_queue=16))
+        for i in order:
+            assert eng.submit(reqs[i]) is None
+        got = {r.id: r.tokens for r in eng.drain()}
+        assert got == want
+
+
+def test_greedy_parity_packed_cache_layout(params):
+    """The packed (L,B,S,C) pooled-cache layout must produce the same
+    greedy tokens through the engine (decode_step_multi's packed write
+    path + chunked-prefill packed path)."""
+    pc = dataclasses.replace(CFG, decode_cache_layout="packed")
+    reqs = _requests(4)
+    want = _offline_greedy(params, reqs)
+    eng = Engine(params, pc, EngineConfig(pool_size=2, max_queue=8))
+    for r in reqs:
+        assert eng.submit(r) is None
+    got = {r.id: r.tokens for r in eng.drain()}
+    assert got == want
+
+
+def test_prefill_chunk_rounded_to_block_divisor(params):
+    """A --prefill-chunk that does not divide block_size must be rounded
+    down to a divisor (a non-divisor's padded final chunk would start
+    past the cache buffer, where dynamic_update_slice silently CLAMPS
+    and corrupts earlier K/V) — and parity must hold at the rounded
+    chunk, including prompts whose final chunk is the last one in the
+    buffer."""
+    ecfg = EngineConfig(pool_size=2, max_queue=8, prefill_chunk=12)
+    assert ecfg.chunk(CFG.block_size) == 8     # largest divisor of 32 <= 12
+    assert EngineConfig(prefill_chunk=48).chunk(256) == 32
+    assert EngineConfig().chunk(31) == 31      # degenerate: c | c always
+    reqs = _requests(3) + [Request(
+        id="edge", prompt=np.arange(CFG.block_size - 1, dtype=np.int32) % 17,
+        max_new_tokens=2, sampling=SamplingParams(greedy=True))]
+    want = _offline_greedy(params, reqs)
+    eng = Engine(params, CFG, ecfg)
+    for r in reqs:
+        assert eng.submit(r) is None
+    got = {r.id: r.tokens for r in eng.drain()}
+    assert got == want
+
+
+def test_decode_step_multi_matches_single_row(params):
+    """decode_step_multi at staggered per-slot positions must equal
+    independent single-row decode_step calls (per-row independence is
+    what the parity guarantee rests on)."""
+    from replicatinggpt_tpu.models.gpt import (decode_step,
+                                               decode_step_multi,
+                                               init_kv_cache)
+    B = 3
+    rng = np.random.default_rng(0)
+    warm = [int(x) for x in rng.integers(2, 7, (B,))]  # per-row warm length
+    toks = rng.integers(0, CFG.vocab_size, (B, 8)).astype(np.int32)
+
+    # single-row references, each warmed to its own position
+    singles = []
+    for b in range(B):
+        cache = init_kv_cache(CFG, 1)
+        for pos in range(warm[b]):
+            logits, cache = decode_step(params, toks[b:b + 1, pos],
+                                        jnp.int32(pos), cache, CFG)
+        singles.append((logits, cache))
+
+    # multi-slot: warm each slot by stepping all slots with per-slot pos
+    cache_m = init_kv_cache(CFG, B)
+    pos = np.zeros((B,), np.int32)
+    logits_m = None
+    for step in range(max(warm)):
+        cur = np.array([toks[b, min(step, warm[b] - 1)] for b in range(B)])
+        step_pos = np.minimum(step, np.array(warm) - 1).astype(np.int32)
+        out, cache_m = decode_step_multi(params, jnp.asarray(cur),
+                                         jnp.asarray(step_pos), cache_m, CFG)
+        if logits_m is None or step == max(warm) - 1:
+            logits_m = out
+    # rows that reached their final position on the last step must match
+    for b in range(B):
+        if warm[b] == max(warm):
+            np.testing.assert_allclose(np.asarray(logits_m[b]),
+                                       np.asarray(singles[b][0][0]),
+                                       atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# slots: free / reuse / cancellation
+# ---------------------------------------------------------------------------
+
+def test_slot_free_and_reuse_after_completion(params):
+    reqs = _requests(5, max_new=6)
+    eng = Engine(params, CFG, EngineConfig(pool_size=2, max_queue=16))
+    for r in reqs:
+        assert eng.submit(r) is None
+    max_used = 0
+    results = []
+    while not eng.idle:
+        results.extend(eng.step())
+        max_used = max(max_used, eng.pool.n_used)
+    assert len(results) == 5
+    assert all(r.finish_reason == FINISH_MAX_TOKENS for r in results)
+    assert max_used == 2                      # pool bound respected
+    assert eng.pool.n_free == 2               # everything released
+    assert eng.metrics.counters["requests_admitted"] == 5
+
+
+def test_cancellation_frees_slot_and_queue(params):
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=4))
+    long_req = Request(id="long", prompt=np.array([1], np.int32),
+                       max_new_tokens=30,
+                       sampling=SamplingParams(greedy=True))
+    queued = Request(id="queued", prompt=np.array([2], np.int32),
+                     max_new_tokens=3, sampling=SamplingParams(greedy=True))
+    assert eng.submit(long_req) is None
+    assert eng.submit(queued) is None
+    for _ in range(3):
+        eng.step()
+    assert eng.pool.slot_of("long") is not None
+    assert eng.cancel("long")
+    assert eng.pool.n_free == 1               # slot freed immediately
+    res = {r.id: r for r in eng.drain()}
+    assert set(res) == {"long", "queued"}
+    assert res["long"].finish_reason == FINISH_CANCELLED
+    assert len(res["long"].tokens) == 3       # partial output preserved
+    assert res["queued"].finish_reason == FINISH_MAX_TOKENS
+    assert len(res["queued"].tokens) == 3
+    # cancelling a queued request removes it before admission
+    eng2 = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=4))
+    assert eng2.submit(long_req) is None
+    assert eng2.submit(queued) is None
+    assert eng2.cancel("queued")
+    res2 = {r.id: r for r in eng2.drain()}
+    assert set(res2) == {"long", "queued"}
+    assert res2["queued"].finish_reason == FINISH_CANCELLED
+    assert res2["queued"].tokens == []
+    assert not eng2.cancel("nonexistent")
+
+
+# ---------------------------------------------------------------------------
+# admission control: backpressure, validation, deadlines, length caps
+# ---------------------------------------------------------------------------
+
+def test_backpressure_rejects_when_queue_full(params):
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=2))
+    reqs = _requests(5, max_new=4)
+    rejected = [r for r in (eng.submit(q) for q in reqs) if r is not None]
+    # slot admission happens at step(), so submit #3..#5 hit a full queue
+    assert len(rejected) == 3
+    assert all(r.finish_reason == REJECT_QUEUE_FULL for r in rejected)
+    assert eng.metrics.counters[REJECT_QUEUE_FULL] == 3
+    accepted = eng.drain()
+    assert len(accepted) == 2
+    assert all(r.finish_reason == FINISH_MAX_TOKENS for r in accepted)
+
+
+def test_prompt_too_long_rejected(params):
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=2))
+    r = eng.submit(Request(id="big",
+                           prompt=np.zeros((CFG.block_size + 1,), np.int32)))
+    assert r is not None and r.finish_reason == REJECT_PROMPT_TOO_LONG
+
+
+def test_deadline_expiry_queued_and_active(params):
+    t = [0.0]
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=4),
+                 clock=lambda: t[0])
+    active = Request(id="active", prompt=np.array([1], np.int32),
+                     max_new_tokens=30, deadline=5.0,
+                     sampling=SamplingParams(greedy=True))
+    queued = Request(id="queued", prompt=np.array([2], np.int32),
+                     max_new_tokens=4, deadline=1.0,
+                     sampling=SamplingParams(greedy=True))
+    assert eng.submit(active) is None
+    assert eng.submit(queued) is None
+    eng.step()                                 # admits 'active' only
+    t[0] = 2.0                                 # queued deadline passes
+    finished = eng.step()
+    assert [r.id for r in finished] == ["queued"]
+    assert finished[0].finish_reason == FINISH_DEADLINE
+    t[0] = 6.0                                 # active deadline passes
+    finished = eng.step()
+    assert [r.id for r in finished] == ["active"]
+    assert finished[0].finish_reason == FINISH_DEADLINE
+    assert eng.pool.n_free == 1
+    assert 0 < len(finished[0].tokens) < 30    # partial output preserved
+
+
+def test_max_new_tokens_and_context_length_cap(params):
+    """A request whose budget exceeds the slot's cache room finishes
+    with the length_cap reason and exactly room = S - P + 1 tokens."""
+    P = CFG.block_size - 4
+    room = CFG.block_size - P + 1
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=2))
+    res = eng.submit(Request(id="cap",
+                             prompt=np.ones((P,), np.int32),
+                             max_new_tokens=100,
+                             sampling=SamplingParams(greedy=True)))
+    assert res is None
+    out = eng.drain()
+    assert out[0].finish_reason == FINISH_LENGTH_CAP
+    assert len(out[0].tokens) == room
+
+
+# ---------------------------------------------------------------------------
+# per-slot sampling params + batched filters
+# ---------------------------------------------------------------------------
+
+def test_mixed_batch_greedy_row_unaffected_by_stochastic_neighbors(params):
+    reqs = _requests(4, greedy=True, max_new=8)
+    want = _offline_greedy(params, reqs)
+    # neighbors with aggressive stochastic settings share the batch
+    noisy = [Request(id=f"n{i}", prompt=np.array([i + 1], np.int32),
+                     max_new_tokens=8,
+                     sampling=SamplingParams(temperature=1.7, top_k=5,
+                                             top_p=0.9), rng_seed=100 + i)
+             for i in range(3)]
+    eng = Engine(params, CFG, EngineConfig(pool_size=4, max_queue=16))
+    for r in (noisy[0], reqs[0], noisy[1], reqs[1], reqs[2], noisy[2],
+              reqs[3]):
+        assert eng.submit(r) is None
+    got = {r.id: r.tokens for r in eng.drain()}
+    for rid, toks in want.items():
+        assert got[rid] == toks
+    for n in noisy:                          # stochastic rows still valid
+        assert len(got[n.id]) == 8
+        assert all(0 <= t < CFG.vocab_size for t in got[n.id])
+
+
+def test_stochastic_request_reproducible_by_seed(params):
+    """A request's sampled stream is keyed by its own rng_seed — same
+    seed twice gives the same tokens, independent of slot/batch."""
+    def run(pool):
+        eng = Engine(params, CFG, EngineConfig(pool_size=pool, max_queue=8))
+        reqs = [Request(id=f"s{i}", prompt=np.array([7], np.int32),
+                        max_new_tokens=10,
+                        sampling=SamplingParams(temperature=0.9, top_k=12),
+                        rng_seed=42 + i) for i in range(3)]
+        for r in reqs:
+            eng.submit(r)
+        return {r.id: r.tokens for r in eng.drain()}
+
+    a, b = run(pool=3), run(pool=1)          # different batching, same seeds
+    assert a == b
+
+
+def test_batched_filters_match_scalar_filters():
+    from replicatinggpt_tpu.sample.generate import (batched_top_k_filter,
+                                                    batched_top_p_filter,
+                                                    _top_k_filter,
+                                                    _top_p_filter)
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(4, 200)), jnp.float32)
+    # per-row k: rows 0/1 filtered at different k, row 2 off (0), row 3 off (>=V)
+    k = jnp.asarray([5, 50, 0, 200], jnp.int32)
+    got = np.asarray(batched_top_k_filter(logits, k))
+    np.testing.assert_array_equal(got[0], np.asarray(
+        _top_k_filter(logits[:1], 5))[0])
+    np.testing.assert_array_equal(got[1], np.asarray(
+        _top_k_filter(logits[1:2], 50))[0])
+    np.testing.assert_array_equal(got[2], np.asarray(logits[2]))  # passthrough
+    np.testing.assert_array_equal(got[3], np.asarray(logits[3]))
+    p = jnp.asarray([0.3, 0.9, 0.0, 1.0], jnp.float32)
+    got = np.asarray(batched_top_p_filter(logits, p))
+    np.testing.assert_array_equal(got[0], np.asarray(
+        _top_p_filter(logits[:1], 0.3))[0])
+    np.testing.assert_array_equal(got[1], np.asarray(
+        _top_p_filter(logits[1:2], 0.9))[0])
+    np.testing.assert_array_equal(got[2], np.asarray(logits[2]))
+    np.testing.assert_array_equal(got[3], np.asarray(logits[3]))
+
+
+# ---------------------------------------------------------------------------
+# steady state: zero recompiles + metrics (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_steady_state_64_requests_zero_recompiles(params):
+    """>= 64 requests through a pool of 8 (smaller than the request
+    count): completes, reports TTFT/tok-s/occupancy, and compiles ZERO
+    new programs after the warmup request."""
+    ecfg = EngineConfig(pool_size=8, max_queue=64)
+    warm = Engine(params, CFG, ecfg)
+    warm.submit(Request(id="w", prompt=np.array([1], np.int32),
+                        max_new_tokens=2,
+                        sampling=SamplingParams(greedy=True)))
+    warm.drain()
+    baseline = compile_counts()
+
+    eng = Engine(params, CFG, ecfg)
+    reqs = _requests(64, greedy=False, seed=9, max_new=6)
+    for r in reqs:
+        assert eng.submit(r) is None
+    results = eng.drain()
+    assert compile_counts() == baseline       # zero recompiles at steady state
+    assert len(results) == 64
+    assert all(r.finish_reason == FINISH_MAX_TOKENS for r in results)
+    s = eng.metrics_summary()
+    assert s["histograms"]["ttft_s"]["n"] == 64
+    assert s["histograms"]["ttft_s"]["p50"] > 0
+    assert s["histograms"]["decode_tokens_per_s"]["p50"] > 0
+    assert 0 < s["histograms"]["batch_fill_ratio"]["mean"] <= 1
+    assert s["step_latency"]["p50_s"] > 0
+    assert s["counters"]["decode_tokens"] == 64 * 6
+
+
+# ---------------------------------------------------------------------------
+# unit: scheduler + cache pool
+# ---------------------------------------------------------------------------
+
+def test_scheduler_bounds_and_fifo():
+    sch = Scheduler(max_queue=2, block_size=8, clock=lambda: 0.0)
+    a = Request(id="a", prompt=np.array([1], np.int32))
+    b = Request(id="b", prompt=np.array([1], np.int32))
+    c = Request(id="c", prompt=np.array([1], np.int32))
+    assert sch.submit(a) is None and sch.submit(b) is None
+    assert sch.submit(c) == REJECT_QUEUE_FULL
+    admitted, dropped = sch.admit(n_free=1)
+    assert [r.id for r, _ in admitted] == ["a"] and not dropped
+    assert sch.depth == 1
+    assert sch.cancel("b") and not sch.cancel("b")
+
+
+def test_cache_pool_acquire_release():
+    pool = CachePool(CFG, n_slots=2)
+    s0, s1 = pool.acquire("a"), pool.acquire("b")
+    assert {s0, s1} == {0, 1} and pool.acquire("c") is None
+    assert pool.occupancy == 1.0 and pool.slot_of("b") == s1
+    pool.release(s0)
+    assert pool.n_free == 1 and pool.owner(s0) is None
+    assert pool.acquire("c") == s0            # freed slot is reused
+    pool.release(s1)
+    with pytest.raises(AssertionError):
+        pool.release(s1)                      # double free caught
+
+
+# ---------------------------------------------------------------------------
+# replay driver + CLI smoke (tier-1) and soak (slow)
+# ---------------------------------------------------------------------------
+
+def test_serve_replay_smoke(params):
+    """Tiny replay through the public driver: everything completes,
+    metrics summary is well-formed, zero recompiles after warmup."""
+    s = run_replay(params, CFG,
+                   ReplayConfig(n_requests=16, rate=2000.0, seed=0,
+                                prompt_len_max=12, max_new_tokens=5,
+                                greedy=True),
+                   EngineConfig(pool_size=4, max_queue=32))
+    assert s["n_completed"] == 16
+    assert s["recompiles_after_warmup"] == 0
+    assert s["generated_tokens"] == 16 * 5
+    assert s["aggregate_tokens_per_s"] > 0
+
+
+def test_serve_replay_cli_smoke(capsys):
+    from replicatinggpt_tpu.cli import main
+    rc = main(["serve-replay", "--preset", "test-tiny", "--n-requests",
+               "16", "--pool-size", "4", "--rate", "2000",
+               "--request-max-new-tokens", "4", "--greedy"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "16 completed" in out
+    assert "recompiles after warmup: 0" in out
+    assert "TTFT" in out
+
+
+@pytest.mark.slow
+def test_serve_replay_soak(params):
+    """Longer mixed soak: 200 stochastic requests with deadlines through
+    a small pool — no leaks (pool fully free), queue drained, every
+    request resolved exactly once."""
+    s = run_replay(params, CFG,
+                   ReplayConfig(n_requests=200, rate=3000.0, seed=5,
+                                prompt_len_max=16, max_new_tokens=10,
+                                temperature=0.9, top_k=10),
+                   EngineConfig(pool_size=6, max_queue=256))
+    assert s["n_requests"] == 200
+    # every request resolved exactly once (queue deep enough: no rejects)
+    assert s["n_completed"] + s["n_rejected"] == 200
+    assert s["recompiles_after_warmup"] == 0
+    assert s["histograms"]["ttft_s"]["n"] == 200 - s["n_rejected"]
