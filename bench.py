@@ -56,6 +56,10 @@ def emit(payload: dict) -> None:
         if _EMITTED:
             return
         _EMITTED = True
+    if os.environ.get("GRAFT_SANITIZE", "") not in ("", "0"):
+        # sanitized runs pay for leak/NaN checks — never comparable to
+        # (or mistakable for) a real measurement
+        payload = {**payload, "sanitize": True}
     print(json.dumps(payload), flush=True)
 
 
@@ -751,6 +755,11 @@ def main() -> None:
                         "JAX_PLATFORMS env var is overridden by PJRT "
                         "plugins in some environments — this flag uses "
                         "jax.config, which always wins")
+    p.add_argument("--sanitize", action="store_true",
+                   help="run the benched mode under GRAFT_SANITIZE "
+                        "(jax tracer-leak + NaN checks; numbers are NOT "
+                        "comparable to unsanitized runs — the JSON "
+                        "artifact is tagged sanitize=true)")
     p.add_argument("--probe-tries", type=int, default=5)
     p.add_argument("--probe-wait", type=float, default=60.0)
     p.add_argument("--watchdog", type=float, default=1500.0,
@@ -780,18 +789,29 @@ def main() -> None:
         if args.platform:
             jax.config.update("jax_platforms", args.platform)
         jax.config.update("jax_default_prng_impl", args.rng_impl)
-        if args.mode == "generate":
-            bench_generate(args)
-        elif args.mode == "longctx":
-            bench_longctx(args)
-        elif args.mode == "kernel":
-            bench_kernel(args)
-        elif args.mode == "decode":
-            bench_decode_sweep(args)
-        elif args.mode == "serve":
-            bench_serve(args)
-        else:
-            bench_train(args)
+        import contextlib
+        san = contextlib.nullcontext()
+        if args.sanitize:
+            # env first so Engine/runner construction sees it; the
+            # context flips jax's leak/NaN checks for the whole mode
+            os.environ["GRAFT_SANITIZE"] = "1"
+            from replicatinggpt_tpu.utils.sanitize import sanitized
+            san = sanitized(True)
+            log("GRAFT_SANITIZE: tracer-leak + NaN checks on (numbers "
+                "not comparable to unsanitized runs)")
+        with san:
+            if args.mode == "generate":
+                bench_generate(args)
+            elif args.mode == "longctx":
+                bench_longctx(args)
+            elif args.mode == "kernel":
+                bench_kernel(args)
+            elif args.mode == "decode":
+                bench_decode_sweep(args)
+            elif args.mode == "serve":
+                bench_serve(args)
+            else:
+                bench_train(args)
     except BaseException as e:  # noqa: BLE001 — artifact must still emit
         log(f"bench failed: {e!r}")
         emit(error_payload(metric, unit, repr(e)))

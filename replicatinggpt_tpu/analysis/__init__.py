@@ -1,0 +1,18 @@
+"""graftlint: JAX-hazard static analysis (pure AST — no jax import).
+
+``python -m replicatinggpt_tpu lint`` is the entry point; see
+docs/graftlint_rules.md for the rule reference and
+utils/sanitize.py for the runtime half (CompileGuard, donation checks,
+GRAFT_SANITIZE mode).
+"""
+
+from .baseline import (DEFAULT_BASELINE, diff_against_baseline,
+                       finding_key, load_baseline, write_baseline)
+from .docgen import render_rule_docs
+from .linter import LintResult, lint_paths, lint_source
+from .rules import RULES, Finding, Rule, all_rule_ids
+
+__all__ = ["DEFAULT_BASELINE", "Finding", "LintResult", "RULES", "Rule",
+           "all_rule_ids", "diff_against_baseline", "finding_key",
+           "lint_paths", "lint_source", "load_baseline",
+           "render_rule_docs", "write_baseline"]

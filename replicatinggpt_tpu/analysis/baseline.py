@@ -1,0 +1,83 @@
+"""Committed lint baseline: pre-existing findings that must not block CI.
+
+A new static analyzer over an existing ~8.6k-line package always finds
+things; blocking every PR on a full cleanup guarantees the tool gets
+turned off. Instead the accepted findings are frozen into
+``graftlint_baseline.json`` and ``lint --baseline`` fails only on NEW
+findings. Fixing a baselined finding then requires refreshing the file
+(``lint --write-baseline``) — the tier-1 test asserts the committed
+baseline matches a fresh whole-package run exactly, so it can go stale
+in neither direction.
+
+Baseline entries key on ``(path, rule, stripped source line)`` with
+multiplicity — line numbers are recorded for humans but ignored for
+matching, so findings survive unrelated edits that shift lines.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from .linter import REPO_ROOT
+from .rules import Finding
+
+DEFAULT_BASELINE = REPO_ROOT / "graftlint_baseline.json"
+
+Key = Tuple[str, str, str]
+
+
+def finding_key(f: Finding) -> Key:
+    return (f.path, f.rule, f.text)
+
+
+def write_baseline(findings: Sequence[Finding], path: Path) -> None:
+    entries = [{"path": f.path, "rule": f.rule, "line": f.line,
+                "text": f.text}
+               for f in sorted(findings,
+                               key=lambda f: (f.path, f.line, f.rule))]
+    Path(path).write_text(json.dumps(
+        {"version": 1, "tool": "graftlint", "findings": entries},
+        indent=1) + "\n")
+
+
+def load_baseline(path: Path) -> Counter:
+    data = json.loads(Path(path).read_text())
+    return Counter((e["path"], e["rule"], e["text"])
+                   for e in data.get("findings", []))
+
+
+@dataclass
+class BaselineDiff:
+    new: List[Finding]        # findings not covered by the baseline
+    matched: int              # findings absorbed by the baseline
+    stale: List[Key]          # baseline entries with no current finding
+
+    @property
+    def clean(self) -> bool:
+        return not self.new
+
+    @property
+    def exact(self) -> bool:
+        """True when current findings == baseline exactly (the tier-1
+        staleness assertion, stronger than `clean`)."""
+        return not self.new and not self.stale
+
+
+def diff_against_baseline(findings: Sequence[Finding],
+                          baseline: Counter) -> BaselineDiff:
+    budget: Dict[Key, int] = dict(baseline)
+    new: List[Finding] = []
+    matched = 0
+    for f in findings:
+        k = finding_key(f)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            matched += 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in budget.items() for _ in range(n))
+    return BaselineDiff(new=new, matched=matched, stale=stale)

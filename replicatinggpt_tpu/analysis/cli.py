@@ -1,0 +1,123 @@
+"""The ``lint`` subcommand (wired into replicatinggpt_tpu.cli).
+
+Fast and CPU-only by construction — the analysis package never imports
+jax — so it runs as a tier-1 gate. Default invocation lints the
+package against the committed baseline (exit 1 on any NEW finding);
+``--write-baseline`` refreshes the committed file after a reviewed
+change; ``--docs`` regenerates the rule reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List
+
+from .baseline import (DEFAULT_BASELINE, diff_against_baseline,
+                       load_baseline, write_baseline)
+from .docgen import render_rule_docs
+from .linter import lint_paths
+from .rules import RULES, Finding
+
+
+def add_lint_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("paths", nargs="*", default=[],
+                   help="files/dirs to lint (default: the "
+                        "replicatinggpt_tpu package)")
+    p.add_argument("--baseline", nargs="?", const=str(DEFAULT_BASELINE),
+                   default=None, metavar="PATH",
+                   help="compare against a committed baseline; fail only "
+                        "on NEW findings (default path: "
+                        "graftlint_baseline.json; auto-applied for a "
+                        "bare package lint when the file exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding even when the committed "
+                        "baseline exists")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings as the new baseline")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--docs", action="store_true",
+                   help="print the generated rule reference (markdown) "
+                        "and exit")
+
+
+def _print_findings(findings: List[Finding], stream=None) -> None:
+    stream = stream or sys.stdout
+    for f in findings:
+        print(f.format(), file=stream)
+
+
+def run_lint(args) -> int:
+    if args.docs:
+        print(render_rule_docs(), end="")
+        return 0
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid].name}")
+        return 0
+    rule_ids = ([r.strip().upper() for r in args.rules.split(",")]
+                if args.rules else ())
+    for r in rule_ids:
+        if r not in RULES:
+            print(f"unknown rule {r!r} (see --list-rules)", file=sys.stderr)
+            return 2
+    res = lint_paths(args.paths, rule_ids)
+
+    baseline_path = args.baseline
+    if (baseline_path is None and not args.no_baseline and not args.paths
+            and not args.write_baseline and DEFAULT_BASELINE.exists()):
+        # bare `lint` over the package: the committed baseline is the
+        # contract (the acceptance criterion's "runs clean" mode)
+        baseline_path = str(DEFAULT_BASELINE)
+    if args.no_baseline:
+        baseline_path = None
+
+    if args.write_baseline:
+        out = Path(args.baseline or DEFAULT_BASELINE)
+        write_baseline(res.findings, out)
+        print(f"wrote {len(res.findings)} finding(s) to {out}")
+        return 0
+
+    if baseline_path is None:
+        if args.format == "json":
+            print(json.dumps({
+                "files": res.files,
+                "findings": [vars(f) for f in res.findings],
+                "suppressed": [vars(f) for f in res.suppressed],
+            }))
+        else:
+            _print_findings(res.findings)
+            print(f"graftlint: {len(res.findings)} finding(s), "
+                  f"{len(res.suppressed)} suppressed, {res.files} file(s)",
+                  file=sys.stderr)
+        return 1 if res.findings else 0
+
+    diff = diff_against_baseline(res.findings, load_baseline(baseline_path))
+    if args.format == "json":
+        # the diffed view IS the result under a baseline: `findings`
+        # holds only NEW hazards (matching the exit code); baselined
+        # ones are a count, stale entries listed for refresh tooling
+        print(json.dumps({
+            "files": res.files,
+            "findings": [vars(f) for f in diff.new],
+            "baselined": diff.matched,
+            "stale": [list(k) for k in diff.stale],
+            "suppressed": [vars(f) for f in res.suppressed],
+        }))
+    else:
+        _print_findings(diff.new)
+        for key in diff.stale:
+            print(f"stale baseline entry (finding fixed? refresh with "
+                  f"--write-baseline): {key[0]}: {key[1]}: {key[2]}",
+                  file=sys.stderr)
+        print(f"graftlint: {len(diff.new)} new finding(s), "
+              f"{diff.matched} baselined, {len(diff.stale)} stale, "
+              f"{len(res.suppressed)} suppressed, {res.files} file(s)",
+              file=sys.stderr)
+    return 1 if diff.new else 0

@@ -1,0 +1,61 @@
+"""Rule reference generated from the registry (docs/graftlint_rules.md).
+
+One source of truth: a rule's ID, rationale, and examples live on its
+``Rule`` entry in rules.py; this renderer turns the registry into the
+committed markdown reference, and tests/test_lint.py asserts the
+committed file matches a fresh render — docs cannot drift from code.
+Regenerate with ``python -m replicatinggpt_tpu lint --docs >
+docs/graftlint_rules.md``.
+"""
+
+from __future__ import annotations
+
+from .rules import RULES
+
+_HEADER = """\
+# graftlint rule reference
+
+<!-- GENERATED from replicatinggpt_tpu/analysis/rules.py — do not edit
+     by hand. Regenerate:
+     python -m replicatinggpt_tpu lint --docs > docs/graftlint_rules.md -->
+
+`graftlint` is this package's JAX-hazard static analyzer: pure-AST
+checks for the failure modes that cost TPU time or corrupt results
+without crashing — silent recompiles, host stalls in hot loops, RNG
+reuse, `dynamic_update_slice` clamp corruption. Run it with:
+
+```
+python -m replicatinggpt_tpu lint                  # package vs baseline
+python -m replicatinggpt_tpu lint path/to/file.py  # specific files
+python -m replicatinggpt_tpu lint --write-baseline # refresh the baseline
+python -m replicatinggpt_tpu lint --format json    # machine-readable
+```
+
+Suppression, in precedence order:
+
+1. fix the hazard (preferred);
+2. `# graftlint: disable=GL004` on the flagged line (or
+   `disable=GL004,GL006`, or `disable=all`) for a reviewed,
+   intentional exception — leave a comment saying why;
+3. `# graftlint: disable-file=GL002` anywhere in a file;
+4. the committed `graftlint_baseline.json` absorbs pre-existing
+   findings; `lint --baseline` (the tier-1 gate) fails only on NEW
+   ones. The tier-1 test also asserts the baseline exactly matches a
+   fresh run, so fixing a baselined finding requires
+   `--write-baseline`.
+
+`GL000` (not listed below) reports files that fail to parse.
+
+"""
+
+
+def render_rule_docs() -> str:
+    parts = [_HEADER]
+    for rid in sorted(RULES):
+        r = RULES[rid]
+        parts.append(f"## {r.id} — `{r.name}`\n\n"
+                     f"{r.rationale}\n\n"
+                     f"**Flagged:**\n\n```python\n{r.bad}```\n\n"
+                     f"**Clean:**\n\n```python\n{r.good}```\n\n"
+                     f"Suppress with `# graftlint: disable={r.id}`.\n")
+    return "\n".join(parts)

@@ -1,0 +1,124 @@
+"""graftlint driver: file discovery, pragma handling, rule dispatch.
+
+Pure host Python (no jax import): parse each file once, run every
+registered rule over the tree, then drop findings suppressed by
+pragmas. Two pragma forms:
+
+- line-level: ``x = risky()  # graftlint: disable=GL004`` (or
+  ``disable=GL004,GL006`` / ``disable=all``) — suppresses findings
+  REPORTED on that line (for a multi-line statement, the line where it
+  starts);
+- file-level: ``# graftlint: disable-file=GL002`` anywhere in the file.
+
+Suppressed findings are counted, not discarded silently — ``lint
+--format json`` reports them so a pragma audit stays possible.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from .rules import RULES, Finding
+
+#: repo root when running from a checkout (analysis/ -> package -> root)
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_TARGET = Path(__file__).resolve().parents[1]
+
+_PRAGMA = re.compile(r"#\s*graftlint:\s*(disable(?:-file)?)\s*=\s*"
+                     r"([A-Za-z0-9_,\s]+)")
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    files: int = 0
+
+    def extend(self, other: "LintResult") -> None:
+        self.findings.extend(other.findings)
+        self.suppressed.extend(other.suppressed)
+        self.files += other.files
+
+
+def _parse_pragmas(lines: Sequence[str]) -> Tuple[Dict[int, Set[str]],
+                                                  Set[str]]:
+    """(line -> disabled rule ids, file-wide disabled ids). 'all' means
+    every rule."""
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    for i, line in enumerate(lines, start=1):
+        m = _PRAGMA.search(line)
+        if not m:
+            continue
+        ids = {tok.strip().upper() for tok in m.group(2).split(",")
+               if tok.strip()}
+        if "ALL" in ids:
+            ids = set(RULES) | {"ALL"}
+        if m.group(1) == "disable-file":
+            per_file |= ids
+        else:
+            per_line.setdefault(i, set()).update(ids)
+    return per_line, per_file
+
+
+def lint_source(source: str, path: str,
+                rule_ids: Sequence[str] = ()) -> LintResult:
+    """Lint one file's source text. ``path`` is the label findings carry
+    (callers pass repo-relative paths so baselines are portable)."""
+    res = LintResult(files=1)
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        res.findings.append(Finding(
+            path=path, rule="GL000", line=e.lineno or 1, col=e.offset or 0,
+            message=f"syntax error: {e.msg}",
+            text=(e.text or "").strip()))
+        return res
+    per_line, per_file = _parse_pragmas(lines)
+    active = [RULES[r] for r in (rule_ids or sorted(RULES))]
+    found: List[Finding] = []
+    for rule in active:
+        found.extend(rule.checker(tree, lines, path))
+    for f in sorted(found, key=lambda f: (f.line, f.col, f.rule)):
+        if f.rule in per_file or f.rule in per_line.get(f.line, set()):
+            res.suppressed.append(f)
+        else:
+            res.findings.append(f)
+    return res
+
+
+def iter_python_files(paths: Iterable[Path]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(q for q in p.rglob("*.py")
+                              if "__pycache__" not in q.parts))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def rel_label(path: Path) -> str:
+    """Repo-relative, forward-slash label for a file (falls back to the
+    absolute path outside the checkout) — the identity baselines key on."""
+    p = Path(path).resolve()
+    try:
+        return p.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def lint_paths(paths: Sequence = (),
+               rule_ids: Sequence[str] = ()) -> LintResult:
+    """Lint files/directories (default: the replicatinggpt_tpu package)."""
+    targets = [Path(p) for p in paths] or [DEFAULT_TARGET]
+    res = LintResult()
+    for f in iter_python_files(targets):
+        res.extend(lint_source(f.read_text(), rel_label(f), rule_ids))
+    return res

@@ -1,0 +1,947 @@
+"""graftlint rule registry: JAX hazards as pure-AST passes.
+
+Every expensive JAX failure mode this package has hit by hand — silent
+recompiles from tracer-dependent Python control flow, retained donated
+buffers, RNG key reuse, per-step host round-trips, the
+``dynamic_update_slice`` clamp corruption PR 1 debugged in the serving
+prefill — leaves a recognizable syntactic footprint. These rules match
+those footprints with ``ast`` only: no jax import, no tracing, no
+device, so ``python -m replicatinggpt_tpu lint`` is a sub-second
+CPU-only tier-1 check.
+
+Each rule is registered with an ID, a rationale, and a bad/good example
+pair; ``docgen.render_rule_docs`` turns the registry into
+``docs/graftlint_rules.md`` and ``tests/test_lint.py`` parametrizes
+over it, so a rule cannot exist without docs and fixture coverage.
+
+Suppression: ``# graftlint: disable=GL004`` on the flagged line, or
+``# graftlint: disable-file=GL004`` anywhere in the file (see
+linter.py); pre-existing findings live in the committed baseline
+(baseline.py) so the lint gate only fails on NEW hazards.
+
+Static analysis over a dynamic language is heuristic by construction:
+the rules are tuned to the idioms of this codebase (decorator-jitted
+functions, ``partial(jax.jit, ...)``, module-level jits) and prefer
+missing an exotic spelling over drowning real findings in noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# data model
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit. ``text`` is the stripped source line — the baseline
+    matches on (path, rule, text) rather than line numbers, so findings
+    survive unrelated edits that shift lines."""
+
+    path: str
+    rule: str
+    line: int
+    col: int
+    message: str
+    text: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    rationale: str
+    bad: str
+    good: str
+    checker: Callable[[ast.Module, Sequence[str], str], List[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def _register(rule: Rule) -> Rule:
+    assert rule.id not in RULES, f"duplicate rule id {rule.id}"
+    RULES[rule.id] = rule
+    return rule
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.dynamic_update_slice' for a Name/Attribute chain, else
+    None (calls, subscripts etc. in the chain give up)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JIT_WRAPPERS = {"jax.jit", "jit", "pjit", "jax.pmap", "pmap",
+                 "jax.experimental.pjit.pjit"}
+_PARTIAL = {"functools.partial", "partial"}
+
+
+def _line_of(node: ast.AST, lines: Sequence[str]) -> str:
+    i = getattr(node, "lineno", 1) - 1
+    return lines[i].strip() if 0 <= i < len(lines) else ""
+
+
+def _finding(rule_id: str, node: ast.AST, message: str, path: str,
+             lines: Sequence[str]) -> Finding:
+    return Finding(path=path, rule=rule_id, line=node.lineno,
+                   col=node.col_offset, message=message,
+                   text=_line_of(node, lines))
+
+
+def _jit_wrap_call(node: ast.AST) -> Optional[ast.Call]:
+    """The jax.jit(...) Call under ``node`` when node is a jit wrapper
+    expression: ``jax.jit``, ``jax.jit(...)``, or
+    ``partial(jax.jit, ...)``. None otherwise."""
+    if isinstance(node, ast.Call):
+        f = dotted(node.func)
+        if f in _JIT_WRAPPERS:
+            return node
+        if f in _PARTIAL and node.args and dotted(node.args[0]) in _JIT_WRAPPERS:
+            return node
+    return None
+
+
+def _is_jit_wrapper(node: ast.AST) -> bool:
+    return (dotted(node) in _JIT_WRAPPERS) or _jit_wrap_call(node) is not None
+
+
+def _jit_kwargs(node: ast.AST) -> Dict[str, ast.expr]:
+    call = _jit_wrap_call(node)
+    if call is None:
+        return {}
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _const_str_items(node: Optional[ast.expr]) -> List[str]:
+    """String elements of a tuple/list/str constant expression."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _const_int_items(node: Optional[ast.expr]) -> List[int]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _static_param_names(fn: ast.FunctionDef,
+                        kwargs: Dict[str, ast.expr]) -> set:
+    static = set(_const_str_items(kwargs.get("static_argnames")))
+    params = _param_names(fn)
+    for i in _const_int_items(kwargs.get("static_argnums")):
+        if 0 <= i < len(params):
+            static.add(params[i])
+    return static
+
+
+def _jit_decorator(fn: ast.FunctionDef) -> Optional[ast.AST]:
+    for dec in fn.decorator_list:
+        if _is_jit_wrapper(dec):
+            return dec
+    return None
+
+
+def _top_level_functions(tree: ast.Module) -> List[ast.FunctionDef]:
+    """Module-level and method-level defs (nested defs analyzed as part
+    of their parent, not separately — guards in the outer scope bless
+    the whole lexical function)."""
+    out: List[ast.FunctionDef] = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(node)
+        elif isinstance(node, ast.ClassDef):
+            out.extend(n for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)))
+    return out
+
+
+def _all_functions(tree: ast.Module) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+# ---------------------------------------------------------------------------
+# GL001 — tracer-dependent Python control flow in jitted functions
+# ---------------------------------------------------------------------------
+
+def _check_tracer_branch(tree, lines, path):
+    findings = []
+    for fn in _all_functions(tree):
+        dec = _jit_decorator(fn)
+        if dec is None:
+            continue
+        static = _static_param_names(fn, _jit_kwargs(dec))
+        traced = {n for n in _param_names(fn) if n not in static} - {"self"}
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            test = node.test
+            # `x is None` / `x is not None` on a traced name is a static
+            # Python identity check, not a tracer branch
+            if (isinstance(test, ast.Compare)
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in test.ops)):
+                continue
+            used = {n.id for n in ast.walk(test) if isinstance(n, ast.Name)}
+            hit = sorted(used & traced)
+            if hit:
+                kw = "while" if isinstance(node, ast.While) else "if"
+                findings.append(_finding(
+                    "GL001", node,
+                    f"Python `{kw}` on traced argument(s) {', '.join(hit)} "
+                    f"inside jitted `{fn.name}` — branches on tracers raise "
+                    f"ConcretizationTypeError or silently retrace per value; "
+                    f"use jnp.where/lax.cond or mark the arg static",
+                    path, lines))
+    return findings
+
+
+_register(Rule(
+    id="GL001", name="tracer-branch",
+    rationale=(
+        "Python `if`/`while` on a traced value inside a jitted function "
+        "either crashes (ConcretizationTypeError) or — when the value is "
+        "accidentally concrete, e.g. a host scalar passed per step — "
+        "recompiles the program for every distinct value. Recompiles are "
+        "the top TPU-time sink in the pjit scaling postmortems this repo "
+        "is built on."),
+    bad="""\
+@jax.jit
+def step(x, n):
+    if n > 0:            # n is traced: retrace/crash
+        x = x * n
+    return x
+""",
+    good="""\
+@partial(jax.jit, static_argnames=("n",))
+def step(x, n):
+    if n > 0:            # n is a static (hashable) Python value
+        x = x * n
+    return x
+# ...or keep n traced and branch on device: jnp.where(n > 0, x * n, x)
+""",
+    checker=_check_tracer_branch))
+
+
+# ---------------------------------------------------------------------------
+# GL002 — device computation at module import time
+# ---------------------------------------------------------------------------
+
+_GL002_PREFIXES = ("jnp.", "jax.numpy.", "jax.random.")
+_GL002_EXACT = {"jax.device_put"}
+
+
+def _gl002_call_hit(call: ast.Call) -> bool:
+    f = dotted(call.func)
+    if f is None:
+        return False
+    return f in _GL002_EXACT or any(f.startswith(p) for p in _GL002_PREFIXES)
+
+
+def _check_module_scope_jnp(tree, lines, path):
+    findings = []
+
+    def scan(node):
+        """Walk expressions evaluated at import time, skipping function
+        and lambda BODIES (their defaults/decorators DO evaluate at
+        import and are scanned)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                scan(d)
+            for default in (*node.args.defaults, *node.args.kw_defaults):
+                if default is not None:
+                    scan(default)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Call) and _gl002_call_hit(node):
+            findings.append(_finding(
+                "GL002", node,
+                f"`{dotted(node.func)}(...)` runs at module import: it "
+                f"allocates device memory / compiles before any jit, on "
+                f"whatever backend import-time default is, and once per "
+                f"process — build arrays inside the jitted fn or lazily",
+                path, lines))
+        for child in ast.iter_child_nodes(node):
+            scan(child)
+
+    for stmt in tree.body:
+        scan(stmt)
+    return findings
+
+
+_register(Rule(
+    id="GL002", name="module-scope-device-call",
+    rationale=(
+        "A `jnp.*` / `jax.random.*` call at module scope executes during "
+        "import: it initializes the backend early (breaking later "
+        "platform/flag configuration), allocates device memory that "
+        "lives for the process, and runs eagerly un-jitted. Constants "
+        "built this way also become committed arrays whose placement "
+        "can split jit cache keys."),
+    bad="""\
+import jax.numpy as jnp
+MASK = jnp.tril(jnp.ones((1024, 1024)))   # device alloc at import
+""",
+    good="""\
+import numpy as np
+MASK = np.tril(np.ones((1024, 1024)))     # host constant; or build
+                                          # inside the jitted function
+""",
+    checker=_check_module_scope_jnp))
+
+
+# ---------------------------------------------------------------------------
+# GL003 — PRNG key reuse (>= 2 consumers without split)
+# ---------------------------------------------------------------------------
+
+_KEY_SOURCES = {"jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+                "jax.random.fold_in", "random.PRNGKey", "random.split",
+                "random.fold_in"}
+_KEY_DERIVERS = {"jax.random.split", "jax.random.fold_in", "random.split",
+                 "random.fold_in", "jax.random.clone"}
+
+
+def _is_key_source(node: ast.expr) -> bool:
+    if isinstance(node, ast.Call) and dotted(node.func) in _KEY_SOURCES:
+        return True
+    if isinstance(node, ast.Subscript):   # keys[0] of a split
+        return _is_key_source(node.value)
+    return False
+
+
+class _KeyReuseScanner:
+    """Linear, source-order walk of one function body. Tracks names
+    bound to PRNG keys; any call consuming a key name (except
+    split/fold_in derivation) counts one use — two uses without an
+    intervening rebind is reuse. A consumption inside a loop deeper
+    than the key's binding counts twice (the classic per-iteration
+    reuse)."""
+
+    def __init__(self, fn, lines, path):
+        self.fn, self.lines, self.path = fn, lines, path
+        self.keys: Dict[str, dict] = {}      # name -> {depth, uses}
+        self.findings: List[Finding] = []
+        self.depth = 0
+
+    def run(self):
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+        return self.findings
+
+    def _bind(self, name: str, value: Optional[ast.expr]):
+        if value is not None and _is_key_source(value):
+            self.keys[name] = {"depth": self.depth, "uses": 0,
+                               "flagged": False}
+        else:
+            self.keys.pop(name, None)
+
+    def _targets(self, target: ast.expr, value: Optional[ast.expr]):
+        if isinstance(target, ast.Name):
+            self._bind(target.id, value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                if isinstance(e, ast.Name):
+                    # tuple-unpack of a split: every element is a key
+                    self._bind(e.id, value)
+
+    def _consume(self, call: ast.Call):
+        f = dotted(call.func)
+        derive = f in _KEY_DERIVERS
+        for arg in (*call.args, *(kw.value for kw in call.keywords)):
+            if isinstance(arg, ast.Name) and arg.id in self.keys:
+                rec = self.keys[arg.id]
+                if derive:
+                    continue
+                rec["uses"] += 2 if self.depth > rec["depth"] else 1
+                if rec["uses"] >= 2 and not rec["flagged"]:
+                    rec["flagged"] = True
+                    self.findings.append(_finding(
+                        "GL003", call,
+                        f"PRNG key `{arg.id}` consumed more than once "
+                        f"without jax.random.split — every consumer sees "
+                        f"the SAME randomness (correlated samples); split "
+                        f"or fold_in a fresh key per consumer",
+                        self.path, self.lines))
+
+    def _expr(self, node: ast.AST):
+        for call in ast.walk(node):
+            if isinstance(call, ast.Call):
+                self._consume(call)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            for t in stmt.targets:
+                self._targets(t, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                self._bind(stmt.target.id, stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                self.keys.pop(stmt.target.id, None)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            self.depth += 1
+            self._targets(stmt.target, stmt.iter)
+            for s in (*stmt.body, *stmt.orelse):
+                self._stmt(s)
+            self.depth -= 1
+        elif isinstance(stmt, ast.While):
+            self._expr(stmt.test)
+            self.depth += 1
+            for s in (*stmt.body, *stmt.orelse):
+                self._stmt(s)
+            self.depth -= 1
+        elif isinstance(stmt, ast.If):
+            self._expr(stmt.test)
+            # branches are mutually exclusive: walk each from the same
+            # pre-branch state and keep the worst-case use count per key
+            # (a consumer in `if` plus one in `else` is NOT reuse)
+            snap = {n: dict(rec) for n, rec in self.keys.items()}
+            for s in stmt.body:
+                self._stmt(s)
+            after_body = self.keys
+            self.keys = snap
+            for s in stmt.orelse:
+                self._stmt(s)
+            # a body that cannot fall through (return/raise) contributes
+            # nothing to the statements after the If — the fall-through
+            # path IS the implicit else
+            terminal = (ast.Return, ast.Raise, ast.Continue, ast.Break)
+            if stmt.body and isinstance(stmt.body[-1], terminal):
+                return
+            for n, rec in after_body.items():
+                if n in self.keys:
+                    cur = self.keys[n]
+                    cur["uses"] = max(cur["uses"], rec["uses"])
+                    cur["flagged"] = cur["flagged"] or rec["flagged"]
+                else:
+                    self.keys[n] = rec
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+            for s in stmt.body:
+                self._stmt(s)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            pass                    # nested defs get their own scan
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+        elif isinstance(stmt, ast.Try):
+            for s in (*stmt.body, *stmt.orelse, *stmt.finalbody):
+                self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+        else:
+            self._expr(stmt)
+
+
+def _check_key_reuse(tree, lines, path):
+    findings = []
+    for fn in _all_functions(tree):
+        findings.extend(_KeyReuseScanner(fn, lines, path).run())
+    return findings
+
+
+_register(Rule(
+    id="GL003", name="rng-key-reuse",
+    rationale=(
+        "jax.random is splittable, not stateful: passing one key to two "
+        "consumers gives both the SAME stream. Correlated dropout masks "
+        "or init tensors are silent statistical corruption — the run "
+        "trains, the loss curve just quietly lies. A consumer inside a "
+        "loop over the key's binding reuses it every iteration."),
+    bad="""\
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (8,))
+b = jax.random.normal(key, (8,))      # identical to `a`
+""",
+    good="""\
+key = jax.random.PRNGKey(0)
+ka, kb = jax.random.split(key)
+a = jax.random.normal(ka, (8,))
+b = jax.random.normal(kb, (8,))
+""",
+    checker=_check_key_reuse))
+
+
+# ---------------------------------------------------------------------------
+# GL004 — host-device sync inside step loops
+# ---------------------------------------------------------------------------
+
+_GL004_FUNCS = {"np.asarray": "np.asarray", "numpy.asarray": "np.asarray",
+                "np.array": "np.array", "numpy.array": "np.array",
+                "jax.device_get": "jax.device_get"}
+
+
+def _check_host_sync_in_loop(tree, lines, path):
+    findings = []
+
+    def scan(node, loop_depth):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if not isinstance(node, ast.Lambda) else []
+            for child in body:
+                scan(child, 0)       # fresh function: loop depth resets
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            loop_depth += 1
+        if loop_depth > 0 and isinstance(node, ast.Call):
+            what = None
+            f = dotted(node.func)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                what = ".item()"
+            elif f in _GL004_FUNCS:
+                what = _GL004_FUNCS[f]
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id == "float" and len(node.args) == 1
+                  and not isinstance(node.args[0], ast.Constant)):
+                what = "float(...)"
+            if what:
+                findings.append(_finding(
+                    "GL004", node,
+                    f"`{what}` inside a loop forces a device->host sync "
+                    f"every iteration (stalls the dispatch pipeline); "
+                    f"accumulate on device and fetch once after the loop",
+                    path, lines))
+        for child in ast.iter_child_nodes(node):
+            scan(child, loop_depth)
+
+    for stmt in tree.body:
+        scan(stmt, 0)
+    return findings
+
+
+_register(Rule(
+    id="GL004", name="host-sync-in-loop",
+    rationale=(
+        "`float()` / `.item()` / `np.asarray()` on a device value blocks "
+        "until the device finishes — inside a step loop that's one full "
+        "pipeline stall per iteration (the TPUv4 pjit postmortem "
+        "attributes most lost time to exactly these host stalls, not "
+        "FLOPs). This package's eval loop paid one round-trip per eval "
+        "batch until the PR that introduced this linter fixed it."),
+    bad="""\
+total = 0.0
+for _ in range(k):
+    total += float(eval_step(params, batch))   # sync per batch
+""",
+    good="""\
+total = None
+for _ in range(k):
+    loss = eval_step(params, batch)            # stays on device
+    total = loss if total is None else total + loss
+mean = float(total) / k                        # ONE sync per split
+""",
+    checker=_check_host_sync_in_loop))
+
+
+# ---------------------------------------------------------------------------
+# GL005 — jit over state/cache pytrees without donation
+# ---------------------------------------------------------------------------
+
+_DONATABLE = {"state", "opt_state", "cache", "kv_cache", "caches",
+              "train_state", "carry"}
+
+
+def _check_missing_donation(tree, lines, path):
+    findings = []
+    module_fns = {fn.name: fn for fn in _all_functions(tree)}
+
+    def check(fn: ast.FunctionDef, site: ast.AST, kwargs):
+        if "donate_argnums" in kwargs or "donate_argnames" in kwargs:
+            return
+        hit = sorted(set(_param_names(fn)) & _DONATABLE)
+        if hit:
+            findings.append(_finding(
+                "GL005", site,
+                f"jit of `{fn.name}` takes {', '.join(hit)} but donates "
+                f"nothing — without donate_argnums/donate_argnames the "
+                f"old buffers stay live across the call, doubling HBM "
+                f"for update-in-place state (OOM at exactly the model "
+                f"size that otherwise fits)",
+                path, lines))
+
+    for fn in _all_functions(tree):
+        dec = _jit_decorator(fn)
+        if dec is not None:
+            check(fn, dec, _jit_kwargs(dec))
+    for node in ast.walk(tree):
+        call = _jit_wrap_call(node)
+        if call is None or not call.args:
+            continue
+        # jax.jit(f, ...) / partial(jax.jit, f, ...) with f a plain
+        # function defined in this module
+        first = call.args[0]
+        if dotted(first) in _JIT_WRAPPERS:        # the partial spelling
+            if len(call.args) < 2:
+                continue
+            first = call.args[1]
+        if isinstance(first, ast.Name) and first.id in module_fns:
+            check(module_fns[first.id], call, _jit_kwargs(node))
+    return findings
+
+
+_register(Rule(
+    id="GL005", name="missing-donation",
+    rationale=(
+        "A jitted update step that takes a large pytree (train state, KV "
+        "cache) and returns its successor keeps BOTH alive unless the "
+        "input is donated — the peak-HBM doubling that decides whether "
+        "a model fits. Donation also lets XLA alias the update in "
+        "place. Heuristic: parameters named state/cache/opt_state/... "
+        "are update-in-place pytrees."),
+    bad="""\
+@jax.jit
+def update(state, batch):        # old state buffers stay live
+    return state.apply(batch)
+""",
+    good="""\
+@partial(jax.jit, donate_argnames=("state",))
+def update(state, batch):        # old buffers reused for the new state
+    return state.apply(batch)
+""",
+    checker=_check_missing_donation))
+
+
+# ---------------------------------------------------------------------------
+# GL006 — dynamic_update_slice without an in-bounds guard
+# ---------------------------------------------------------------------------
+
+_DUS = {"jax.lax.dynamic_update_slice", "lax.dynamic_update_slice",
+        "jax.lax.dynamic_update_slice_in_dim",
+        "lax.dynamic_update_slice_in_dim"}
+_BOUNDS_GUARDS = ("check_in_bounds", "assert_in_bounds", "checkify.check")
+
+
+def _const_like(node: ast.expr, const_names: set) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in const_names
+    if isinstance(node, ast.Call):
+        f = dotted(node.func)
+        if f in ("jnp.int32", "jnp.uint32", "int") and node.args:
+            return _const_like(node.args[0], const_names)
+    if isinstance(node, ast.UnaryOp):
+        return _const_like(node.operand, const_names)
+    return False
+
+
+def _check_unguarded_dus(tree, lines, path):
+    findings = []
+    for fn in _top_level_functions(tree):
+        # one-level local constant/tuple resolution
+        assigns: Dict[str, ast.expr] = {}
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                assigns[node.targets[0].id] = node.value
+        const_names = {n for n, v in assigns.items()
+                       if _const_like(v, set())}
+        # clamped names: bound from jnp.minimum / jnp.clip / `%`
+        clamped = {n for n, v in assigns.items()
+                   if (isinstance(v, ast.Call)
+                       and dotted(v.func) in ("jnp.minimum", "jnp.clip",
+                                              "jax.numpy.minimum",
+                                              "jax.numpy.clip"))
+                   or (isinstance(v, ast.BinOp)
+                       and isinstance(v.op, ast.Mod))}
+        # blessing: a sanctioned guard call anywhere in the function, or
+        # an `assert` naming one of the start indices
+        guard_called = any(
+            isinstance(n, ast.Call)
+            and dotted(n.func) is not None
+            and (dotted(n.func) in _BOUNDS_GUARDS
+                 or dotted(n.func).split(".")[-1] in _BOUNDS_GUARDS)
+            for n in ast.walk(fn))
+        assert_names: set = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assert):
+                assert_names |= {x.id for x in ast.walk(n.test)
+                                 if isinstance(x, ast.Name)}
+
+        for call in ast.walk(fn):
+            if not (isinstance(call, ast.Call)
+                    and dotted(call.func) in _DUS):
+                continue
+            if guard_called:
+                continue
+            start_args = call.args[2:]
+            names: set = set()
+            for a in start_args:
+                if isinstance(a, ast.Name) and a.id in assigns:
+                    a = assigns[a.id]
+                for x in ast.walk(a):
+                    if isinstance(x, ast.Name):
+                        names.add(x.id)
+            nonconst = {n for n in names if n not in const_names}
+            if not nonconst:
+                continue
+            if nonconst & clamped or nonconst & assert_names:
+                continue
+            findings.append(_finding(
+                "GL006", call,
+                f"dynamic_update_slice start index ({', '.join(sorted(nonconst))}) "
+                f"has no in-bounds guard in `{fn.name}` — out-of-bounds "
+                f"starts silently CLAMP and overwrite valid earlier data "
+                f"(the serving prefill corruption bug); add "
+                f"check_in_bounds(...) (utils.sanitize) or an assert on "
+                f"the index",
+                path, lines))
+    return findings
+
+
+_register(Rule(
+    id="GL006", name="unguarded-dynamic-update-slice",
+    rationale=(
+        "`jax.lax.dynamic_update_slice` does not raise on out-of-bounds "
+        "start indices: it CLAMPS them, silently overwriting valid "
+        "earlier data. PR 1's chunked-prefill bug corrupted KV-cache "
+        "entries exactly this way. The sanctioned pattern is a "
+        "`check_in_bounds(start, length, size)` call "
+        "(utils.sanitize) — or an `assert` naming the index — in the "
+        "same function."),
+    bad="""\
+def write(buf, row, pos):
+    return jax.lax.dynamic_update_slice(buf, row, (pos, 0))
+""",
+    good="""\
+from replicatinggpt_tpu.utils.sanitize import check_in_bounds
+
+def write(buf, row, pos):
+    check_in_bounds(pos, row.shape[0], buf.shape[0])  # asserts when
+    return jax.lax.dynamic_update_slice(buf, row, (pos, 0))  # concrete
+""",
+    checker=_check_unguarded_dus))
+
+
+# ---------------------------------------------------------------------------
+# GL007 — non-hashable values for static jit parameters
+# ---------------------------------------------------------------------------
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+
+
+def _check_unhashable_static(tree, lines, path):
+    findings = []
+    # jitted defs and their static param names
+    static_of: Dict[str, set] = {}
+    for fn in _all_functions(tree):
+        dec = _jit_decorator(fn)
+        if dec is None:
+            continue
+        static = _static_param_names(fn, _jit_kwargs(dec))
+        if static:
+            static_of[fn.name] = static
+        # (a) static param whose DEFAULT is a mutable literal
+        a = fn.args
+        params = [p.arg for p in (*a.posonlyargs, *a.args)]
+        for p, d in zip(params[len(params) - len(a.defaults):], a.defaults):
+            if p in static and isinstance(d, _MUTABLE_LITERALS):
+                findings.append(_finding(
+                    "GL007", d,
+                    f"static arg `{p}` of jitted `{fn.name}` defaults to a "
+                    f"non-hashable {type(d).__name__.lower()} — jit "
+                    f"statics are dict keys; this raises "
+                    f"`unhashable type` at the first call (use a tuple / "
+                    f"frozen dataclass)",
+                    path, lines))
+        for p, d in zip([p.arg for p in a.kwonlyargs], a.kw_defaults):
+            if d is not None and p in static and isinstance(d, _MUTABLE_LITERALS):
+                findings.append(_finding(
+                    "GL007", d,
+                    f"static arg `{p}` of jitted `{fn.name}` defaults to a "
+                    f"non-hashable {type(d).__name__.lower()}",
+                    path, lines))
+    # assigned wrappers: g = jax.jit(f, static_argnames=(...))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            call = _jit_wrap_call(node.value)
+            if call is not None:
+                statics = set(_const_str_items(
+                    _jit_kwargs(node.value).get("static_argnames")))
+                if statics:
+                    static_of[node.targets[0].id] = statics
+    # (b) callsites passing a mutable literal to a known static kwarg
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        name = dotted(call.func)
+        statics = static_of.get(name or "", set())
+        if not statics:
+            continue
+        for kw in call.keywords:
+            if kw.arg in statics and isinstance(kw.value, _MUTABLE_LITERALS):
+                findings.append(_finding(
+                    "GL007", kw.value,
+                    f"call passes a non-hashable "
+                    f"{type(kw.value).__name__.lower()} as static arg "
+                    f"`{kw.arg}` of jitted `{name}` — raises `unhashable "
+                    f"type: ...` (pass a tuple / frozen value)",
+                    path, lines))
+    return findings
+
+
+_register(Rule(
+    id="GL007", name="unhashable-static-arg",
+    rationale=(
+        "jit's static arguments become cache-dictionary keys: a list / "
+        "dict / set value raises `TypeError: unhashable type` at call "
+        "time — and a mutable-but-hashable value is worse, silently "
+        "splitting the cache per identity. Statics should be tuples, "
+        "strings, numbers, or frozen dataclasses (like this package's "
+        "ModelConfig)."),
+    bad="""\
+@partial(jax.jit, static_argnames=("dims",))
+def pool(x, dims=[1, 2]):        # unhashable at first call
+    return x.sum(tuple(dims))
+""",
+    good="""\
+@partial(jax.jit, static_argnames=("dims",))
+def pool(x, dims=(1, 2)):        # hashable static
+    return x.sum(dims)
+""",
+    checker=_check_unhashable_static))
+
+
+# ---------------------------------------------------------------------------
+# GL008 — pmap/shard_map bodies capturing module globals
+# ---------------------------------------------------------------------------
+
+_SPMD_WRAPPERS = {"jax.pmap", "pmap", "shard_map",
+                  "jax.experimental.shard_map.shard_map"}
+
+
+def _spmd_decorator(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if dotted(dec) in _SPMD_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            f = dotted(dec.func)
+            if f in _SPMD_WRAPPERS:
+                return True
+            if f in _PARTIAL and dec.args and dotted(dec.args[0]) in _SPMD_WRAPPERS:
+                return True
+    return False
+
+
+def _check_spmd_global_capture(tree, lines, path):
+    # module-scope mutable-looking globals: lowercase simple assignments
+    globals_: set = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if (isinstance(t, ast.Name) and not t.id.startswith("__")
+                        and not t.id.isupper()
+                        and not isinstance(stmt.value,
+                                           (ast.Lambda, ast.Constant))):
+                    globals_.add(t.id)
+    if not globals_:
+        return []
+    # functions handed to pmap/shard_map by name
+    spmd_fns = {fn.name for fn in _all_functions(tree) if _spmd_decorator(fn)}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and dotted(node.func) in _SPMD_WRAPPERS
+                and node.args and isinstance(node.args[0], ast.Name)):
+            spmd_fns.add(node.args[0].id)
+    findings = []
+    for fn in _all_functions(tree):
+        if fn.name not in spmd_fns:
+            continue
+        local = set(_param_names(fn))
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for t in tgts:
+                    for x in ast.walk(t):
+                        if isinstance(x, ast.Name):
+                            local.add(x.id)
+        seen = set()
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id in globals_ and n.id not in local
+                    and n.id not in seen):
+                seen.add(n.id)
+                findings.append(_finding(
+                    "GL008", n,
+                    f"`{fn.name}` runs under pmap/shard_map but captures "
+                    f"module global `{n.id}` — captured arrays are "
+                    f"broadcast into every program (replicated HBM copy, "
+                    f"silent retrace when rebound); pass it as an "
+                    f"argument with an explicit spec",
+                    path, lines))
+    return findings
+
+
+_register(Rule(
+    id="GL008", name="spmd-global-capture",
+    rationale=(
+        "A function run under pmap/shard_map that closes over a module "
+        "global embeds that value into the compiled program: arrays get "
+        "broadcast to every device (a full replicated copy in HBM, "
+        "outside any sharding spec), and rebinding the global later "
+        "does nothing — or forces a retrace. Per-device data must "
+        "arrive as arguments with explicit specs."),
+    bad="""\
+table = jnp.zeros((50_000, 512))     # module global
+
+def embed(ids):
+    return table[ids]                # broadcast into every program
+
+embed_p = jax.pmap(embed)
+""",
+    good="""\
+def embed(table, ids):               # explicit argument
+    return table[ids]
+
+embed_p = jax.pmap(embed, in_axes=(None, 0))
+""",
+    checker=_check_spmd_global_capture))
+
+
+def all_rule_ids() -> List[str]:
+    return sorted(RULES)

@@ -388,6 +388,15 @@ def main(argv=None) -> int:
     pe.add_argument("--checkpoint-dir", default=None)
     pe.set_defaults(fn=cmd_eval)
 
+    pl = sub.add_parser("lint",
+                        help="graftlint: JAX-hazard static analysis "
+                             "(recompiles, host syncs, RNG reuse, "
+                             "dynamic_update_slice clamps, ...) — "
+                             "CPU-only, no jax import, tier-1 fast")
+    from .analysis.cli import add_lint_flags, run_lint
+    add_lint_flags(pl)
+    pl.set_defaults(fn=run_lint)
+
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from ..config import ModelConfig
 from ..ops.attention import (cached_attention, full_causal_attention,
                              uint8_inverted_dropout)
+from ..utils.sanitize import check_in_bounds
 
 Params = Dict[str, Any]
 
@@ -467,6 +468,11 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     if allow_pallas is None:
         allow_pallas = _default_allow_pallas(params, idx_t, cache)
     S_actual = cache["k"].shape[cache_seq_axis(cfg)]
+    # a past-the-end pos would CLAMP in the cache write below and
+    # overwrite the last valid K/V (lint GL006); concrete (eager) calls
+    # assert here, traced callers bound pos host-side (generate's
+    # window refresh, the serve engine's admission room check)
+    check_in_bounds(pos, 1, S_actual, what="decode_step cache write")
     from ..ops.decode_pallas import fused_decode_layers, fused_decode_supported
     # the envelope gates on the CACHE actually handed in (its length and
     # dtype may differ from cfg.block_size / the compute dtype via
@@ -549,6 +555,7 @@ def _decode_step_packed(params: Params, x, pos, cache, cfg: ModelConfig,
                                      packed_decode_supported)
     H = cfg.n_head
     S = cache["k"].shape[2]
+    check_in_bounds(pos, 1, S, what="packed decode cache write")
     # same cache-dtype gate as the fused path: the kernel attends the
     # fresh column at compute precision, so write-then-attend
     # bit-equivalence needs the stored value to round-trip losslessly
@@ -635,6 +642,10 @@ def prefill(params: Params, idx: jnp.ndarray,
     """
     cd = _dtype(cfg.dtype)
     B, P = idx.shape
+    # shapes are static, so this guard holds even under jit: a prompt
+    # longer than the cache buffer would clamp-corrupt the tail
+    check_in_bounds(0, P, cache["k"].shape[cache_seq_axis(cfg)],
+                    what="prefill prompt write")
     x = params["wte"].astype(cd)[idx] + params["wpe"].astype(cd)[:P]
 
     packed = cfg.decode_cache_layout == "packed"
@@ -783,6 +794,13 @@ def prefill_chunk_into_slot(params: Params, idx: jnp.ndarray,
     cd = _dtype(cfg.dtype)
     _, Pc = idx.shape
     H, S = cfg.n_head, cache["k"].shape[cache_seq_axis(cfg)]
+    # THE site of PR 1's clamp bug: a padded final chunk whose offset
+    # pushes past the buffer would silently overwrite chunk 1's K/V.
+    # Eager calls assert here; the jitted serving path (offset traced)
+    # is bounded host-side at admission (Engine._admit) and by
+    # EngineConfig.chunk's divisibility invariant.
+    check_in_bounds(offset, Pc, S, what="prefill chunk write")
+    check_in_bounds(slot, 1, cache["k"].shape[1], what="prefill slot index")
     scale = cfg.head_dim ** -0.5
     x = (params["wte"].astype(cd)[idx]
          + jax.lax.dynamic_slice_in_dim(params["wpe"].astype(cd), offset,

@@ -320,7 +320,14 @@ def fused_decode_layers(x0: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
       vec("ln2_bias"), w["mlp_up_kernel"], vec("mlp_up_bias"),
       w["mlp_down_kernel"], vec("mlp_down_bias"), cache["k"], cache["v"])
     # scatter every layer's fresh K/V row into the cache at pos — ONE
-    # dynamic_update_slice per array for all layers
+    # dynamic_update_slice per array for all layers. An out-of-range pos
+    # would CLAMP onto the last valid row (lint GL006); eager calls
+    # assert, jitted callers bound pos host-side (decode_step's guard
+    # already ran on this pos before dispatching here).
+    from ..utils.sanitize import check_in_bounds
+    seq_axis = 2 if packed else 3
+    check_in_bounds(pos, 1, cache["k"].shape[seq_axis],
+                    what="fused decode cache write")
     zero = jnp.int32(0)
     p = jnp.asarray(pos, jnp.int32)
     if packed:

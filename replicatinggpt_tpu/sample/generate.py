@@ -459,9 +459,13 @@ def generate(params, prompt: jnp.ndarray, cfg: ModelConfig,
             g = 1 << (k.bit_length() - 1)
             while k > 0:
                 if g <= k:
-                    toks, window = _refresh_group(params, window, g,
-                                                  jnp.int32(ordinal), base,
-                                                  cfg, gcfg, allow_pallas)
+                    # key+counter idiom: _refresh_group fold_ins the
+                    # per-segment ordinal internally, so passing `base`
+                    # each iteration is NOT stream reuse (see the
+                    # fold_in(base, ordinal) comment above)
+                    toks, window = _refresh_group(  # graftlint: disable=GL003
+                        params, window, g, jnp.int32(ordinal), base,
+                        cfg, gcfg, allow_pallas)
                     take = min(g * n_mid, remaining)
                     chunks.append(toks[:, :take])
                     remaining -= take

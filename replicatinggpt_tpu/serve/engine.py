@@ -47,6 +47,7 @@ from ..models.gpt import decode_step_multi, prefill_chunk_into_slot
 from ..sample.generate import sample_tokens_batched
 from ..utils.logging import Metrics
 from ..utils.profiling import StepTimer, annotate
+from ..utils.sanitize import CompileGuard, check_in_bounds, sanitize_enabled
 from .cache_pool import CachePool
 from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_LENGTH_CAP,
                        FINISH_MAX_TOKENS, Request, RequestResult)
@@ -119,8 +120,12 @@ def _engine_prefill(params, chunk, offset, slot, cache, cfg: ModelConfig):
 
 
 def compile_counts() -> Dict[str, int]:
-    """Compiled-program counts for the two engine entry points — the
-    steady-state zero-recompile assertion reads these before/after."""
+    """Process-wide compiled-program counts for the two engine entry
+    points (module-level jits, so they accumulate across engines). The
+    replay driver's before/after bookkeeping reads these; the *live*
+    steady-state enforcement is per-engine via :class:`CompileGuard`
+    (utils.sanitize), which raises from the offending step instead of
+    reporting after the fact."""
     return {"decode": _engine_decode._cache_size(),
             "prefill": _engine_prefill._cache_size()}
 
@@ -170,6 +175,16 @@ class Engine:
         self._slots: Dict[int, _Active] = {}
         self._pending: List[RequestResult] = []  # cancellations between steps
         self.n_steps = 0
+        # the steady-state contract, enforced live: each entry point may
+        # compile ONE program for this engine's shapes (counted relative
+        # to engine construction — the module jit caches accumulate
+        # across engines); a second compile raises RecompileError from
+        # the step that caused it. Replaces the ad-hoc two-program
+        # bookkeeping the first serving PR shipped (compile_counts()
+        # remains for offline summaries).
+        self._decode_guard = CompileGuard(_engine_decode, "serve/decode")
+        self._prefill_guard = CompileGuard(_engine_prefill, "serve/prefill")
+        self._sanitize = sanitize_enabled()
 
     # ---------------------------------------------------------------- API
 
@@ -245,6 +260,8 @@ class Engine:
         s["step_latency"] = self.step_timer.summary(skip=1)
         s["n_steps"] = self.n_steps
         s["compile_counts"] = compile_counts()
+        s["compile_guards"] = {"decode": self._decode_guard.stats(),
+                               "prefill": self._prefill_guard.stats()}
         return s
 
     # ----------------------------------------------------------- internals
@@ -261,12 +278,20 @@ class Engine:
         cap = min(req.max_new_tokens, room)
         chunk = self._chunk
         n_chunks = -(-P // chunk)
+        # the host-side bound the jitted prefill (offset traced) relies
+        # on: the LAST padded chunk must land inside the slot buffer,
+        # else dynamic_update_slice clamp-corrupts earlier K/V (lint
+        # GL006 / the PR 1 bug). Holds by construction — scheduler
+        # rejects P > block_size and EngineConfig.chunk divides it —
+        # this assert keeps the invariant from silently rotting.
+        check_in_bounds((n_chunks - 1) * chunk, chunk, S,
+                        what=f"prefill of {P}-token prompt in {chunk}-chunks")
         padded = np.zeros((n_chunks * chunk,), np.int32)
         padded[:P] = req.prompt
         cache = self.pool.cache
         with annotate("serve/prefill"):
             for c in range(n_chunks):
-                cache = _engine_prefill(
+                cache = self._prefill_guard(
                     self.params, jnp.asarray(padded[None,
                                                     c * chunk:(c + 1) * chunk]),
                     jnp.int32(c * chunk), jnp.int32(slot), cache, self.cfg)
@@ -290,7 +315,7 @@ class Engine:
     def _decode_once(self) -> List[RequestResult]:
         with annotate("serve/decode"):
             self.step_timer.start()
-            nxt, cache, rngs = _engine_decode(
+            nxt, cache, rngs = self._decode_guard(
                 self.params, jnp.asarray(self._tok), jnp.asarray(self._pos),
                 jnp.asarray(self._active), self.pool.cache, self._rngs,
                 jnp.asarray(self._temp), jnp.asarray(self._top_k),
@@ -300,6 +325,16 @@ class Engine:
         self.pool.cache = cache
         self._rngs = rngs
         toks = np.asarray(nxt)
+        if self._sanitize:
+            # GRAFT_SANITIZE: sampled ids must be valid vocab entries
+            # (an out-of-range id would clamp in the next embedding
+            # gather and silently decode garbage)
+            bad = (toks < 0) | (toks >= self.cfg.vocab_size)
+            if bad.any():
+                raise FloatingPointError(
+                    f"sanitize: decode produced out-of-range token(s) "
+                    f"{toks[bad][:4].tolist()} (vocab "
+                    f"{self.cfg.vocab_size})")
         now = self.clock()
         self.n_steps += 1
         n_active = int(self._active.sum())

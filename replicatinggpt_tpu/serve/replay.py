@@ -19,6 +19,7 @@ from typing import List, Tuple
 import numpy as np
 
 from ..config import ModelConfig
+from ..utils.sanitize import sanitized
 from .engine import Engine, EngineConfig, compile_counts
 from .requests import Request, RequestResult, SamplingParams
 
@@ -50,7 +51,8 @@ def make_trace(mcfg: ModelConfig, rcfg: ReplayConfig
     sp = SamplingParams(temperature=rcfg.temperature, top_k=rcfg.top_k,
                         top_p=rcfg.top_p, greedy=rcfg.greedy)
     for i in range(rcfg.n_requests):
-        t += float(rng.exponential(1.0 / max(rcfg.rate, 1e-9)))
+        # host numpy RNG: float() here is not a device round-trip
+        t += float(rng.exponential(1.0 / max(rcfg.rate, 1e-9)))  # graftlint: disable=GL004
         P = int(rng.integers(lo, hi + 1))
         prompt = rng.integers(0, mcfg.vocab_size, (P,), dtype=np.int64)
         trace.append((t, Request(
@@ -82,23 +84,26 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
     results: List[RequestResult] = []
     i = 0
     t0 = time.monotonic()
-    while len(results) < len(trace):
-        now = time.monotonic() - t0
-        while i < len(trace) and trace[i][0] <= now:
-            arr_t, req = trace[i]
-            if rcfg.deadline_s > 0:
-                req.deadline = time.monotonic() + rcfg.deadline_s
-            rej = engine.submit(req)
-            if rej is not None:
-                results.append(rej)
-            i += 1
-        if engine.idle:
-            if i >= len(trace):
-                break
-            # nothing in flight: sleep to the next arrival
-            time.sleep(min(max(trace[i][0] - now, 0.0), 0.05))
-            continue
-        results.extend(engine.step())
+    # GRAFT_SANITIZE=1 runs the whole replay under jax's tracer-leak +
+    # NaN checks (no-op context otherwise)
+    with sanitized():
+        while len(results) < len(trace):
+            now = time.monotonic() - t0
+            while i < len(trace) and trace[i][0] <= now:
+                arr_t, req = trace[i]
+                if rcfg.deadline_s > 0:
+                    req.deadline = time.monotonic() + rcfg.deadline_s
+                rej = engine.submit(req)
+                if rej is not None:
+                    results.append(rej)
+                i += 1
+            if engine.idle:
+                if i >= len(trace):
+                    break
+                # nothing in flight: sleep to the next arrival
+                time.sleep(min(max(trace[i][0] - now, 0.0), 0.05))
+                continue
+            results.extend(engine.step())
     wall_s = time.monotonic() - t0
 
     done = compile_counts()

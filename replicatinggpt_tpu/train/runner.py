@@ -21,6 +21,8 @@ from ..data.loader import make_batcher, prefetch
 from ..models.gpt import param_count
 from ..tokenizers import get_tokenizer
 from ..utils.logging import StepLogger
+from ..utils.sanitize import (CompileGuard, check_finite, sanitize_enabled,
+                              sanitized)
 from .state import TrainState, create_train_state
 from .steps import estimate_loss, make_eval_step, make_train_step
 
@@ -126,7 +128,15 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
         state = shard_train_state(
             lambda: create_train_state(rng, mcfg, tcfg), mesh, cfg.mesh)
     else:
-        state = create_train_state(rng, mcfg, tcfg)
+        # commit the fresh state to an explicit device: jit keys on
+        # placement, and an uncommitted initial state whose successor
+        # comes back committed can split the cache into a throwaway
+        # first program (the serve engine's commit_default rationale —
+        # and the train CompileGuard below would flag it as a
+        # recompile)
+        state = jax.device_put(
+            create_train_state(rng, mcfg, tcfg),
+            jax.config.jax_default_device or jax.local_devices()[0])
     logger.log(f"model: {param_count(state.params):,} params "
                f"({mcfg.n_layer}L/{mcfg.n_head}H/{mcfg.n_embd}C, "
                f"dtype={mcfg.dtype})")
@@ -176,8 +186,13 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
         mcfg = dc.replace(mcfg, attention_impl="einsum")
         logger.log(f"attention_impl {prev_impl!r} -> 'einsum': mesh run "
                    "where the shard_map flash wrapper does not apply")
-    train_step = make_train_step(mcfg, tcfg, attention_fn=attention_fn,
-                                 blocks_fn=blocks_fn)
+    # steady-state contract, same as the serve engine's: ONE compiled
+    # program per dispatch shape; a silent mid-run recompile (shape /
+    # weak-type / placement drift) raises RecompileError naming the
+    # step instead of quietly halving throughput
+    train_step = CompileGuard(
+        make_train_step(mcfg, tcfg, attention_fn=attention_fn,
+                        blocks_fn=blocks_fn), "train/step")
     super_sharding = None
     superbatch_put = None
     if mesh is not None:
@@ -208,9 +223,10 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                        f"eval/checkpoint cadence")
         if scan_k > 1:
             from .steps import make_train_scan
-            train_scan = make_train_scan(mcfg, tcfg, scan_k,
-                                         attention_fn=attention_fn,
-                                         blocks_fn=blocks_fn)
+            train_scan = CompileGuard(
+                make_train_scan(mcfg, tcfg, scan_k,
+                                attention_fn=attention_fn,
+                                blocks_fn=blocks_fn), "train/scan")
         else:
             scan_k = 1
     eval_step = make_eval_step(mcfg, attention_fn=attention_fn,
@@ -363,6 +379,14 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     tokens_since_log = 0
     lr_at = _make_lr_reader(tcfg)
     stopped_early = False
+    import contextlib
+    sanitizer = contextlib.ExitStack()
+    if sanitize_enabled():
+        # GRAFT_SANITIZE=1: jax tracer-leak + NaN checks for the whole
+        # loop, host finiteness check on every logged loss (below) —
+        # debug equipment, off by default (costs compile time/fusions)
+        logger.log("GRAFT_SANITIZE=1: tracer-leak + NaN checks enabled")
+        sanitizer.enter_context(sanitized(True))
     try:
         it = start_step
         while it < tcfg.max_iters:
@@ -402,7 +426,12 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                     losses_arr = metrics["loss"]
                     loss_b = (losses_arr if chunk == 1
                               else losses_arr[b - prev_it - 1])
-                    logger.log_step(b - 1, float(loss_b), tokens_since_log,
+                    # one reviewed sync per LOG boundary, not per step;
+                    # the fetch is also the NaN tripwire under sanitize
+                    loss_val = float(loss_b)  # graftlint: disable=GL004
+                    if sanitize_enabled():
+                        check_finite(loss_val, f"train loss at step {b - 1}")
+                    logger.log_step(b - 1, loss_val, tokens_since_log,
                                     n_chips, lr=lr_at(b - 1))
                     tokens_since_log = 0
             if (checkpoint_manager is not None and tcfg.checkpoint_every
@@ -410,6 +439,7 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                 checkpoint_manager.save(state, cursor)
     finally:
         profiler.close()
+        sanitizer.close()
     jax.block_until_ready(state.params)
     wall = time.perf_counter() - t0
     end_step = int(jax.device_get(state.step))

@@ -1,3 +1,8 @@
 from .logging import StepLogger
+from .sanitize import (CompileGuard, DonationError, RecompileError,
+                       assert_donated, check_in_bounds, donation_report,
+                       sanitize_enabled, sanitized)
 
-__all__ = ["StepLogger"]
+__all__ = ["CompileGuard", "DonationError", "RecompileError", "StepLogger",
+           "assert_donated", "check_in_bounds", "donation_report",
+           "sanitize_enabled", "sanitized"]

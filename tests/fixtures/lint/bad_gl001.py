@@ -1,0 +1,11 @@
+"""GL001 bad: Python control flow on traced jit arguments."""
+import jax
+
+
+@jax.jit
+def step(x, n):
+    if n > 0:                 # n is traced -> retrace/crash
+        x = x * n
+    while n > 0:              # traced while: same hazard
+        n = n - 1
+    return x

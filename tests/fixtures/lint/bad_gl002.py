@@ -1,0 +1,10 @@
+"""GL002 bad: device computation at module import time."""
+import jax
+import jax.numpy as jnp
+
+MASK = jnp.tril(jnp.ones((64, 64)))     # device alloc at import
+NOISE = jax.random.normal(jax.random.PRNGKey(0), (8,))
+
+
+def f(x, m=jnp.zeros((2,))):            # default evaluated at import
+    return x + m

@@ -1,0 +1,17 @@
+"""GL003 bad: one PRNG key feeding multiple consumers."""
+import jax
+
+
+def sample():
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (8,))
+    b = jax.random.normal(key, (8,))      # identical to `a`
+    return a, b
+
+
+def loop_reuse(xs):
+    key = jax.random.PRNGKey(1)
+    out = []
+    for x in xs:
+        out.append(jax.random.normal(key, (4,)))   # same noise each iter
+    return out

@@ -1,0 +1,18 @@
+"""GL004 bad: device->host syncs inside step loops."""
+import numpy as np
+
+
+def eval_loop(step, params, batches):
+    total = 0.0
+    for b in batches:
+        total += float(step(params, b))     # sync per batch
+    return total
+
+
+def fetch_loop(decode, toks):
+    outs = []
+    while toks:
+        t = decode(toks.pop())
+        outs.append(np.asarray(t))          # sync per token
+        outs[-1].item()                     # and again
+    return outs

@@ -1,0 +1,13 @@
+"""GL005 bad: jit over update-in-place pytrees without donation."""
+import jax
+
+
+@jax.jit
+def update(state, batch):            # old state buffers stay live
+    return state
+
+
+def make_step():
+    def inner(state, cache):
+        return state, cache
+    return jax.jit(inner)            # resolvable wrap site, no donation

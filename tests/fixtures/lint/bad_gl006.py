@@ -1,0 +1,11 @@
+"""GL006 bad: dynamic_update_slice with an unguarded start index."""
+import jax
+
+
+def write(buf, row, pos):
+    # out-of-bounds pos CLAMPS and overwrites earlier rows
+    return jax.lax.dynamic_update_slice(buf, row, (pos, 0))
+
+
+def write_in_dim(buf, row, i):
+    return jax.lax.dynamic_update_slice_in_dim(buf, row, i, axis=0)

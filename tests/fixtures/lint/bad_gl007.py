@@ -1,0 +1,18 @@
+"""GL007 bad: non-hashable values for static jit parameters."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("dims",))
+def pool(x, dims=[1, 2]):            # unhashable default
+    return x.sum(tuple(dims))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def run(x, cfg):
+    return x
+
+
+def caller(x):
+    return run(x, cfg={"layers": 2})  # unhashable at the callsite

@@ -1,0 +1,17 @@
+"""GL008 bad: pmap/shard_map bodies reading module globals."""
+import jax
+import numpy as np
+
+table = np.zeros((16, 4))            # module global
+
+
+def embed(ids):
+    return table[ids]                # broadcast into every program
+
+
+embed_p = jax.pmap(embed)
+
+
+@jax.pmap
+def lookup(ids):
+    return table[ids] + 1

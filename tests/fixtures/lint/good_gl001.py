@@ -1,0 +1,24 @@
+"""GL001 good: static args, device-side select, identity checks."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n",))
+def step(x, n):
+    if n > 0:                 # n is a static (hashable) Python value
+        x = x * n
+    return x
+
+
+@jax.jit
+def masked(x, n):
+    return jnp.where(n > 0, x * n, x)   # branch ON DEVICE
+
+
+@jax.jit
+def optional(x, rng):
+    if rng is None:           # identity check: static under tracing
+        return x
+    return x + 1

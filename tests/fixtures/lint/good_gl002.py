@@ -1,0 +1,13 @@
+"""GL002 good: host constants at module scope, device work inside fns."""
+import jax.numpy as jnp
+import numpy as np
+
+MASK = np.tril(np.ones((64, 64)))       # host constant
+
+
+def f(x):
+    return x + jnp.asarray(MASK)        # device work happens traced
+
+
+def g(x, shape=(2,)):
+    return x + jnp.zeros(shape)

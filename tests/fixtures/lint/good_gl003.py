@@ -1,0 +1,26 @@
+"""GL003 good: split / fold_in before each consumer; exclusive branches."""
+import jax
+
+
+def sample():
+    key = jax.random.PRNGKey(0)
+    ka, kb = jax.random.split(key)
+    a = jax.random.normal(ka, (8,))
+    b = jax.random.normal(kb, (8,))
+    return a, b
+
+
+def loop_fresh(xs):
+    key = jax.random.PRNGKey(1)
+    out = []
+    for i, _ in enumerate(xs):
+        k = jax.random.fold_in(key, i)    # fresh stream per iteration
+        out.append(jax.random.normal(k, (4,)))
+    return out
+
+
+def branchy(flag):
+    key = jax.random.PRNGKey(2)
+    if flag:                              # branches are exclusive:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))  # only ONE consumer runs

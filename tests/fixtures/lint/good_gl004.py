@@ -1,0 +1,15 @@
+"""GL004 good: accumulate on device, fetch once after the loop."""
+import numpy as np
+
+
+def eval_loop(step, params, batches):
+    total = None
+    for b in batches:
+        loss = step(params, b)              # stays on device
+        total = loss if total is None else total + loss
+    return float(total) / len(batches)      # ONE sync
+
+
+def fetch_once(decode, toks):
+    outs = [decode(t) for t in toks]
+    return np.asarray(outs)                 # one fetch outside any loop

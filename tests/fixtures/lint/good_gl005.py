@@ -1,0 +1,20 @@
+"""GL005 good: donation declared, or no update-in-place parameter."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def update(state, batch):
+    return state
+
+
+@jax.jit
+def evaluate(params, batch):         # read-only pytree: donation optional
+    return params
+
+
+def make_step():
+    def inner(state, cache):
+        return state, cache
+    return jax.jit(inner, donate_argnums=(0, 1))

@@ -1,0 +1,25 @@
+"""GL006 good: every start index guarded, clamped, or constant."""
+import jax
+import jax.numpy as jnp
+
+from replicatinggpt_tpu.utils.sanitize import check_in_bounds
+
+
+def write_guarded(buf, row, pos):
+    check_in_bounds(pos, row.shape[0], buf.shape[0])
+    return jax.lax.dynamic_update_slice(buf, row, (pos, 0))
+
+
+def write_asserted(buf, row, pos):
+    assert pos + row.shape[0] <= buf.shape[0]
+    return jax.lax.dynamic_update_slice(buf, row, (pos, 0))
+
+
+def write_clamped(buf, row, pos):
+    p = jnp.minimum(pos, buf.shape[0] - row.shape[0])
+    return jax.lax.dynamic_update_slice(buf, row, (p, 0))
+
+
+def write_const(buf, row):
+    zero = jnp.int32(0)
+    return jax.lax.dynamic_update_slice(buf, row, (zero, 0))

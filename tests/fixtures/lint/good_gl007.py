@@ -1,0 +1,13 @@
+"""GL007 good: hashable statics (tuples / frozen configs)."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("dims",))
+def pool(x, dims=(1, 2)):
+    return x.sum(dims)
+
+
+def caller(x):
+    return pool(x, dims=(1, 3))

@@ -1,0 +1,20 @@
+"""GL008 good: per-device data arrives as arguments; constants allowed."""
+import jax
+import numpy as np
+
+TABLE = np.zeros((16, 4))            # ALL-CAPS constant: allowed
+
+
+def embed(table, ids):               # explicit argument
+    return table[ids]
+
+
+embed_p = jax.pmap(embed, in_axes=(None, 0))
+
+
+def local_ok(ids):
+    table = ids * 2                  # local shadows nothing
+    return table
+
+
+local_p = jax.pmap(local_ok)

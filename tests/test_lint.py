@@ -1,0 +1,162 @@
+"""graftlint tests: every rule flags its bad fixture and passes its good
+one, both pragma forms suppress, the committed baseline exactly matches
+a fresh whole-package run (the tier-1 CI gate), and the generated rule
+docs cannot drift from the registry."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from replicatinggpt_tpu.analysis import (DEFAULT_BASELINE, RULES,
+                                         diff_against_baseline, lint_paths,
+                                         lint_source, load_baseline,
+                                         render_rule_docs)
+
+FIXTURES = Path(__file__).parent / "fixtures" / "lint"
+REPO = Path(__file__).resolve().parent.parent
+
+RULE_IDS = sorted(RULES)
+
+
+def test_registry_shape():
+    assert len(RULES) >= 8                    # the tentpole's rule floor
+    for rid, rule in RULES.items():
+        assert rid == rule.id and rid.startswith("GL") and len(rid) == 5
+        assert rule.name and rule.rationale and rule.bad and rule.good
+        assert callable(rule.checker)
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_bad_fixture_flagged(rule_id):
+    """Each rule must flag its known-bad snippet (run with only that
+    rule active, so the assertion is about THIS rule's detector)."""
+    path = FIXTURES / f"bad_{rule_id.lower()}.py"
+    res = lint_paths([path], [rule_id])
+    assert res.findings, f"{rule_id} missed its bad fixture"
+    assert {f.rule for f in res.findings} == {rule_id}
+    for f in res.findings:
+        assert f.line > 0 and f.text            # anchored + baselineable
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_good_fixture_clean(rule_id):
+    """The matching clean snippet must pass ALL rules (fixtures are
+    written to be globally clean, not just clean for their own rule)."""
+    path = FIXTURES / f"good_{rule_id.lower()}.py"
+    res = lint_paths([path])
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+def test_line_pragma_suppresses():
+    res = lint_paths([FIXTURES / "suppressed_line.py"])
+    assert res.findings == []
+    assert [f.rule for f in res.suppressed] == ["GL004"]
+
+
+def test_file_pragma_suppresses():
+    res = lint_paths([FIXTURES / "suppressed_file.py"])
+    assert res.findings == []
+    assert {f.rule for f in res.suppressed} == {"GL004"}
+
+
+def test_pragma_only_masks_named_rule():
+    src = ("import numpy as np\n"
+           "def f(xs):\n"
+           "    for x in xs:\n"
+           "        np.asarray(x)  # graftlint: disable=GL001\n")
+    res = lint_source(src, "t.py")
+    assert [f.rule for f in res.findings] == ["GL004"]   # wrong id: no-op
+
+
+def test_syntax_error_reported_not_raised():
+    res = lint_source("def broken(:\n", "t.py")
+    assert [f.rule for f in res.findings] == ["GL000"]
+
+
+def test_baseline_matches_fresh_whole_package_run():
+    """The committed graftlint_baseline.json must EXACTLY equal a fresh
+    run over the package: a new finding fails CI, and a fixed finding
+    must be removed from the baseline (no silent staleness in either
+    direction). Refresh with `python -m replicatinggpt_tpu lint
+    --write-baseline`."""
+    res = lint_paths([])                      # default: the package
+    diff = diff_against_baseline(res.findings,
+                                 load_baseline(DEFAULT_BASELINE))
+    assert diff.exact, {
+        "new": [f.format() for f in diff.new],
+        "stale": diff.stale,
+    }
+
+
+def test_cli_gate_in_process():
+    from replicatinggpt_tpu.cli import main
+    assert main(["lint", "--baseline"]) == 0
+
+
+def test_cli_gate_subprocess():
+    """The exact tier-1 invocation: `python -m replicatinggpt_tpu lint
+    --baseline` exits 0 against the committed baseline."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "replicatinggpt_tpu", "lint", "--baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_fails_on_new_finding():
+    from replicatinggpt_tpu.cli import main
+    bad = FIXTURES / "bad_gl004.py"
+    assert main(["lint", str(bad)]) == 1
+    assert main(["lint", "--baseline", str(DEFAULT_BASELINE),
+                 str(bad)]) == 1              # fixtures aren't baselined
+
+
+def test_cli_json_reflects_baseline_diff(capsys):
+    """Under --baseline, the JSON payload must agree with the exit
+    code: `findings` holds only NEW hazards (empty on a clean tree),
+    absorbed ones appear as a `baselined` count."""
+    from replicatinggpt_tpu.cli import main
+    rc = main(["lint", "--baseline", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["findings"] == []
+    assert out["baselined"] > 0 and out["stale"] == []
+
+
+def test_cli_json_format(capsys):
+    from replicatinggpt_tpu.cli import main
+    rc = main(["lint", "--format", "json", str(FIXTURES / "bad_gl006.py")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["files"] == 1
+    assert all(f["rule"] == "GL006" for f in out["findings"])
+    assert len(out["findings"]) >= 2          # both dus spellings
+
+
+def test_docs_generated_from_registry_in_sync():
+    committed = (REPO / "docs" / "graftlint_rules.md").read_text()
+    assert committed == render_rule_docs(), (
+        "docs/graftlint_rules.md is stale — regenerate with "
+        "`python -m replicatinggpt_tpu lint --docs > "
+        "docs/graftlint_rules.md`")
+    for rid in RULE_IDS:                      # every rule documented
+        assert f"## {rid}" in committed
+
+
+def test_baseline_diff_mechanics():
+    """New / matched / stale bookkeeping on a synthetic baseline."""
+    res = lint_paths([FIXTURES / "bad_gl001.py"])
+    from collections import Counter
+    from replicatinggpt_tpu.analysis import finding_key
+    base = Counter(finding_key(f) for f in res.findings)
+    exact = diff_against_baseline(res.findings, base)
+    assert exact.exact and exact.matched == len(res.findings)
+    # drop one entry -> that finding is NEW; add a bogus one -> stale
+    k = finding_key(res.findings[0])
+    short = base - Counter([k])
+    short[("x.py", "GL001", "nope")] += 1
+    diff = diff_against_baseline(res.findings, short)
+    assert len(diff.new) == 1 and not diff.exact
+    assert ("x.py", "GL001", "nope") in diff.stale

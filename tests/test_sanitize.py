@@ -1,0 +1,296 @@
+"""Runtime-sanitizer tests (utils/sanitize.py): CompileGuard catches
+deliberately-induced recompiles on BOTH the train step and the serve
+decode step (the acceptance criterion), the in-bounds guard hard-fails
+eager out-of-range prefill/decode writes, donation reporting behaves on
+a donation-less backend, and GRAFT_SANITIZE mode toggles jax's checks."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from replicatinggpt_tpu.config import ModelConfig, get_config
+from replicatinggpt_tpu.models.gpt import (decode_step, init_kv_cache,
+                                           init_params,
+                                           prefill_chunk_into_slot)
+from replicatinggpt_tpu.utils.sanitize import (CompileGuard, DonationError,
+                                               RecompileError,
+                                               assert_donated,
+                                               check_finite,
+                                               check_in_bounds,
+                                               donation_report,
+                                               donation_supported,
+                                               sanitize_enabled, sanitized)
+
+CFG = ModelConfig(vocab_size=65, block_size=32, n_layer=2, n_head=2,
+                  n_embd=32, dropout=0.0, attn_dropout=0.0, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+# ---------------------------------------------------------------------------
+# CompileGuard
+# ---------------------------------------------------------------------------
+
+def test_compile_guard_counts_and_budget():
+    f = jax.jit(lambda x: x + 1)
+    g = CompileGuard(f, "plus-one")
+    g(jnp.ones((2,)))
+    g(jnp.ones((2,)))                       # cache hit: still 1 program
+    assert g.compiles == 1 and g.calls == 2
+    with pytest.raises(RecompileError, match="plus-one"):
+        g(jnp.ones((3,)))                   # new shape: budget exceeded
+    assert g.expect(2).check() == 2         # widened budget: now legal
+    assert g.stats() == {"calls": 3, "compiles": 2, "budget": 2}
+
+
+def test_compile_guard_relative_to_construction():
+    """Module-jit caches accumulate across owners; a guard built after
+    warmup must count only growth since ITS construction."""
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones((4,)))                       # pre-existing program
+    g = CompileGuard(f, "warm")
+    g(jnp.ones((4,)))                       # same shape: zero growth
+    assert g.compiles == 0
+
+
+def test_compile_guard_catches_train_step_recompile():
+    """Acceptance: a deliberately-induced recompile of the TRAIN step
+    (batch shape change mid-run) raises instead of silently retracing."""
+    from replicatinggpt_tpu.train.steps import make_train_step
+    tiny = get_config("test-tiny")
+    step = CompileGuard(make_train_step(tiny.model, tiny.train),
+                        "train/step")
+    from replicatinggpt_tpu.train.state import create_train_state
+    state = create_train_state(jax.random.PRNGKey(0), tiny.model, tiny.train)
+    x = jnp.zeros((4, tiny.model.block_size), jnp.int32)
+    state, _ = step(state, (x, x))
+    state, _ = step(state, (x, x))          # steady state: one program
+    assert step.compiles == 1
+    bad = jnp.zeros((5, tiny.model.block_size), jnp.int32)
+    with pytest.raises(RecompileError, match="train/step"):
+        step(state, (bad, bad))
+
+
+def test_compile_guard_catches_serve_decode_recompile(params):
+    """Acceptance: a deliberately-induced recompile of the serve DECODE
+    step (per-slot sampling array flips dtype) raises from engine.step()."""
+    from replicatinggpt_tpu.serve import Engine, EngineConfig
+    from replicatinggpt_tpu.serve.requests import Request, SamplingParams
+    eng = Engine(params, CFG, EngineConfig(pool_size=2, max_queue=8))
+    eng.submit(Request(id="a", prompt=np.array([1, 2], np.int32),
+                       max_new_tokens=2,
+                       sampling=SamplingParams(greedy=True)))
+    eng.drain()                              # warm: one decode program
+    assert eng._decode_guard.compiles <= 1
+    # induce a jit-key change: f16 survives jnp.asarray (f64 would be
+    # silently downcast back to f32 under jax's x32 default)
+    eng._temp = eng._temp.astype(np.float16)
+    eng.submit(Request(id="b", prompt=np.array([3], np.int32),
+                       max_new_tokens=2,
+                       sampling=SamplingParams(greedy=True)))
+    with pytest.raises(RecompileError, match="serve/decode"):
+        eng.drain()
+
+
+def test_compile_guard_ignores_other_engines_compiles(params):
+    """Guards over the SHARED module-level jits must attribute only
+    compiles that happen during their own calls: a second engine with
+    a different pool shape compiling new programs must not trip the
+    first engine's guard."""
+    from replicatinggpt_tpu.serve import Engine, EngineConfig
+    from replicatinggpt_tpu.serve.requests import Request, SamplingParams
+
+    def req(rid):
+        return Request(id=rid, prompt=np.array([1, 2], np.int32),
+                       max_new_tokens=2,
+                       sampling=SamplingParams(greedy=True))
+
+    eng1 = Engine(params, CFG, EngineConfig(pool_size=2, max_queue=8))
+    eng1.submit(req("a"))
+    eng1.drain()
+    # different pool shape: compiles fresh programs into the SAME jits
+    eng2 = Engine(params, CFG, EngineConfig(pool_size=3, max_queue=8))
+    eng2.submit(req("b"))
+    eng2.drain()
+    eng1.submit(req("c"))                    # pure cache hit for eng1
+    res = eng1.drain()                       # must NOT raise
+    assert len(res) == 1
+    assert eng1._decode_guard.compiles <= 1
+
+
+def test_train_runner_wraps_step_in_guard(tmp_path):
+    """The runner's train step is guarded end-to-end (steady state: no
+    raise, guard visible on the returned history path)."""
+    from replicatinggpt_tpu.train.runner import train
+    tiny = get_config("test-tiny")
+    cfg = tiny.replace(
+        train=dataclasses.replace(tiny.train, max_iters=3, eval_interval=0,
+                                  eval_iters=2, log_interval=0,
+                                  batch_size=2),
+        dataset="datasets/shakespeare.txt")
+    res = train(cfg)                         # would raise on any recompile
+    assert int(jax.device_get(res.state.step)) == 3
+
+
+# ---------------------------------------------------------------------------
+# check_in_bounds (the GL006 sanctioned guard)
+# ---------------------------------------------------------------------------
+
+def test_check_in_bounds_concrete():
+    assert check_in_bounds(3, 2, 8)
+    assert check_in_bounds(np.int32(0), 8, 8)
+    assert check_in_bounds(jnp.int32(6), 2, 8)      # concrete jax scalar
+    assert check_in_bounds(np.array([1, 5, 3]), 2, 8)
+    with pytest.raises(IndexError, match="CLAMP"):
+        check_in_bounds(7, 2, 8)
+    with pytest.raises(IndexError):
+        check_in_bounds(-1, 1, 8)
+    with pytest.raises(IndexError):
+        check_in_bounds(np.array([0, 7]), 2, 8)     # max row out of range
+
+
+def test_check_in_bounds_traced_is_noop():
+    @jax.jit
+    def f(buf, row, p):
+        assert not check_in_bounds(p, 1, buf.shape[0])  # tracer: unchecked
+        return jax.lax.dynamic_update_slice(buf, row, (p,))
+
+    out = f(jnp.zeros((4,)), jnp.ones((1,)), jnp.int32(2))
+    assert float(out[2]) == 1.0
+
+
+def test_prefill_chunk_guard_rejects_out_of_bounds(params):
+    """Eager chunked prefill past the slot buffer must hard-fail (the
+    exact clamp-corruption path of PR 1), valid offsets must work."""
+    cache = init_kv_cache(CFG, 2)
+    chunk = jnp.zeros((1, 8), jnp.int32)
+    ok = prefill_chunk_into_slot(params, chunk, jnp.int32(24), jnp.int32(0),
+                                 cache, CFG)
+    assert ok["k"].shape == cache["k"].shape
+    with pytest.raises(IndexError, match="prefill chunk write"):
+        prefill_chunk_into_slot(params, chunk, jnp.int32(28), jnp.int32(0),
+                                cache, CFG)          # 28 + 8 > 32
+    with pytest.raises(IndexError, match="slot"):
+        prefill_chunk_into_slot(params, chunk, jnp.int32(0), jnp.int32(2),
+                                cache, CFG)          # slot 2 of pool of 2
+
+
+def test_decode_step_guard_rejects_out_of_bounds(params):
+    cache = init_kv_cache(CFG, 1)
+    tok = jnp.zeros((1,), jnp.int32)
+    with pytest.raises(IndexError, match="decode_step cache write"):
+        decode_step(params, tok, jnp.int32(CFG.block_size), cache, CFG)
+
+
+# ---------------------------------------------------------------------------
+# donation verification
+# ---------------------------------------------------------------------------
+
+def test_donation_report_counts_deleted_and_live():
+    a, b = jnp.ones((4,)), jnp.ones((4,))
+    a.delete()
+    rep = donation_report({"a": a, "b": b})
+    assert rep == {"deleted": 1, "live": 1}
+
+
+def test_assert_donated_skips_on_unsupported_backend():
+    """CPU ignores donation; asserting would always fail, so the check
+    reports 'unchecked' (False) instead of raising."""
+    assert not donation_supported()          # tests force JAX_PLATFORMS=cpu
+    live = {"w": jnp.ones((4,))}
+    assert assert_donated(live) is False     # no DonationError on CPU
+
+
+def test_assert_donated_raises_when_supported(monkeypatch):
+    monkeypatch.setattr("replicatinggpt_tpu.utils.sanitize."
+                        "donation_supported", lambda: True)
+    live = {"w": jnp.ones((4,))}
+    with pytest.raises(DonationError, match="still alive"):
+        assert_donated(live, what="train state")
+    gone = jnp.ones((2,))
+    gone.delete()
+    assert assert_donated({"w": gone}) is True
+
+
+# ---------------------------------------------------------------------------
+# GRAFT_SANITIZE mode
+# ---------------------------------------------------------------------------
+
+def test_sanitize_enabled_env(monkeypatch):
+    monkeypatch.delenv("GRAFT_SANITIZE", raising=False)
+    assert not sanitize_enabled()
+    monkeypatch.setenv("GRAFT_SANITIZE", "0")
+    assert not sanitize_enabled()
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+    assert sanitize_enabled()
+
+
+def test_sanitized_context_toggles_and_restores():
+    assert not jax.config.jax_debug_nans
+    with sanitized(True) as on:
+        assert on
+        assert jax.config.jax_debug_nans
+        assert jax.config.jax_check_tracer_leaks
+        with pytest.raises(FloatingPointError):
+            jnp.log(jnp.float32(-1.0))       # NaN raises inside the block
+    assert not jax.config.jax_debug_nans
+    assert not jax.config.jax_check_tracer_leaks
+    with sanitized(False) as on:
+        assert not on and not jax.config.jax_debug_nans
+
+
+def test_check_finite():
+    check_finite(1.25, "loss")
+    with pytest.raises(FloatingPointError, match="train loss"):
+        check_finite(float("nan"), "train loss")
+
+
+def test_engine_sanitize_validates_tokens(monkeypatch, params):
+    """GRAFT_SANITIZE=1 on the engine: a healthy run passes the token
+    range check; an out-of-range fetch raises."""
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+    from replicatinggpt_tpu.serve import Engine, EngineConfig
+    from replicatinggpt_tpu.serve.requests import Request, SamplingParams
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=4))
+    assert eng._sanitize
+    eng.submit(Request(id="a", prompt=np.array([1], np.int32),
+                       max_new_tokens=3,
+                       sampling=SamplingParams(greedy=True)))
+    res = eng.drain()
+    assert len(res) == 1 and len(res[0].tokens) == 3
+
+
+# ---------------------------------------------------------------------------
+# the slow sanitize tier: full train + serve under GRAFT_SANITIZE=1
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.sanitize
+def test_sanitize_mode_tiny_train_and_serve(monkeypatch, params):
+    """GRAFT_SANITIZE=1 end-to-end: a tiny real-corpus training run and
+    a replay through the serving engine both complete under jax's
+    tracer-leak + NaN checks (and the engine's token validation)."""
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+    from replicatinggpt_tpu.serve import EngineConfig, ReplayConfig, run_replay
+    from replicatinggpt_tpu.train.runner import train
+    tiny = get_config("test-tiny")
+    cfg = tiny.replace(
+        train=dataclasses.replace(tiny.train, max_iters=12, eval_interval=6,
+                                  eval_iters=2, log_interval=4,
+                                  batch_size=4),
+        dataset="datasets/shakespeare.txt")
+    res = train(cfg)
+    assert np.isfinite(res.final_eval["val"])
+    s = run_replay(params, CFG,
+                   ReplayConfig(n_requests=8, rate=2000.0, seed=0,
+                                prompt_len_max=12, max_new_tokens=4,
+                                greedy=True),
+                   EngineConfig(pool_size=2, max_queue=16))
+    assert s["n_completed"] == 8
+    assert s["recompiles_after_warmup"] == 0
