@@ -47,6 +47,10 @@ CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
+# tags merged into the artifact by emit() — e.g. {"backend":
+# "cpu-fallback"} when the accelerator probe gave up and the run
+# proceeded on CPU (a tagged measurement beats a zero-valued error line)
+_EMIT_TAGS: dict = {}
 
 
 def emit(payload: dict) -> None:
@@ -60,6 +64,8 @@ def emit(payload: dict) -> None:
         # sanitized runs pay for leak/NaN checks — never comparable to
         # (or mistakable for) a real measurement
         payload = {**payload, "sanitize": True}
+    if _EMIT_TAGS:
+        payload = {**payload, **_EMIT_TAGS}
     print(json.dumps(payload), flush=True)
 
 
@@ -323,7 +329,14 @@ def bench_serve(args) -> None:
     """Continuous-batching serving replay (serve/): a seeded Poisson
     trace through the pooled-KV engine; artifact is the aggregate
     decode throughput plus the TTFT/step-latency/occupancy summary and
-    the recompiles-after-warmup count (must be 0 at steady state)."""
+    the recompiles-after-warmup count (must be 0 at steady state).
+
+    ``--spec`` switches on speculative decoding over a repetitive
+    greedy trace (the drafter's favorable regime — the point of the
+    artifact is the serving-side multiplier: accept rate and mean
+    committed tokens per slot-step, which is 1.0 exactly without
+    speculation). ``--draft-model <preset>`` swaps the host-side
+    n-gram drafter for a small random-init draft model."""
     import jax
 
     from replicatinggpt_tpu.config import get_config
@@ -332,8 +345,10 @@ def bench_serve(args) -> None:
 
     cfg = get_config(args.preset)
     dev = jax.devices()[0]
+    spec_mode = ("model" if args.spec and args.draft_model
+                 else "ngram" if args.spec else "off")
     log(f"serve replay: {args.serve_requests} requests @ "
-        f"{args.serve_rate}/s, pool {args.serve_pool}, "
+        f"{args.serve_rate}/s, pool {args.serve_pool}, spec {spec_mode}, "
         f"model {cfg.model.n_layer}L/{cfg.model.n_head}H/"
         f"{cfg.model.n_embd}C on {dev.device_kind}")
     state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
@@ -341,14 +356,32 @@ def bench_serve(args) -> None:
                         rate=args.serve_rate, seed=0,
                         prompt_len_max=cfg.model.block_size // 2,
                         max_new_tokens=args.serve_max_new_tokens,
-                        top_k=50)
+                        top_k=50,
+                        # the speculative artifact measures the
+                        # multiplier where drafting can win: repetitive
+                        # prompts, greedy (deterministic accept rule)
+                        greedy=bool(args.spec),
+                        prompt_mode="repeat" if args.spec else "random",
+                        spec=spec_mode, spec_k=args.spec_k)
+    draft_params = draft_cfg = None
+    if spec_mode == "model":
+        from replicatinggpt_tpu.models.gpt import init_params
+        from replicatinggpt_tpu.serve import draft_config_from_preset
+        draft_cfg = draft_config_from_preset(cfg.model, args.draft_model)
+        draft_params = init_params(jax.random.PRNGKey(1), draft_cfg)
+        log(f"draft model: {args.draft_model} -> {draft_cfg.n_layer}L/"
+            f"{draft_cfg.n_head}H/{draft_cfg.n_embd}C (random init)")
     summary = run_replay(state.params, cfg.model, rcfg,
                          EngineConfig(pool_size=args.serve_pool,
-                                      max_queue=2 * args.serve_requests))
+                                      max_queue=2 * args.serve_requests),
+                         draft_params=draft_params, draft_cfg=draft_cfg)
     h = summary["histograms"]
+    sp = summary.get("speculative") or {}
     log(f"serve: {summary['aggregate_tokens_per_s']} tok/s aggregate, "
         f"TTFT p50 {h.get('ttft_s', {}).get('p50', 0) * 1e3:.1f} ms, "
-        f"{summary['recompiles_after_warmup']} recompiles after warmup")
+        f"{summary['recompiles_after_warmup']} recompiles after warmup"
+        + (f", accept rate {sp['accept_rate']}, "
+           f"{sp['mean_tokens_per_step']} tok/slot-step" if sp else ""))
     emit({
         "metric": "serve_replay_aggregate_tokens_per_sec",
         "value": summary["aggregate_tokens_per_s"],
@@ -363,6 +396,7 @@ def bench_serve(args) -> None:
             h.get("batch_fill_ratio", {}).get("mean", 0), 3),
         "recompiles_after_warmup": summary["recompiles_after_warmup"],
         "device_kind": dev.device_kind,
+        **({"speculative": sp} if sp else {}),
     })
 
 
@@ -712,6 +746,17 @@ def main() -> None:
                    help="--mode serve: KV-cache pool slots")
     p.add_argument("--serve-max-new-tokens", type=int, default=32,
                    help="--mode serve: per-request decode budget")
+    p.add_argument("--spec", action="store_true",
+                   help="--mode serve: speculative decoding over a "
+                        "repetitive greedy trace (n-gram drafter unless "
+                        "--draft-model is given)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="--mode serve --spec: drafted tokens per slot "
+                        "per step (static; one verify program per k)")
+    p.add_argument("--draft-model", default="",
+                   help="--mode serve --spec: preset sizing a small "
+                        "random-init draft model (vocab/block forced to "
+                        "the target's); empty = n-gram drafter")
     p.add_argument("--loss-chunk", type=int, default=None,
                    help="train modes: chunked CE head override "
                         "(ModelConfig.loss_chunk; 0 = one-shot logits)")
@@ -782,7 +827,21 @@ def main() -> None:
         # tunnel can eat many retries — starting the watchdog before it
         # burned the whole run budget on probes and emitted a false
         # "device hang" artifact while the device was merely unclaimed
-        probe_backend(args.platform, args.probe_tries, args.probe_wait)
+        try:
+            probe_backend(args.platform, args.probe_tries, args.probe_wait)
+        except RuntimeError as probe_err:
+            # a wedged accelerator tunnel must not zero the artifact: a
+            # CPU-tagged measurement still carries signal (BENCH_r01..r05
+            # were all zeros from exactly this failure mode). The CPU
+            # backend initializes in-process, but probe it anyway — if
+            # even CPU fails, something bigger is wrong and the error
+            # artifact is the honest outcome.
+            log(f"backend probe exhausted retries ({probe_err}); "
+                f"falling back to JAX_PLATFORMS=cpu")
+            probe_backend("cpu", 1, 0.0)
+            args.platform = "cpu"
+            _EMIT_TAGS["backend"] = "cpu-fallback"
+            _EMIT_TAGS["backend_error"] = str(probe_err)[:200]
         start_watchdog(args.watchdog, metric, unit)
         import jax
 

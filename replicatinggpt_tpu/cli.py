@@ -240,16 +240,30 @@ def cmd_serve_replay(args) -> int:
         prompt_len_max=args.prompt_len_max or cfg.model.block_size // 2,
         max_new_tokens=args.request_max_new_tokens, greedy=args.greedy,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        deadline_s=args.deadline_s)
+        deadline_s=args.deadline_s, prompt_mode=args.prompt_mode,
+        spec=args.spec, spec_k=args.spec_k, spec_ngram=args.spec_ngram)
     ecfg = EngineConfig(pool_size=args.pool_size, max_queue=args.max_queue,
                         prefill_chunk=args.prefill_chunk)
+    draft_params = draft_cfg = None
+    if rcfg.spec == "model":
+        from .models.gpt import init_params, param_count
+        from .serve import draft_config_from_preset
+        draft_cfg = draft_config_from_preset(cfg.model, args.draft_model)
+        draft_params = init_params(jax.random.PRNGKey(cfg.train.seed + 1),
+                                   draft_cfg)
+        print(f"draft model: {args.draft_model} -> "
+              f"{draft_cfg.n_layer}L/{draft_cfg.n_head}H/"
+              f"{draft_cfg.n_embd}C ({param_count(draft_params):,} params, "
+              f"random init)", file=sys.stderr)
     dev = jax.devices()[0]
     print(f"serve-replay: {rcfg.n_requests} requests @ {rcfg.rate}/s, "
           f"pool {ecfg.pool_size}, queue {ecfg.max_queue}, "
+          f"spec {rcfg.spec} (k={rcfg.spec_k}), "
           f"model {cfg.model.n_layer}L/{cfg.model.n_head}H/"
           f"{cfg.model.n_embd}C on {dev.platform} ({dev.device_kind})",
           file=sys.stderr)
-    summary = run_replay(state.params, cfg.model, rcfg, ecfg)
+    summary = run_replay(state.params, cfg.model, rcfg, ecfg,
+                         draft_params=draft_params, draft_cfg=draft_cfg)
     print(format_summary(summary))
     if args.json:
         print(json.dumps(summary))
@@ -376,6 +390,24 @@ def main(argv=None) -> int:
     ps.add_argument("--top-p", type=float, default=0.0)
     ps.add_argument("--deadline-s", type=float, default=0.0,
                     help="per-request deadline after arrival (0 = none)")
+    ps.add_argument("--spec", default="off",
+                    choices=["off", "ngram", "model"],
+                    help="speculative decoding drafter: host-side n-gram "
+                         "prompt lookup (no extra params) or a small "
+                         "random-init draft model (--draft-model preset)")
+    ps.add_argument("--spec-k", type=int, default=4,
+                    help="drafted tokens per slot per step (static: one "
+                         "verify program per k)")
+    ps.add_argument("--spec-ngram", type=int, default=3,
+                    help="n-gram drafter match width")
+    ps.add_argument("--draft-model", default="test-tiny",
+                    help="--spec model: preset whose architecture sizes "
+                         "the draft model (vocab/block/dtype forced to "
+                         "the target's)")
+    ps.add_argument("--prompt-mode", default="random",
+                    choices=["random", "repeat"],
+                    help="'repeat' tiles small patterns — the "
+                         "speculative-friendly repetitive trace")
     ps.add_argument("--json", action="store_true",
                     help="also print the summary as one JSON line")
     ps.set_defaults(fn=cmd_serve_replay)

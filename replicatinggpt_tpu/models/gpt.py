@@ -32,7 +32,8 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from ..ops.attention import (cached_attention, full_causal_attention,
-                             uint8_inverted_dropout)
+                             uint8_inverted_dropout,
+                             windowed_cached_attention)
 from ..utils.sanitize import check_in_bounds
 
 Params = Dict[str, Any]
@@ -767,6 +768,100 @@ def decode_step_multi(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
             carry, _ = body(carry, (lp, i))
         x, new_k, new_v = carry
     return _decode_head(x, params, cfg, cd), {"k": new_k, "v": new_v}
+
+
+def verify_step_multi(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
+                      n_valid: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+                      cfg: ModelConfig
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """The target-side forward of speculative decoding: score a static
+    (k+1)-wide token window per slot in ONE pass over the pooled cache.
+
+    window: (B, W) int32 — per slot ``[last_committed, draft_1..draft_k]``;
+    pos: (B,) int32 per-slot base positions (window token j sits at
+    ``pos[b] + j``); n_valid: (B,) int32 — how many DRAFT positions are
+    real for each slot (0..W-1; the base token at j=0 is always real).
+    Returns (logits (B, W, V) float32, updated cache): logits[:, j] is
+    the next-token distribution after window token j, so j=0 reproduces
+    ``decode_step_multi``'s output and j>=1 scores the drafted suffix.
+
+    Cache discipline mirrors ``decode_step_multi``: K/V for window token
+    j is scattered at (layer, b, pos[b]+j) and queries attend positions
+    <= their own (ops.attention.windowed_cached_attention), i.e.
+    write-then-attend. Padding window positions (j > n_valid[b]) route
+    their scatter index to S — explicitly out of bounds, where scatter
+    drops the update (mode='drop'), so a slot near the end of its buffer
+    never clamp-corrupts earlier K/V; their logits are garbage and the
+    caller discards them (acceptance is masked by n_valid). Rejected
+    drafts leave stale K/V past the committed frontier — harmless under
+    the pool invariant (every position is overwritten before any query
+    sits at or beyond it). Per-row, per-position math is the decode
+    path's exactly, which is what greedy speculative parity rests on
+    (tests/test_speculative.py).
+    """
+    cd = _dtype(cfg.dtype)
+    B, W = window.shape
+    S = cache["k"].shape[cache_seq_axis(cfg)]
+    bidx = jnp.arange(B)[:, None]                       # (B, 1)
+    offs = jnp.arange(W, dtype=jnp.int32)[None, :]      # (1, W)
+    abs_pos = pos[:, None] + offs                       # (B, W)
+    # wpe gather clamps out-of-bounds rows (padding only — real window
+    # positions are bounded host-side: pos + n_valid <= S - 1)
+    x = (params["wte"].astype(cd)[window]
+         + params["wpe"].astype(cd)[jnp.minimum(abs_pos, S - 1)])  # (B, W, C)
+    # padding writes go to S where the scatter drops them
+    wpos = jnp.where(offs <= n_valid[:, None], abs_pos, S)
+    packed = cfg.decode_cache_layout == "packed"
+    H = cfg.n_head
+
+    def body(carry, inputs):
+        h_in, ck, cv = carry
+        lp, layer_idx = inputs
+        if packed:
+            q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)  # (B, W, C)
+            ck = ck.at[layer_idx, bidx, wpos, :].set(
+                k_m.astype(ck.dtype), mode="drop")
+            cv = cv.at[layer_idx, bidx, wpos, :].set(
+                v_m.astype(cv.dtype), mode="drop")
+            k_cache = jax.lax.dynamic_index_in_dim(ck, layer_idx, 0,
+                                                   keepdims=False)
+            v_cache = jax.lax.dynamic_index_in_dim(cv, layer_idx, 0,
+                                                   keepdims=False)
+            attn = windowed_cached_attention(
+                _split_heads(q_m, H), _split_heads(k_cache, H),
+                _split_heads(v_cache, H), pos)
+        else:
+            q, k, v = _cached_qkv(h_in, lp, cfg, cd)    # (B, H, W, D)
+            # scatter value laid out (B, W, H, D): advanced indices
+            # (bidx, wpos) broadcast to (B, W) and land first
+            ck = ck.at[layer_idx, bidx, :, wpos, :].set(
+                k.transpose(0, 2, 1, 3).astype(ck.dtype), mode="drop")
+            cv = cv.at[layer_idx, bidx, :, wpos, :].set(
+                v.transpose(0, 2, 1, 3).astype(cv.dtype), mode="drop")
+            k_cache = jax.lax.dynamic_index_in_dim(ck, layer_idx, 0,
+                                                   keepdims=False)
+            v_cache = jax.lax.dynamic_index_in_dim(cv, layer_idx, 0,
+                                                   keepdims=False)
+            attn = windowed_cached_attention(q, k_cache, v_cache, pos)
+        return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
+                ck, cv), None
+
+    if cfg.use_layer_scan:
+        layer_ids = jnp.arange(cfg.n_layer)
+        (x, new_k, new_v), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], layer_ids))
+    else:
+        carry = (x, cache["k"], cache["v"])
+        for i in range(cfg.n_layer):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            carry, _ = body(carry, (lp, i))
+        x, new_k, new_v = carry
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                    cfg.layernorm_eps)
+    head = (params["wte"].astype(cd).T if cfg.tied_head
+            else params["lm_head"].astype(cd))
+    return (x @ head).astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
 def prefill_chunk_into_slot(params: Params, idx: jnp.ndarray,

@@ -91,6 +91,37 @@ def full_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
 
 
+def windowed_cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                              v_cache: jnp.ndarray, base_index: jnp.ndarray,
+                              *, scale: Optional[float] = None) -> jnp.ndarray:
+    """Multi-position decode attention against a KV cache — the verify
+    core of speculative decoding (serve/speculative.py).
+
+    q: (B, H, W, D) — W window queries per row, query j sitting at
+    absolute position ``base_index[b] + j``; caches: (B, H, S, D);
+    base_index: (B,) int32 per-row base positions. Query j attends cache
+    positions <= base_index[b] + j — the same write-then-attend masking
+    as ``cached_attention`` (W=1 reduces to it exactly), widened so one
+    forward scores a whole drafted window per slot. Stale cache entries
+    past each query's own position (rejected drafts from an earlier
+    speculative step) get NEG_INF before the softmax, so they carry
+    exactly zero weight.
+    """
+    *_, S, D = k_cache.shape
+    W = q.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    qj = jax.lax.broadcasted_iota(jnp.int32, (W, S), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (W, S), 1)
+    limit = jnp.asarray(base_index)[:, None, None, None] + qj  # (B,1,W,S)
+    logits = jnp.where(kpos <= limit, logits, NEG_INF)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v_cache.dtype),
+                      v_cache)
+
+
 def cached_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                      v_cache: jnp.ndarray, cache_index: jnp.ndarray, *,
                      scale: Optional[float] = None) -> jnp.ndarray:

@@ -191,6 +191,21 @@ def batched_top_p_filter(logits: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(off[:, None], logits, filtered)
 
 
+def filter_logits_batched(logits: jnp.ndarray, temperature: jnp.ndarray,
+                          top_k: jnp.ndarray, top_p: jnp.ndarray
+                          ) -> jnp.ndarray:
+    """The per-row stochastic filter pipeline — temperature -> top-k ->
+    top-p, each (B,)-parameterized — factored out of
+    ``sample_tokens_batched`` so the speculative verifier
+    (serve/speculative.py) scores drafted tokens against EXACTLY the
+    distribution the engine would have sampled from (rejection sampling
+    is only target-preserving if both sides use the same filters)."""
+    scaled = logits / jnp.maximum(
+        jnp.asarray(temperature, jnp.float32), 1e-6)[:, None]
+    f = batched_top_k_filter(scaled, top_k)
+    return batched_top_p_filter(f, top_p)
+
+
 def sample_tokens_batched(rngs: jnp.ndarray, logits: jnp.ndarray,
                           temperature: jnp.ndarray, top_k: jnp.ndarray,
                           top_p: jnp.ndarray, greedy: jnp.ndarray
@@ -202,10 +217,7 @@ def sample_tokens_batched(rngs: jnp.ndarray, logits: jnp.ndarray,
     temperature -> top-k -> top-p, each per-row, then a per-row
     categorical draw from the row's own key."""
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / jnp.maximum(
-        jnp.asarray(temperature, jnp.float32), 1e-6)[:, None]
-    f = batched_top_k_filter(scaled, top_k)
-    f = batched_top_p_filter(f, top_p)
+    f = filter_logits_batched(logits, temperature, top_k, top_p)
     sampled = jax.vmap(jax.random.categorical)(rngs, f).astype(jnp.int32)
     return jnp.where(jnp.asarray(greedy, bool), greedy_tok, sampled)
 

@@ -7,6 +7,11 @@
 - ``engine``: the per-step loop — admit (chunked prefill into the
   slot's cache region) + ONE jitted multi-slot decode with per-slot
   positions/mask/RNG/sampling params;
+- ``speculative``: drafters (host-side n-gram prompt lookup, or a
+  second small model with its own pooled cache) + the exact
+  point-mass rejection-sampling acceptance behind the engine's jitted
+  multi-slot verify step — up to k+1 tokens per slot per full-model
+  forward;
 - ``replay``: synthetic Poisson trace driver (`serve-replay` CLI,
   `bench.py --mode serve`).
 """
@@ -16,7 +21,11 @@ from .engine import Engine, EngineConfig, compile_counts
 from .replay import ReplayConfig, format_summary, make_trace, run_replay
 from .requests import Request, RequestResult, SamplingParams
 from .scheduler import Scheduler
+from .speculative import (Drafter, ModelDrafter, NGramDrafter,
+                          draft_config_from_preset, make_drafter)
 
 __all__ = ["CachePool", "Engine", "EngineConfig", "compile_counts",
            "ReplayConfig", "format_summary", "make_trace", "run_replay",
-           "Request", "RequestResult", "SamplingParams", "Scheduler"]
+           "Request", "RequestResult", "SamplingParams", "Scheduler",
+           "Drafter", "ModelDrafter", "NGramDrafter",
+           "draft_config_from_preset", "make_drafter"]

@@ -20,9 +20,29 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import ModelConfig
 from ..models.gpt import cache_seq_axis, init_kv_cache
+
+
+def prefill_chunk_size(requested: int, block_size: int) -> int:
+    """Effective prefill chunk: the requested (or 0 = auto
+    min(64, block_size)) size rounded DOWN to a divisor of block_size.
+    Divisibility is a correctness requirement, not a preference: the
+    final chunk of a P-token prompt is dispatched at offset
+    (ceil(P/c)-1)*c and padded to c, so a non-divisor c could push the
+    padded chunk past the cache buffer — and
+    jax.lax.dynamic_update_slice silently CLAMPS out-of-bounds starts,
+    which would overwrite valid earlier K/V instead of erroring. With
+    c | block_size, ceil(P/c)*c <= block_size for every admissible P.
+    One definition on purpose: the engine's prefill (EngineConfig.chunk)
+    and the model drafter's (serve/speculative.py) must agree on this
+    rule or drift apart silently."""
+    c = min(requested or min(64, block_size), block_size)
+    while block_size % c:
+        c -= 1
+    return c
 
 
 def commit_default(x):
@@ -52,6 +72,12 @@ class CachePool:
             cfg, n_slots, max_len=self.max_len, dtype=dtype))
         self._free: List[int] = list(range(n_slots - 1, -1, -1))
         self._owner: Dict[int, str] = {}        # slot -> request id
+        # host-side per-slot positions, updated by the engine in place
+        # (its step arrays alias this buffer). Living on the pool makes
+        # the committed frontier readable by a drafter
+        # (serve/speculative.py) without any per-slot device sync — the
+        # generated suffix itself is host bookkeeping in the engine.
+        self.positions = np.zeros((n_slots,), np.int32)
 
     @property
     def seq_len(self) -> int:
@@ -69,13 +95,17 @@ class CachePool:
     def occupancy(self) -> float:
         return self.n_used / self.n_slots
 
-    def acquire(self, request_id: str) -> Optional[int]:
-        """Assign a free slot to ``request_id``; None when the pool is
-        exhausted (the scheduler then leaves the request queued)."""
+    def acquire(self, request_id: str,
+                position: int = 0) -> Optional[int]:
+        """Assign a free slot to ``request_id`` starting at ``position``
+        (the last prompt index — decode rewrites it first); None when
+        the pool is exhausted (the scheduler then leaves the request
+        queued)."""
         if not self._free:
             return None
         slot = self._free.pop()
         self._owner[slot] = request_id
+        self.positions[slot] = position
         return slot
 
     def release(self, slot: int) -> None:

@@ -17,12 +17,18 @@ Per step the engine:
 3. runs ONE jitted ``decode_step_multi`` over ALL slots — per-slot
    positions, per-slot active mask, per-slot RNG streams, per-slot
    sampling params (``sample.generate.sample_tokens_batched``) — and
-   fetches the (n_slots,) sampled tokens.
+   fetches the (n_slots,) sampled tokens. With a drafter attached
+   (serve/speculative.py) the decode phase is instead ONE jitted
+   ``_engine_verify``: score a static (k+1)-token drafted window per
+   slot against the pooled cache and commit 1..k+1 accepted tokens —
+   up to k+1 tokens per slot per full-model forward, interleaved with
+   chunked prefill admissions exactly like plain decode.
 
-Zero recompiles at steady state: the decode program is keyed only on
-the (static) model config and pool shape, the prefill program only on
-the chunk shape; both are module-level jits whose cache sizes the tests
-assert stay flat across a long replay (tests/test_serve.py).
+Zero recompiles at steady state: the decode/verify programs are keyed
+only on the (static) model config, pool shape and draft width, the
+prefill program only on the chunk shape; all are module-level jits
+whose cache sizes the tests assert stay flat across a long replay
+(tests/test_serve.py, tests/test_speculative.py).
 
 Observability: per-request TTFT / decode tok/s / queue wait, engine
 counters (admissions, rejections, completions, tokens), slot-occupancy
@@ -43,7 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import ModelConfig
-from ..models.gpt import decode_step_multi, prefill_chunk_into_slot
+from ..models.gpt import (decode_step_multi, prefill_chunk_into_slot,
+                          verify_step_multi)
 from ..sample.generate import sample_tokens_batched
 from ..utils.logging import Metrics
 from ..utils.profiling import StepTimer, annotate
@@ -52,6 +59,8 @@ from .cache_pool import CachePool
 from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_LENGTH_CAP,
                        FINISH_MAX_TOKENS, Request, RequestResult)
 from .scheduler import Scheduler
+from .speculative import (DraftContext, Drafter, spec_accept_and_sample,
+                          timed_draft)
 
 
 @dataclass(frozen=True)
@@ -66,19 +75,10 @@ class EngineConfig:
     prefill_chunk: int = 0
 
     def chunk(self, block_size: int) -> int:
-        """Effective prefill chunk: the requested (or auto) size rounded
-        DOWN to a divisor of block_size. Divisibility is a correctness
-        requirement, not a preference: the final chunk of a P-token
-        prompt is dispatched at offset (ceil(P/c)-1)*c and padded to c,
-        so a non-divisor c could push the padded chunk past the cache
-        buffer — and jax.lax.dynamic_update_slice silently CLAMPS
-        out-of-bounds starts, which would overwrite valid earlier K/V
-        instead of erroring. With c | block_size, ceil(P/c)*c <=
-        block_size for every admissible P."""
-        c = min(self.prefill_chunk or min(64, block_size), block_size)
-        while block_size % c:
-            c -= 1
-        return c
+        """Effective prefill chunk — see ``cache_pool.prefill_chunk_size``
+        for the divisor-rounding rule and why it is load-bearing."""
+        from .cache_pool import prefill_chunk_size
+        return prefill_chunk_size(self.prefill_chunk, block_size)
 
 
 @dataclass
@@ -119,15 +119,43 @@ def _engine_prefill(params, chunk, offset, slot, cache, cfg: ModelConfig):
     return prefill_chunk_into_slot(params, chunk, offset, slot, cache, cfg)
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _engine_verify(params, window, pos, m, active, cache, rngs, temp,
+                   top_k, top_p, greedy, cfg: ModelConfig):
+    """The speculative steady-state program: ONE target forward over a
+    static (n_slots, k+1) window + per-position acceptance. Draft count
+    k is carried by the window's static width, so a fixed --spec-k
+    means exactly one extra compiled program next to decode/prefill.
+    All request-level inputs — positions, valid-draft counts, sampling
+    params, the drafted tokens themselves — are traced (n_slots,)-sized
+    arrays, so acceptance outcomes never retrace. Inactive slots run at
+    position 0 with zero valid drafts (their writes land in regions the
+    next occupant's prefill overwrites) and their outputs are masked.
+    """
+    pos_eff = jnp.where(active, pos, 0)
+    m_eff = jnp.where(active, m, 0)
+    logits, cache = verify_step_multi(params, window, pos_eff, m_eff,
+                                      cache, cfg)
+    n_acc, out, rngs = spec_accept_and_sample(rngs, logits, window, m_eff,
+                                              temp, top_k, top_p, greedy)
+    return (jnp.where(active, n_acc, 0),
+            jnp.where(active[:, None], out, 0), cache, rngs)
+
+
 def compile_counts() -> Dict[str, int]:
-    """Process-wide compiled-program counts for the two engine entry
-    points (module-level jits, so they accumulate across engines). The
-    replay driver's before/after bookkeeping reads these; the *live*
-    steady-state enforcement is per-engine via :class:`CompileGuard`
-    (utils.sanitize), which raises from the offending step instead of
-    reporting after the fact."""
+    """Process-wide compiled-program counts for the engine entry points
+    (module-level jits, so they accumulate across engines), including
+    the speculative verify step and the model drafter's two programs.
+    The replay driver's before/after bookkeeping reads these; the
+    *live* steady-state enforcement is per-engine via
+    :class:`CompileGuard` (utils.sanitize), which raises from the
+    offending step instead of reporting after the fact."""
+    from .speculative import _draft_decode_k, _draft_prefill
     return {"decode": _engine_decode._cache_size(),
-            "prefill": _engine_prefill._cache_size()}
+            "prefill": _engine_prefill._cache_size(),
+            "verify": _engine_verify._cache_size(),
+            "draft_decode": _draft_decode_k._cache_size(),
+            "draft_prefill": _draft_prefill._cache_size()}
 
 
 class Engine:
@@ -146,12 +174,23 @@ class Engine:
 
     def __init__(self, params, cfg: ModelConfig,
                  ecfg: EngineConfig = EngineConfig(),
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 drafter: Optional[Drafter] = None):
         cfg.validate()
         self.params = params
         self.cfg = cfg
         self.ecfg = ecfg
         self.clock = clock
+        self.drafter = drafter
+        if drafter is not None:
+            dcfg = getattr(drafter, "cfg", None)
+            if dcfg is not None:       # model drafter: pools must line up
+                assert dcfg.vocab_size == cfg.vocab_size, \
+                    "draft model must share the target vocab"
+                assert dcfg.block_size == cfg.block_size, \
+                    "draft model must share the target block_size"
+                assert drafter.pool_size == ecfg.pool_size, \
+                    "draft pool must match the engine pool"
         self.pool = CachePool(cfg, ecfg.pool_size)
         self.scheduler = Scheduler(ecfg.max_queue, cfg.block_size,
                                    clock=clock)
@@ -160,7 +199,9 @@ class Engine:
         P = ecfg.pool_size
         self._chunk = ecfg.chunk(cfg.block_size)
         self._tok = np.zeros((P,), np.int32)
-        self._pos = np.zeros((P,), np.int32)
+        # ALIAS of pool.positions (one host buffer): the pool exposes the
+        # committed frontier to drafters, the engine advances it in place
+        self._pos = self.pool.positions
         self._active = np.zeros((P,), bool)
         self._temp = np.ones((P,), np.float32)
         self._top_k = np.zeros((P,), np.int32)
@@ -184,6 +225,7 @@ class Engine:
         # remains for offline summaries).
         self._decode_guard = CompileGuard(_engine_decode, "serve/decode")
         self._prefill_guard = CompileGuard(_engine_prefill, "serve/prefill")
+        self._verify_guard = CompileGuard(_engine_verify, "serve/verify")
         self._sanitize = sanitize_enabled()
 
     # ---------------------------------------------------------------- API
@@ -244,7 +286,8 @@ class Engine:
         self.metrics.gauge("slot_occupancy", self.pool.occupancy)
 
         if self._active.any():
-            finished.extend(self._decode_once())
+            finished.extend(self._verify_once() if self.drafter is not None
+                            else self._decode_once())
         return finished
 
     def drain(self, max_steps: int = 1_000_000) -> List[RequestResult]:
@@ -261,15 +304,33 @@ class Engine:
         s["n_steps"] = self.n_steps
         s["compile_counts"] = compile_counts()
         s["compile_guards"] = {"decode": self._decode_guard.stats(),
-                               "prefill": self._prefill_guard.stats()}
+                               "prefill": self._prefill_guard.stats(),
+                               "verify": self._verify_guard.stats()}
+        if self.drafter is not None:
+            c = self.metrics.counters
+            drafted = c.get("spec_draft_tokens", 0)
+            slot_steps = c.get("slot_steps", 0)
+            s["speculative"] = {
+                "drafter": self.drafter.name,
+                "k": self.drafter.k,
+                "accept_rate": (round(c.get("spec_accepted_tokens", 0)
+                                      / drafted, 4) if drafted else 0.0),
+                "mean_tokens_per_step": (round(c.get("decode_tokens", 0)
+                                               / slot_steps, 3)
+                                         if slot_steps else 0.0),
+                "draft_overhead_s":
+                    self.metrics.hist_summary("draft_overhead_s"),
+            }
         return s
 
     # ----------------------------------------------------------- internals
 
     def _admit(self, req: Request, t_submit: float, now: float) -> None:
-        slot = self.pool.acquire(req.id)
-        assert slot is not None, "scheduler admitted past pool capacity"
         P = int(req.prompt.size)
+        # acquire sets pool.positions[slot] = P - 1, which self._pos
+        # aliases — the first decode step rewrites the last prompt index
+        slot = self.pool.acquire(req.id, position=P - 1)
+        assert slot is not None, "scheduler admitted past pool capacity"
         S = self.pool.seq_len
         # decode step i runs at position P-1+i (the first rewrites the
         # last prompt position), so the slot supports S - P + 1 new
@@ -296,8 +357,9 @@ class Engine:
                                                     c * chunk:(c + 1) * chunk]),
                     jnp.int32(c * chunk), jnp.int32(slot), cache, self.cfg)
         self.pool.cache = cache
+        if self.drafter is not None:
+            self.drafter.on_admit(slot, req.prompt)
         self._tok[slot] = req.prompt[-1]
-        self._pos[slot] = P - 1
         self._active[slot] = True
         sp = req.sampling
         self._temp[slot] = sp.temperature
@@ -360,11 +422,118 @@ class Engine:
                 finished.append(self._finish_slot(slot, reason, now))
         return finished
 
+    def _histories(self) -> List[Optional[np.ndarray]]:
+        """Per-slot prompt+generated token history — pure host data (the
+        engine appends every committed token), so drafters never pay a
+        device sync for it."""
+        out: List[Optional[np.ndarray]] = [None] * self.ecfg.pool_size
+        for slot, st in self._slots.items():
+            # fromiter, not asarray: tokens is a host list of ints — no
+            # device round-trip here, and the conversion can't be
+            # mistaken (by reader or linter) for one
+            out[slot] = np.concatenate(
+                [st.req.prompt,
+                 np.fromiter(st.tokens, np.int32, len(st.tokens))])
+        return out
+
+    def _verify_once(self) -> List[RequestResult]:
+        """One speculative step: host-side draft -> ONE jitted verify
+        over all slots -> commit 1..k+1 tokens per slot. The drafter's
+        proposals are clamped per slot by cache room (the window's last
+        REAL write position must stay inside the slot buffer) and by
+        the remaining token budget, both host-side — the device program
+        only ever sees traced (n_slots,)-sized inputs."""
+        k = self.drafter.k
+        S = self.pool.seq_len
+        P = self.ecfg.pool_size
+        ctx = DraftContext(
+            tok=self._tok, pos=self._pos, active=self._active,
+            histories=(self._histories() if self.drafter.needs_history
+                       else None))
+        draft_toks, draft_len, dt = timed_draft(self.drafter, ctx)
+        self.metrics.observe("draft_overhead_s", dt)
+        m = np.zeros((P,), np.int32)
+        for slot, st in self._slots.items():
+            if not self._active[slot]:
+                continue
+            room = S - 1 - int(self._pos[slot])
+            budget = st.cap - len(st.tokens) - 1
+            m[slot] = max(0, min(int(draft_len[slot]), k, room, budget))
+        window = np.zeros((P, k + 1), np.int32)
+        window[:, 0] = self._tok
+        window[:, 1:] = draft_toks
+        # the host-side bound the traced verify writes rely on: every
+        # ACTIVE slot's real window positions (j <= m) stay inside the
+        # slot buffer; padding positions route to an explicit
+        # scatter-drop (GL006). Scoped to active slots: a released
+        # slot's stale frontier can legitimately sit at S (a request
+        # that finished by filling its buffer), and the verify program
+        # runs those slots at position 0 anyway.
+        check_in_bounds(np.where(self._active, self._pos + m, 0), 1, S,
+                        what="speculative verify window")
+        with annotate("serve/verify"):
+            self.step_timer.start()
+            n_acc, out, cache, rngs = self._verify_guard(
+                self.params, jnp.asarray(window), jnp.asarray(self._pos),
+                jnp.asarray(m), jnp.asarray(self._active), self.pool.cache,
+                self._rngs, jnp.asarray(self._temp),
+                jnp.asarray(self._top_k), jnp.asarray(self._top_p),
+                jnp.asarray(self._greedy), self.cfg)
+            self.step_timer.lap(n_acc)
+        self.pool.cache = cache
+        self._rngs = rngs
+        # ONE device->host snapshot per step for every slot's outcome
+        n_acc_h, out_h = (np.asarray(a) for a in
+                          jax.device_get((n_acc, out)))
+        if self._sanitize:
+            bad = (out_h < 0) | (out_h >= self.cfg.vocab_size)
+            if bad.any():
+                raise FloatingPointError(
+                    f"sanitize: verify produced out-of-range token(s) "
+                    f"{out_h[bad][:4].tolist()} (vocab "
+                    f"{self.cfg.vocab_size})")
+        now = self.clock()
+        self.n_steps += 1
+        n_active = int(self._active.sum())
+        drafted = int(m.sum())
+        accepted = int(n_acc_h.sum())
+        emitted = accepted + n_active          # +1 correction/bonus each
+        self.metrics.observe("batch_fill_ratio", n_active / P)
+        self.metrics.inc("decode_steps")
+        self.metrics.inc("decode_tokens", emitted)
+        self.metrics.inc("slot_steps", n_active)
+        self.metrics.inc("spec_draft_tokens", drafted)
+        self.metrics.inc("spec_accepted_tokens", accepted)
+        if drafted:
+            self.metrics.observe("accept_rate", accepted / drafted)
+        self.metrics.observe("tokens_per_slot_step", emitted / n_active)
+        finished: List[RequestResult] = []
+        for slot in list(self._slots):
+            if not self._active[slot]:
+                continue
+            st = self._slots[slot]
+            n_emit = int(n_acc_h[slot]) + 1
+            first = not st.tokens
+            st.tokens.extend(int(t) for t in out_h[slot, :n_emit])
+            if first:
+                st.t_first_token = now
+                self.metrics.observe("ttft_s", now - st.t_submit)
+            st.t_last_token = now
+            self._tok[slot] = st.tokens[-1]
+            self._pos[slot] += n_emit
+            if len(st.tokens) >= st.cap:
+                reason = (FINISH_LENGTH_CAP if st.capped
+                          else FINISH_MAX_TOKENS)
+                finished.append(self._finish_slot(slot, reason, now))
+        return finished
+
     def _finish_slot(self, slot: int, reason: str,
                      now: float) -> RequestResult:
         st = self._slots.pop(slot)
         self._active[slot] = False
         self.pool.release(slot)
+        if self.drafter is not None:
+            self.drafter.on_release(slot)
         n = len(st.tokens)
         decode_tps = 0.0
         if n > 1 and st.t_last_token > st.t_first_token:

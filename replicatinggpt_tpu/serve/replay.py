@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -22,6 +22,7 @@ from ..config import ModelConfig
 from ..utils.sanitize import sanitized
 from .engine import Engine, EngineConfig, compile_counts
 from .requests import Request, RequestResult, SamplingParams
+from .speculative import make_drafter
 
 
 @dataclass(frozen=True)
@@ -37,12 +38,20 @@ class ReplayConfig:
     top_k: int = 20
     top_p: float = 0.0
     deadline_s: float = 0.0        # per-request deadline after arrival; 0=off
+    prompt_mode: str = "random"    # 'random' | 'repeat' (tiled small
+                                   # pattern — the speculative bench trace)
+    spec: str = "off"              # drafter: 'off' | 'ngram' | 'model'
+    spec_k: int = 4                # drafted tokens per slot per step
+    spec_ngram: int = 3            # n-gram drafter match width
 
 
 def make_trace(mcfg: ModelConfig, rcfg: ReplayConfig
                ) -> List[Tuple[float, Request]]:
     """Seeded (arrival_time, request) list: exponential inter-arrivals,
-    uniform prompt lengths (clamped to block_size), uniform token ids."""
+    uniform prompt lengths (clamped to block_size), uniform token ids —
+    or, with ``prompt_mode='repeat'``, each prompt a tiled random <=4
+    token pattern (repetitive text is the n-gram drafter's favorable
+    regime; the serve-spec bench row uses this trace)."""
     rng = np.random.default_rng(rcfg.seed)
     hi = min(rcfg.prompt_len_max, mcfg.block_size)
     lo = min(rcfg.prompt_len_min, hi)
@@ -54,7 +63,13 @@ def make_trace(mcfg: ModelConfig, rcfg: ReplayConfig
         # host numpy RNG: float() here is not a device round-trip
         t += float(rng.exponential(1.0 / max(rcfg.rate, 1e-9)))  # graftlint: disable=GL004
         P = int(rng.integers(lo, hi + 1))
-        prompt = rng.integers(0, mcfg.vocab_size, (P,), dtype=np.int64)
+        if rcfg.prompt_mode == "repeat":
+            pat = rng.integers(0, mcfg.vocab_size,
+                               (min(int(rng.integers(1, 5)), P),),
+                               dtype=np.int64)
+            prompt = np.tile(pat, -(-P // pat.size))[:P]
+        else:
+            prompt = rng.integers(0, mcfg.vocab_size, (P,), dtype=np.int64)
         trace.append((t, Request(
             id=f"r{i:04d}", prompt=prompt.astype(np.int32),
             max_new_tokens=rcfg.max_new_tokens, sampling=sp,
@@ -63,23 +78,35 @@ def make_trace(mcfg: ModelConfig, rcfg: ReplayConfig
 
 
 def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
-               ecfg: EngineConfig, warmup: bool = True) -> dict:
+               ecfg: EngineConfig, warmup: bool = True,
+               draft_params=None,
+               draft_cfg: Optional[ModelConfig] = None) -> dict:
     """Replay the trace in wall-clock time; returns the summary dict.
 
     ``warmup`` first pushes one tiny request through a throwaway engine
-    of the same shapes so the two device programs compile outside the
-    timed replay — the summary's ``recompiles_after_warmup`` then
-    asserts the steady-state claim (0 on a healthy run).
+    of the same shapes so the device programs (including the
+    speculative verify step and the model drafter's two programs, when
+    configured) compile outside the timed replay — the summary's
+    ``recompiles_after_warmup`` then asserts the steady-state claim
+    (0 on a healthy run). ``rcfg.spec`` selects the drafter; the
+    'model' mode additionally needs ``draft_params``/``draft_cfg``
+    (see ``speculative.draft_config_from_preset``). Drafters are
+    stateful, so each engine gets its own.
     """
+    def drafter():
+        return make_drafter(rcfg.spec, rcfg.spec_k, rcfg.spec_ngram,
+                            ecfg.pool_size, draft_params, draft_cfg,
+                            ecfg.prefill_chunk)
+
     if warmup:
-        w = Engine(params, mcfg, ecfg)
+        w = Engine(params, mcfg, ecfg, drafter=drafter())
         w.submit(Request(id="warmup", prompt=np.zeros((1,), np.int32),
                          max_new_tokens=1,
                          sampling=SamplingParams(greedy=True)))
         w.drain()
     warm = compile_counts()
 
-    engine = Engine(params, mcfg, ecfg)
+    engine = Engine(params, mcfg, ecfg, drafter=drafter())
     trace = make_trace(mcfg, rcfg)
     results: List[RequestResult] = []
     i = 0
@@ -148,4 +175,11 @@ def format_summary(s: dict) -> str:
         f" (pool), queue wait {pct('queue_wait_s', 1e3, ' ms')}",
         f"recompiles after warmup: {s['recompiles_after_warmup']}",
     ]
+    sp = s.get("speculative")
+    if sp:
+        lines.insert(2, (
+            f"speculative ({sp['drafter']}, k={sp['k']}): accept rate "
+            f"{sp['accept_rate']:.3f}, {sp['mean_tokens_per_step']:.2f} "
+            f"tokens/slot-step, draft overhead p50 "
+            f"{sp['draft_overhead_s'].get('p50', 0) * 1e3:.2f} ms"))
     return "\n".join(lines)
