@@ -943,5 +943,131 @@ embed_p = jax.pmap(embed, in_axes=(None, 0))
     checker=_check_spmd_global_capture))
 
 
+# ---------------------------------------------------------------------------
+# GL009 — broad except swallowing checkpoint / device I/O failures
+# ---------------------------------------------------------------------------
+
+# call footprints that mean "this try block does checkpoint or device
+# I/O": last dotted segment (methods on managers, jax transfer calls)
+# or a bare name (builtins). Tuned to this codebase's idioms — orbax
+# manager methods, jax device transfer, raw file handles.
+_GL009_IO_ATTRS = {"save", "restore", "restore_latest", "item_metadata",
+                   "wait_until_finished", "device_get", "device_put",
+                   "block_until_ready", "read_bytes", "write_bytes",
+                   "read_text", "write_text"}
+_GL009_IO_NAMES = {"open"}
+_GL009_IO_PREFIXES = ("ocp.", "orbax.", "jax.device_", "os.")
+
+_GL009_LOG_NAMES = {"print", "log", "warn", "warning", "error", "exception",
+                    "debug", "info", "log_step", "log_eval"}
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _gl009_is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:                                  # bare `except:`
+        return True
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        d = dotted(t)
+        return d is not None and d.split(".")[-1] in _BROAD_EXC
+    if isinstance(t, ast.Tuple):
+        return any(dotted(e) is not None
+                   and dotted(e).split(".")[-1] in _BROAD_EXC
+                   for e in t.elts)
+    return False
+
+
+def _gl009_io_call(call: ast.Call) -> Optional[str]:
+    f = dotted(call.func)
+    if f is None:
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _GL009_IO_ATTRS:
+            return call.func.attr            # method on a computed object
+        return None
+    last = f.split(".")[-1]
+    if last in _GL009_IO_ATTRS or f in _GL009_IO_NAMES:
+        return f
+    if any(f.startswith(p) for p in _GL009_IO_PREFIXES):
+        return f
+    return None
+
+
+def _gl009_handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler neither re-raises nor logs — the failure
+    leaves no trace at all."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call):
+            f = dotted(node.func)
+            name = (f.split(".")[-1] if f
+                    else getattr(node.func, "attr", ""))
+            if name in _GL009_LOG_NAMES:
+                return False
+    return True
+
+
+def _check_swallowed_io_except(tree, lines, path):
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        io_call = None
+        for sub in node.body:
+            for c in ast.walk(sub):
+                if isinstance(c, ast.Call):
+                    io_call = io_call or _gl009_io_call(c)
+        if io_call is None:
+            continue
+        for handler in node.handlers:
+            if not _gl009_is_broad_handler(handler):
+                continue
+            if not _gl009_handler_swallows(handler):
+                continue
+            findings.append(_finding(
+                "GL009", handler,
+                f"broad `except` swallows failures of `{io_call}(...)` "
+                f"with no re-raise and no log — a corrupt/partial "
+                f"checkpoint or failed device transfer disappears here "
+                f"and resurfaces later as an unrelated cryptic error; "
+                f"catch the narrow exception, or log/re-raise with the "
+                f"step and path named",
+                path, lines))
+    return findings
+
+
+_register(Rule(
+    id="GL009", name="swallowed-io-except",
+    rationale=(
+        "`except Exception:` (or bare `except:`) around checkpoint or "
+        "device I/O that neither re-raises nor logs erases the only "
+        "evidence of a half-written checkpoint, a failed device "
+        "transfer, or transient storage trouble. The failure then "
+        "resurfaces steps later as a cryptic unrelated error — this "
+        "package's restore path did exactly that, silently skipping "
+        "its RNG-impl check on corrupt checkpoints until the "
+        "robustness PR made corruption a named, typed error. Narrow "
+        "the exception (OSError for transient I/O, KeyError for "
+        "missing metadata) or convert it into a typed error naming "
+        "the step."),
+    bad="""\
+def latest_rng_shape(mngr, step):
+    try:
+        return mngr.item_metadata(step)["state"]["rng"].shape
+    except Exception:        # corrupt step vanishes here
+        return None
+""",
+    good="""\
+def latest_rng_shape(mngr, step):
+    try:
+        return mngr.item_metadata(step)["state"]["rng"].shape
+    except (KeyError, TypeError, OSError) as e:
+        raise CorruptCheckpointError(
+            f"checkpoint step {step} is corrupt: {e}") from e
+""",
+    checker=_check_swallowed_io_except))
+
+
 def all_rule_ids() -> List[str]:
     return sorted(RULES)

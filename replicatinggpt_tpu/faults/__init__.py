@@ -1,0 +1,27 @@
+"""Fault injection + the supervision layer that survives it (PR 4).
+
+- ``inject``: deterministic :class:`FaultPlan` + the no-op-by-default
+  seams compiled into train/checkpoint/serve hot paths;
+- ``supervise``: train self-healing — loss-spike/NaN rollback to the
+  last *verified* checkpoint, data-cursor advance, bounded retries;
+- ``watchdog``: serve self-healing policies — step-stall watchdog,
+  speculative auto-disable with re-probe, load shedding.
+
+The ops story (fault matrix -> detection -> automatic recovery ->
+operator action) lives in docs/robustness.md.
+"""
+
+from .inject import Fault, FaultPlan, active, clear, fire, install, installed
+from .supervise import (LossSpikeError, NonFiniteLossError,
+                        SupervisedResult, SupervisionConfig,
+                        SupervisionExhausted, supervised_train)
+from .watchdog import (DEFAULT_SERVE_RESILIENCE, LoadShedder,
+                       ResilienceConfig, SpecHealth, StepWatchdog)
+
+__all__ = [
+    "Fault", "FaultPlan", "active", "clear", "fire", "install", "installed",
+    "LossSpikeError", "NonFiniteLossError", "SupervisedResult",
+    "SupervisionConfig", "SupervisionExhausted", "supervised_train",
+    "DEFAULT_SERVE_RESILIENCE", "LoadShedder", "ResilienceConfig",
+    "SpecHealth", "StepWatchdog",
+]

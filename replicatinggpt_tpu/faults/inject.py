@@ -1,0 +1,236 @@
+"""Deterministic fault injection behind no-op-by-default seams.
+
+The supervision layer (faults/supervise.py, faults/watchdog.py) claims
+to survive preemption, transient I/O faults, silent corruption, and
+numerical blowups — claims that are untestable without a way to *cause*
+those faults on demand. This module is that way: a :class:`FaultPlan`
+describes exactly which fault fires at which site and step, and the
+seams compiled into the hot paths (`train/runner.py`,
+`train/checkpoint.py`, `serve/engine.py`, `serve/speculative.py`) ask
+it, via :func:`fire`, whether to misbehave.
+
+Design constraints, in order:
+
+1. **No-op by default.** With no plan installed, a seam is one module
+   attribute read and a ``None`` comparison — nothing on the device,
+   nothing allocated, no branch the jit ever sees (every seam runs in
+   host code between dispatches).
+2. **Deterministic.** Faults trigger on explicit per-site indices (the
+   engine passes its step counter, the checkpoint manager the step id)
+   or on the seam's own call counter — never on wall-clock races. Each
+   fault fires at most ``times`` times across the whole plan lifetime,
+   so a rolled-back training run that replays the faulted step does
+   NOT re-trip a one-shot fault (exactly how a transient fault behaves
+   in production, and what the bitwise-resume chaos tests rely on).
+   Corruption payloads draw from a ``seed``-keyed RNG.
+3. **Injected faults are indistinguishable from real ones.** The
+   checkpoint corruptor flips bytes in the files orbax actually wrote;
+   the transient-I/O fault raises a plain ``OSError``; the SIGTERM
+   fault raises the real signal through the real handler. Recovery
+   code cannot special-case "test mode" because there is none.
+
+Sites and kinds (the fault matrix — docs/robustness.md):
+
+========================  ==========  =======================================
+site                      kind        effect at the seam
+========================  ==========  =======================================
+``ckpt/save``             ``io``      transient ``OSError`` before the write
+``ckpt/restore``          ``io``      transient ``OSError`` before the read
+``ckpt/finalize``         ``corrupt``   flip bytes in the step's largest file
+``ckpt/finalize``         ``truncate``  truncate it to half (partial write)
+``ckpt/finalize``         ``drop_manifest``  delete the integrity manifest
+``train/step``            ``sigterm``   raise SIGTERM (preemption notice)
+``train/step``            ``nan_params``  scale one param leaf by NaN
+``train/loss``            ``nan``     observed loss becomes NaN
+``train/loss``            ``spike``   observed loss scaled by ``arg``
+``serve/step``            ``delay``   ``time.sleep(arg)`` before the dispatch
+``spec/draft``            ``collapse``  shift every drafted token by one
+========================  ==========  =======================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One planned fault.
+
+    Fires when its ``site`` seam is hit with an index in
+    ``[at, at + times)`` — where the index is the seam's explicit
+    counter (engine step, checkpoint step) when it passes one, else the
+    seam's own call count — AND the fault has fired fewer than
+    ``times`` times in total. The total-count cap is what makes a
+    step-indexed fault one-shot across a rollback replay of the same
+    step. ``after_s`` (optional) additionally delays eligibility until
+    that many seconds after plan installation.
+    """
+
+    site: str
+    kind: str
+    at: int = 0
+    times: int = 1
+    arg: float = 0.0
+    after_s: float = 0.0
+
+
+class FaultPlan:
+    """An installed set of faults plus the bookkeeping that makes them
+    deterministic: per-site call counters, per-fault fire counts, and a
+    ``fired`` log the chaos tests assert against."""
+
+    def __init__(self, *faults: Fault, seed: int = 0):
+        self.faults: Tuple[Fault, ...] = faults
+        self.seed = seed
+        self._counts: Dict[str, int] = {}
+        self._fired_counts: Dict[int, int] = {}
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        #: (site, kind, index) log of every firing, in order
+        self.fired: List[Tuple[str, str, int]] = []
+
+    def fire(self, site: str, index: Optional[int] = None) -> Optional[Fault]:
+        with self._lock:
+            n = self._counts.get(site, 0)
+            self._counts[site] = n + 1
+            idx = n if index is None else index
+            now = time.monotonic()
+            for i, f in enumerate(self.faults):
+                if f.site != site:
+                    continue
+                if not (f.at <= idx < f.at + f.times):
+                    continue
+                if self._fired_counts.get(i, 0) >= f.times:
+                    continue
+                if f.after_s and now - self._t0 < f.after_s:
+                    continue
+                self._fired_counts[i] = self._fired_counts.get(i, 0) + 1
+                self.fired.append((site, f.kind, idx))
+                return f
+            return None
+
+    def rng(self, site: str) -> np.random.Generator:
+        """Seeded payload RNG, stable per (plan seed, site)."""
+        return np.random.default_rng(
+            [self.seed, sum(site.encode())])
+
+    def count(self, site: str, kind: Optional[str] = None) -> int:
+        return sum(1 for s, k, _ in self.fired
+                   if s == site and (kind is None or k == kind))
+
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _PLAN
+    _PLAN = plan
+    return plan
+
+
+def clear() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+@contextlib.contextmanager
+def installed(plan: FaultPlan):
+    """``with installed(FaultPlan(...)) as plan:`` — guaranteed cleanup
+    so a failing chaos test can't leak faults into the next one."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        clear()
+
+
+def fire(site: str, index: Optional[int] = None) -> Optional[Fault]:
+    """The seam entry point: None (almost always) or the fault to apply.
+
+    The no-plan fast path is a single module-global read — cheap enough
+    to sit inside the train loop and the serve engine's step."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.fire(site, index)
+
+
+# ---------------------------------------------------------------------------
+# payload helpers — the code that actually breaks things
+# ---------------------------------------------------------------------------
+
+def corrupt_step_dir(directory: str, step: int, kind: str,
+                     rng: np.random.Generator) -> str:
+    """Corrupt a finalized checkpoint step on disk, the way real bit rot
+    or a partial write would: ``corrupt`` flips bytes at seeded offsets
+    in the step's largest file (silent corruption — only a checksum can
+    see it); ``truncate`` cuts that file to half (a crash mid-write).
+    Returns the path touched. Raises FileNotFoundError if the step dir
+    has no files (the save must be finalized before corrupting it)."""
+    step_dir = os.path.join(directory, str(step))
+    candidates = []
+    for root, _, files in os.walk(step_dir):
+        for name in files:
+            p = os.path.join(root, name)
+            sz = os.path.getsize(p)
+            if sz > 0:
+                candidates.append((sz, p))
+    if not candidates:
+        raise FileNotFoundError(f"no files under {step_dir} to corrupt")
+    _, target = max(candidates)
+    size = os.path.getsize(target)
+    if kind == "truncate":
+        with open(target, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    else:
+        offsets = rng.integers(0, size, size=min(8, size))
+        with open(target, "r+b") as f:
+            for off in offsets:
+                f.seek(int(off))
+                b = f.read(1)
+                f.seek(int(off))
+                f.write(bytes([b[0] ^ 0xFF]))
+    return target
+
+
+def apply_train_state_fault(fault: Fault, state):
+    """Apply a ``train/step`` fault to the live train state (host side,
+    between dispatches). ``sigterm`` raises the real signal — the CLI's
+    installed handler turns it into a graceful checkpoint-and-stop,
+    exactly the preemption path. ``nan_params`` scales the first
+    parameter leaf by NaN: the next forward produces a non-finite loss,
+    which is the supervisor's job to catch and roll back."""
+    if fault.kind == "sigterm":
+        import signal
+        signal.raise_signal(signal.SIGTERM)
+        return state
+    if fault.kind == "nan_params":
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(state.params)
+        # eager scalar multiply keeps shape/dtype/placement — the guarded
+        # train-step jit sees identical avals and does not recompile
+        leaves[0] = leaves[0] * float("nan")
+        return state._replace(
+            params=jax.tree_util.tree_unflatten(treedef, leaves))
+    raise ValueError(f"unknown train/step fault kind {fault.kind!r}")
+
+
+def apply_loss_fault(fault: Fault, loss: float) -> float:
+    """Apply a ``train/loss`` fault to the observed (host) loss value."""
+    if fault.kind == "nan":
+        return float("nan")
+    if fault.kind == "spike":
+        return loss * (fault.arg or 100.0)
+    raise ValueError(f"unknown train/loss fault kind {fault.kind!r}")

@@ -1,0 +1,193 @@
+"""Train self-healing: loss-spike/NaN supervision with checkpoint rollback.
+
+PR 2 gave the train loop *detection* (sanitize-mode NaN checks kill the
+run at train/runner.py's loss fetch) and the checkpoint layer gives it
+*durability*; this module adds *recovery* — the piece a long preemptible
+run actually needs. :func:`supervised_train` wraps ``train.runner.train``
+in the standard production recipe:
+
+1. the runner (under a :class:`SupervisionConfig`) fetches the loss once
+   per ``check_every`` dispatches and raises a typed error on a
+   non-finite value (:class:`NonFiniteLossError`) or on a spike past
+   ``spike_factor`` x the running EMA (:class:`LossSpikeError`);
+2. the supervisor catches it, counts a ``rollback``, and re-enters
+   training with ``resume=True`` — restore_latest lands on the newest
+   checkpoint that passes integrity verification (a NaN-poisoned or
+   corrupt save is skipped, counted as ``ckpt_fallbacks``);
+3. a *repeat* failure at the same step means the fault is in the data
+   window, not transient — the supervisor advances the data cursor
+   ``skip_window`` optimizer steps past it (``data_skips``) before the
+   next attempt;
+4. after ``max_rollbacks`` failed recoveries it re-raises as
+   :class:`SupervisionExhausted` — dying is correct once recovery
+   demonstrably doesn't work.
+
+A transient fault (a one-shot blowup) therefore resumes **bitwise
+identical** to an uninterrupted run: the rollback restores the exact
+state + data cursor, replay re-consumes the same token stream, and the
+step-keyed dropout RNG makes the tail deterministic — pinned by
+tests/test_faults.py.
+
+All recovery actions land in a ``utils.logging.Metrics`` instance
+(``rollbacks``, ``data_skips``, plus the checkpoint manager's
+``ckpt_fallbacks`` / ``save_retries`` / ``restore_retries``) so the
+bench artifacts can report them.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ..utils.logging import Metrics, StepLogger
+
+
+class NonFiniteLossError(FloatingPointError):
+    """Training loss went NaN/inf at ``step`` (subclasses
+    FloatingPointError so GRAFT_SANITIZE handlers keep working)."""
+
+    def __init__(self, step: int, loss: float):
+        super().__init__(f"train loss at step {step} is {loss} — "
+                         f"non-finite")
+        self.step = step
+        self.loss = loss
+
+
+class LossSpikeError(RuntimeError):
+    """Training loss spiked past the supervisor's budget at ``step``."""
+
+    def __init__(self, step: int, loss: float, ema: float, factor: float):
+        super().__init__(
+            f"train loss at step {step} spiked to {loss:.4f} "
+            f"(> {factor:.1f} x running mean {ema:.4f})")
+        self.step = step
+        self.loss = loss
+        self.ema = ema
+
+
+class SupervisionExhausted(RuntimeError):
+    """Recovery failed ``max_rollbacks`` times — the run is dead."""
+
+
+@dataclass(frozen=True)
+class SupervisionConfig:
+    """Runner-side detection knobs (the runner only *detects*; the
+    supervisor recovers). ``check_every`` is in dispatches — each check
+    is one host sync, the documented cost of supervision. ``spike_factor``
+    0 disables spike detection (non-finite always raises)."""
+
+    check_every: int = 1
+    spike_factor: float = 0.0
+    ema_alpha: float = 0.1
+    warmup_checks: int = 5
+
+
+class LossTracker:
+    """Host-side EMA + finiteness checks over supervised loss fetches.
+    One instance per train() call (the EMA must restart with the run —
+    a rolled-back run re-learns its baseline from the replayed steps)."""
+
+    def __init__(self, cfg: SupervisionConfig):
+        self.cfg = cfg
+        self.ema: Optional[float] = None
+        self.checks = 0
+
+    def check(self, step: int, loss: float) -> None:
+        if not math.isfinite(loss):
+            raise NonFiniteLossError(step, loss)
+        self.checks += 1
+        if self.ema is None:
+            self.ema = loss
+            return
+        if (self.cfg.spike_factor > 0
+                and self.checks > self.cfg.warmup_checks
+                and loss > self.cfg.spike_factor * self.ema):
+            raise LossSpikeError(step, loss, self.ema,
+                                 self.cfg.spike_factor)
+        a = self.cfg.ema_alpha
+        self.ema = (1 - a) * self.ema + a * loss
+
+
+@dataclass
+class SupervisedResult:
+    """``train()``'s result plus the recovery story that produced it."""
+
+    result: Any                            # runner.TrainResult
+    metrics: Metrics
+    attempts: int = 1
+
+    @property
+    def counters(self) -> Dict[str, float]:
+        return dict(self.metrics.counters)
+
+
+def supervised_train(cfg, *, checkpoint_manager, mesh=None,
+                     logger: Optional[StepLogger] = None,
+                     supervision: SupervisionConfig = SupervisionConfig(),
+                     max_rollbacks: int = 3, skip_window: int = 1,
+                     metrics: Optional[Metrics] = None,
+                     resume: bool = False,
+                     **train_kwargs) -> SupervisedResult:
+    """Run ``train()`` under loss supervision with automatic rollback.
+
+    ``skip_window`` — optimizer steps the data cursor advances past the
+    offending window when the SAME step fails twice (a transient fault
+    gets one clean replay first; only a repeat implicates the data).
+    ``max_rollbacks`` bounds total recoveries before
+    :class:`SupervisionExhausted`. Extra ``train_kwargs`` pass through
+    to :func:`~replicatinggpt_tpu.train.runner.train`.
+    """
+    from ..train.runner import train      # lazy: runner imports faults
+
+    logger = logger or StepLogger()
+    metrics = metrics or Metrics()
+    failures_at: Dict[int, int] = {}
+    skip = 0
+    for attempt in range(max_rollbacks + 1):
+        try:
+            res = train(cfg, mesh=mesh, logger=logger,
+                        checkpoint_manager=checkpoint_manager,
+                        resume=resume, supervision=supervision,
+                        skip_data_steps=skip, **train_kwargs)
+            for k, v in checkpoint_manager.recovery.items():
+                if v:
+                    metrics.inc(k, v)
+            return SupervisedResult(result=res, metrics=metrics,
+                                    attempts=attempt + 1)
+        except (NonFiniteLossError, LossSpikeError) as e:
+            metrics.inc("rollbacks")
+            step = getattr(e, "step", -1)
+            failures_at[step] = failures_at.get(step, 0) + 1
+            logger.log(f"supervisor: {e} — rollback "
+                       f"{attempt + 1}/{max_rollbacks} to last good "
+                       f"checkpoint")
+            if attempt == max_rollbacks:
+                for k, v in checkpoint_manager.recovery.items():
+                    if v:
+                        metrics.inc(k, v)
+                raise SupervisionExhausted(
+                    f"training failed {max_rollbacks + 1} times "
+                    f"(last: {e}); no recovery path left") from e
+            # a durable rollback target: block until the last good save
+            # is actually on disk before re-entering
+            checkpoint_manager.wait()
+            resume = True
+            if failures_at[step] > 1 and skip_window > 0:
+                # same step failed after a clean replay: the data window
+                # itself is implicated. The skip is applied at the
+                # RESTORED checkpoint's cursor, so it must cover the
+                # whole distance from there THROUGH the offending
+                # window — skipping only skip_window batches at the
+                # restored cursor would shift the stream and feed the
+                # poisoned window to an earlier step instead
+                restored = checkpoint_manager.latest_step() or 0
+                skip = max(step - restored, 0) + skip_window
+                metrics.inc("data_skips")
+                logger.log(f"supervisor: step {step} failed again after "
+                           f"rollback; advancing data cursor {skip} "
+                           f"step(s) (from checkpoint {restored} past "
+                           f"the offending window)")
+            else:
+                skip = 0
+    raise AssertionError("unreachable")

@@ -12,12 +12,19 @@
   point-mass rejection-sampling acceptance behind the engine's jitted
   multi-slot verify step — up to k+1 tokens per slot per full-model
   forward;
+- ``journal``: append-only submit/finish request journal — restart
+  recovery requeues accepted-but-unfinished requests into a fresh
+  engine (docs/robustness.md);
 - ``replay``: synthetic Poisson trace driver (`serve-replay` CLI,
   `bench.py --mode serve`).
+
+Self-healing (step watchdog, speculative auto-disable, load shedding)
+is opt-in via ``faults.watchdog.ResilienceConfig`` on the Engine.
 """
 
 from .cache_pool import CachePool
 from .engine import Engine, EngineConfig, compile_counts
+from .journal import RequestJournal
 from .replay import ReplayConfig, format_summary, make_trace, run_replay
 from .requests import Request, RequestResult, SamplingParams
 from .scheduler import Scheduler
@@ -25,6 +32,7 @@ from .speculative import (Drafter, ModelDrafter, NGramDrafter,
                           draft_config_from_preset, make_drafter)
 
 __all__ = ["CachePool", "Engine", "EngineConfig", "compile_counts",
+           "RequestJournal",
            "ReplayConfig", "format_summary", "make_trace", "run_replay",
            "Request", "RequestResult", "SamplingParams", "Scheduler",
            "Drafter", "ModelDrafter", "NGramDrafter",

@@ -49,6 +49,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import ModelConfig
+from ..faults.inject import fire as fault_fire
+from ..faults.watchdog import (LoadShedder, ResilienceConfig, SpecHealth,
+                               StepWatchdog)
 from ..models.gpt import (decode_step_multi, prefill_chunk_into_slot,
                           verify_step_multi)
 from ..sample.generate import sample_tokens_batched
@@ -57,7 +60,7 @@ from ..utils.profiling import StepTimer, annotate
 from ..utils.sanitize import CompileGuard, check_in_bounds, sanitize_enabled
 from .cache_pool import CachePool
 from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_LENGTH_CAP,
-                       FINISH_MAX_TOKENS, Request, RequestResult)
+                       FINISH_MAX_TOKENS, FINISH_SHED, Request, RequestResult)
 from .scheduler import Scheduler
 from .speculative import (DraftContext, Drafter, spec_accept_and_sample,
                           timed_draft)
@@ -175,7 +178,14 @@ class Engine:
     def __init__(self, params, cfg: ModelConfig,
                  ecfg: EngineConfig = EngineConfig(),
                  clock: Callable[[], float] = time.monotonic,
-                 drafter: Optional[Drafter] = None):
+                 drafter: Optional[Drafter] = None,
+                 rcfg: Optional[ResilienceConfig] = None,
+                 journal=None):
+        """``rcfg`` (faults.watchdog.ResilienceConfig) opts into the
+        self-healing policies — stall watchdog, speculative auto-disable
+        with re-probe, load shedding; None/all-zero changes nothing.
+        ``journal`` (serve.journal.RequestJournal) records accepted and
+        finished requests for restart recovery."""
         cfg.validate()
         self.params = params
         self.cfg = cfg
@@ -227,6 +237,25 @@ class Engine:
         self._prefill_guard = CompileGuard(_engine_prefill, "serve/prefill")
         self._verify_guard = CompileGuard(_engine_verify, "serve/verify")
         self._sanitize = sanitize_enabled()
+        # self-healing (faults.watchdog): all policies opt-in via rcfg.
+        # Degraded transitions move between the two already-budgeted
+        # steady-state programs (verify <-> decode), so CompileGuard
+        # keeps enforcing zero recompiles through every mode switch.
+        self.rcfg = rcfg or ResilienceConfig()
+        self.journal = journal
+        self._spec_active = drafter is not None
+        self._watchdog = (StepWatchdog(self.rcfg)
+                          if self.rcfg.watchdog_on else None)
+        self._spec_health = (SpecHealth(self.rcfg)
+                             if (self.rcfg.spec_guard_on
+                                 and drafter is not None) else None)
+        self._shedder = (LoadShedder(self.rcfg)
+                         if self.rcfg.shed_on else None)
+        self._probe_pending = False
+        self._spec_pinned = False     # operator pin (set_spec_active)
+        #: host-side log of resilience events (bounded — see _event),
+        #: for tests/ops
+        self.events: List[str] = []
 
     # ---------------------------------------------------------------- API
 
@@ -234,8 +263,13 @@ class Engine:
         self.metrics.inc("requests_submitted")
         reason = self.scheduler.submit(req)
         if reason is not None:
-            self.metrics.inc(reason)
+            # an expired-at-submit deadline is a terminal finish, not a
+            # backpressure rejection — count it with the finishes
+            self.metrics.inc("finished_" + reason
+                             if reason == FINISH_DEADLINE else reason)
             return RequestResult(id=req.id, tokens=[], finish_reason=reason)
+        if self.journal is not None:
+            self.journal.record_submit(req)
         return None
 
     def cancel(self, request_id: str) -> bool:
@@ -245,6 +279,7 @@ class Engine:
         now = self.clock()
         if self.scheduler.cancel(request_id):
             self.metrics.inc("finished_" + FINISH_CANCELLED)
+            self._journal_finish(request_id, FINISH_CANCELLED)
             self._pending.append(RequestResult(
                 id=request_id, tokens=[], finish_reason=FINISH_CANCELLED))
             return True
@@ -260,10 +295,13 @@ class Engine:
                 and not self._pending)
 
     def step(self) -> List[RequestResult]:
-        """One scheduling iteration: expire -> admit -> decode."""
+        """One scheduling iteration: expire -> shed -> admit -> decode,
+        with the self-healing policies (watchdog / speculative health /
+        shedding) folded around the decode phase when configured."""
         finished: List[RequestResult] = self._pending
         self._pending = []
         now = self.clock()
+        t_wall = time.perf_counter()
 
         for req, t_submit, reason in self.scheduler.drain_expired(now):
             finished.append(self._finish_unstarted(req, t_submit, reason,
@@ -273,6 +311,18 @@ class Engine:
             if dl is not None and now >= dl:
                 finished.append(self._finish_slot(slot, FINISH_DEADLINE,
                                                   now))
+
+        if self._shedder is not None:
+            n_shed = self._shedder.observe(self.scheduler.depth,
+                                           self.ecfg.max_queue)
+            if n_shed:
+                for req, t_submit in self.scheduler.shed(n_shed):
+                    finished.append(self._finish_unstarted(
+                        req, t_submit, FINISH_SHED, now))
+                self.metrics.inc("shed_requests", n_shed)
+                self._event(f"step {self.n_steps}: shed {n_shed} "
+                                   f"queued request(s) under sustained "
+                                   f"overload")
 
         admitted, dropped = self.scheduler.admit(self.pool.n_free, now)
         for req, t_submit, reason in dropped:
@@ -285,10 +335,72 @@ class Engine:
         self.metrics.gauge("slots_active", int(self._active.sum()))
         self.metrics.gauge("slot_occupancy", self.pool.occupancy)
 
+        # speculative re-probe countdown while degraded (auto-disabled
+        # only: an operator pin via set_spec_active(False) must stick)
+        if (self.drafter is not None and not self._spec_active
+                and not self._spec_pinned
+                and self._spec_health is not None
+                and self._active.any()):
+            if self._spec_health.tick_disabled():
+                self.set_spec_active(True)
+                self._probe_pending = True
+                self.metrics.inc("spec_reprobes")
+                self._event(f"step {self.n_steps}: re-probing "
+                                   f"speculative decoding")
+
+        # chaos seam: an artificially slow/stuck step (no-op without an
+        # installed FaultPlan) — what the watchdog must catch
+        flt = fault_fire("serve/step", index=self.n_steps)
+        if flt is not None and flt.kind == "delay":
+            time.sleep(flt.arg)
+
         if self._active.any():
-            finished.extend(self._verify_once() if self.drafter is not None
+            use_spec = self.drafter is not None and self._spec_active
+            finished.extend(self._verify_once() if use_spec
                             else self._decode_once())
+            if self._watchdog is not None:
+                dur = time.perf_counter() - t_wall
+                if self._watchdog.observe(dur):
+                    self.metrics.inc("watchdog_stalls")
+                    self.metrics.gauge("last_stall_s", dur)
+                    self._event(f"step {self.n_steps}: stall — "
+                                       f"{dur * 1e3:.1f} ms step against "
+                                       f"a p99-derived budget")
         return finished
+
+    def set_spec_active(self, active: bool) -> None:
+        """Flip speculative decoding between its verify program and the
+        plain decode program (both CompileGuard-budgeted — no new
+        compilations at steady state). Re-enabling resyncs stateful
+        drafters from host-side histories: tokens committed while
+        degraded never went through the drafter's cache. A manual
+        disable through this method PINS the degraded mode — the
+        auto-re-probe policy leaves it alone until set_spec_active(True)
+        lifts the pin (the auto-disable path flips ``_spec_active``
+        directly and stays re-probeable)."""
+        active = active and self.drafter is not None
+        if active and not self._spec_active:
+            hists = self._histories()
+            for slot in self._slots:
+                if self._active[slot] and hists[slot] is not None:
+                    self.drafter.resync(slot, hists[slot])
+        self._spec_pinned = not active and self.drafter is not None
+        self._spec_active = active
+
+    @property
+    def spec_active(self) -> bool:
+        return self._spec_active
+
+    def _journal_finish(self, request_id: str, reason: str) -> None:
+        if self.journal is not None:
+            self.journal.record_finish(request_id, reason)
+
+    def _event(self, msg: str) -> None:
+        # a soak run with recurring degradations must not grow host
+        # memory without bound (the Metrics reservoir rationale)
+        self.events.append(msg)
+        if len(self.events) > 256:
+            del self.events[:len(self.events) - 256]
 
     def drain(self, max_steps: int = 1_000_000) -> List[RequestResult]:
         out: List[RequestResult] = []
@@ -306,6 +418,15 @@ class Engine:
         s["compile_guards"] = {"decode": self._decode_guard.stats(),
                                "prefill": self._prefill_guard.stats(),
                                "verify": self._verify_guard.stats()}
+        c = self.metrics.counters
+        s["recovery"] = {
+            "watchdog_stalls": int(c.get("watchdog_stalls", 0)),
+            "spec_disables": int(c.get("spec_disables", 0)),
+            "spec_reprobes": int(c.get("spec_reprobes", 0)),
+            "shed_requests": int(c.get("shed_requests", 0)),
+            "spec_active": self._spec_active,
+            "events": list(self.events[-32:]),
+        }
         if self.drafter is not None:
             c = self.metrics.counters
             drafted = c.get("spec_draft_tokens", 0)
@@ -450,7 +571,8 @@ class Engine:
             tok=self._tok, pos=self._pos, active=self._active,
             histories=(self._histories() if self.drafter.needs_history
                        else None))
-        draft_toks, draft_len, dt = timed_draft(self.drafter, ctx)
+        draft_toks, draft_len, dt = timed_draft(self.drafter, ctx,
+                                                self.cfg.vocab_size)
         self.metrics.observe("draft_overhead_s", dt)
         m = np.zeros((P,), np.int32)
         for slot, st in self._slots.items():
@@ -507,6 +629,26 @@ class Engine:
         if drafted:
             self.metrics.observe("accept_rate", accepted / drafted)
         self.metrics.observe("tokens_per_slot_step", emitted / n_active)
+        if self._spec_health is not None:
+            if self._spec_health.observe(drafted, accepted):
+                # the drafter is a pure tax at this accept rate: fall
+                # back to plain decode (same shapes, already-budgeted
+                # program) and re-probe later with backoff
+                self._spec_active = False
+                self._probe_pending = False
+                self._spec_health.on_disable()
+                self.metrics.inc("spec_disables")
+                self._event(
+                    f"step {self.n_steps}: speculative decoding disabled "
+                    f"(windowed accept rate below "
+                    f"{self.rcfg.spec_disable_threshold})")
+            elif (self._probe_pending
+                  and len(self._spec_health.window)
+                  >= self.rcfg.spec_window):
+                self._probe_pending = False
+                self._spec_health.on_reenable()
+                self._event(f"step {self.n_steps}: speculative "
+                                   f"re-probe healthy; backoff reset")
         finished: List[RequestResult] = []
         for slot in list(self._slots):
             if not self._active[slot]:
@@ -544,6 +686,7 @@ class Engine:
             ttft_s=(st.t_first_token - st.t_submit) if n else 0.0,
             decode_tokens_per_s=decode_tps, total_s=now - st.t_submit)
         self.metrics.inc(f"finished_{reason}")
+        self._journal_finish(st.req.id, reason)
         if decode_tps:
             self.metrics.observe("decode_tokens_per_s", decode_tps)
         return res
@@ -551,6 +694,7 @@ class Engine:
     def _finish_unstarted(self, req: Request, t_submit: float, reason: str,
                           now: float) -> RequestResult:
         self.metrics.inc(f"finished_{reason}")
+        self._journal_finish(req.id, reason)
         return RequestResult(id=req.id, tokens=[], finish_reason=reason,
                              queue_wait_s=now - t_submit,
                              total_s=now - t_submit)

@@ -1,0 +1,104 @@
+"""Host-side request journal: restart recovery for the serving engine.
+
+The engine's device state (pooled KV cache) is disposable — every
+request regenerates deterministically from its prompt + sampling params
++ rng_seed (per-request RNG streams make output independent of slot and
+neighbors). What a crash actually loses is the *host* bookkeeping:
+which requests were in flight. The journal closes that gap with an
+append-only JSONL file: one ``submit`` record when the engine accepts a
+request, one ``finish`` record when its terminal ``RequestResult``
+exists. After a crash/restart, :func:`unfinished` replays the journal
+and returns the accepted-but-unfinished requests for requeueing into a
+fresh engine — every admitted request is eventually served (or
+explicitly shed), across restarts.
+
+Records are flushed per write: a journal that lags the engine would
+silently drop the most recent admissions, which is exactly the window a
+crash hits. One fsync-free flush per request (not per token) is host
+noise next to a model forward.
+
+Deadlines are *not* recovered: they are absolute timestamps on the dead
+engine's monotonic clock, meaningless after restart. A recovered
+request runs deadline-free (operators re-impose one at requeue time if
+the workload needs it — docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, TextIO
+
+import numpy as np
+
+from .requests import Request, SamplingParams
+
+
+class RequestJournal:
+    """Append-only submit/finish journal (one writer — the engine)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._f: Optional[TextIO] = open(self.path, "a")
+
+    def _write(self, obj: dict) -> None:
+        assert self._f is not None, "journal is closed"
+        self._f.write(json.dumps(obj) + "\n")
+        self._f.flush()
+
+    def record_submit(self, req: Request) -> None:
+        sp = req.sampling
+        self._write({
+            "ev": "submit", "id": req.id,
+            "prompt": np.asarray(req.prompt).tolist(),
+            "max_new_tokens": int(req.max_new_tokens),
+            "rng_seed": int(req.rng_seed),
+            "temperature": float(sp.temperature), "top_k": int(sp.top_k),
+            "top_p": float(sp.top_p), "greedy": bool(sp.greedy),
+        })
+
+    def record_finish(self, request_id: str, reason: str) -> None:
+        self._write({"ev": "finish", "id": request_id, "reason": reason})
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    @staticmethod
+    def unfinished(path: str) -> List[Request]:
+        """Replay a journal (possibly from a dead engine) and rebuild the
+        accepted-but-unfinished requests, in admission order. Tolerates a
+        torn final line (the crash may have landed mid-write)."""
+        if not os.path.exists(path):
+            return []
+        submits: Dict[str, Request] = {}
+        order: List[str] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue          # torn tail record from the crash
+                if rec.get("ev") == "submit":
+                    rid = rec["id"]
+                    if rid not in submits:
+                        order.append(rid)
+                    submits[rid] = Request(
+                        id=rid,
+                        # host JSON list -> host array; no device involved
+                        prompt=np.asarray(rec["prompt"],  # graftlint: disable=GL004
+                                          np.int32),
+                        max_new_tokens=rec["max_new_tokens"],
+                        sampling=SamplingParams(
+                            temperature=rec["temperature"],
+                            top_k=rec["top_k"], top_p=rec["top_p"],
+                            greedy=rec["greedy"]),
+                        rng_seed=rec["rng_seed"])
+                elif rec.get("ev") == "finish":
+                    submits.pop(rec["id"], None)
+        return [submits[rid] for rid in order if rid in submits]

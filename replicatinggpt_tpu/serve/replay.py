@@ -80,7 +80,8 @@ def make_trace(mcfg: ModelConfig, rcfg: ReplayConfig
 def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
                ecfg: EngineConfig, warmup: bool = True,
                draft_params=None,
-               draft_cfg: Optional[ModelConfig] = None) -> dict:
+               draft_cfg: Optional[ModelConfig] = None,
+               resilience=None, journal=None) -> dict:
     """Replay the trace in wall-clock time; returns the summary dict.
 
     ``warmup`` first pushes one tiny request through a throwaway engine
@@ -88,25 +89,40 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
     speculative verify step and the model drafter's two programs, when
     configured) compile outside the timed replay — the summary's
     ``recompiles_after_warmup`` then asserts the steady-state claim
-    (0 on a healthy run). ``rcfg.spec`` selects the drafter; the
+    (0 on a healthy run). With a drafter configured the warmup also
+    runs the plain-decode path once: the speculative auto-disable
+    policy (``resilience``, a faults.watchdog.ResilienceConfig) may
+    legitimately switch to it mid-replay, and a degraded transition
+    must not cost a compile. ``rcfg.spec`` selects the drafter; the
     'model' mode additionally needs ``draft_params``/``draft_cfg``
     (see ``speculative.draft_config_from_preset``). Drafters are
-    stateful, so each engine gets its own.
+    stateful, so each engine gets its own. ``journal`` (a
+    serve.journal.RequestJournal) is handed to the replay engine for
+    restart-recovery coverage.
     """
     def drafter():
         return make_drafter(rcfg.spec, rcfg.spec_k, rcfg.spec_ngram,
                             ecfg.pool_size, draft_params, draft_cfg,
                             ecfg.prefill_chunk)
 
+    def tiny(rid):
+        return Request(id=rid, prompt=np.zeros((1,), np.int32),
+                       max_new_tokens=1,
+                       sampling=SamplingParams(greedy=True))
+
     if warmup:
         w = Engine(params, mcfg, ecfg, drafter=drafter())
-        w.submit(Request(id="warmup", prompt=np.zeros((1,), np.int32),
-                         max_new_tokens=1,
-                         sampling=SamplingParams(greedy=True)))
+        w.submit(tiny("warmup"))
         w.drain()
+        if w.drafter is not None:
+            # compile the degraded (plain decode) program too — see above
+            w.set_spec_active(False)
+            w.submit(tiny("warmup-degraded"))
+            w.drain()
     warm = compile_counts()
 
-    engine = Engine(params, mcfg, ecfg, drafter=drafter())
+    engine = Engine(params, mcfg, ecfg, drafter=drafter(),
+                    rcfg=resilience, journal=journal)
     trace = make_trace(mcfg, rcfg)
     results: List[RequestResult] = []
     i = 0

@@ -40,8 +40,11 @@ class SamplingParams:
 FINISH_MAX_TOKENS = "max_tokens"        # produced request.max_new_tokens
 FINISH_LENGTH_CAP = "length_cap"        # hit the slot's context capacity
                                         # (block_size) before max_new_tokens
-FINISH_DEADLINE = "deadline"            # deadline expired (queued or active)
+FINISH_DEADLINE = "deadline"            # deadline expired (at submit,
+                                        # queued, or active)
 FINISH_CANCELLED = "cancelled"          # caller cancelled (queued or active)
+FINISH_SHED = "shed"                    # dropped by overload shedding
+                                        # (faults.watchdog.LoadShedder)
 REJECT_QUEUE_FULL = "rejected_queue_full"      # backpressure at submit
 REJECT_PROMPT_TOO_LONG = "rejected_prompt_too_long"  # prompt > block_size
 REJECT_BAD_REQUEST = "rejected_bad_request"    # empty prompt / bad lengths

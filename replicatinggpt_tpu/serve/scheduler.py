@@ -45,10 +45,24 @@ class Scheduler:
             return REJECT_BAD_REQUEST
         if req.prompt.size > self.block_size:
             return REJECT_PROMPT_TOO_LONG
+        if req.deadline is not None and self.clock() >= req.deadline:
+            # already dead on arrival: queueing it would burn a queue
+            # slot and a prefill on work nobody can use
+            return FINISH_DEADLINE
         if len(self._queue) >= self.max_queue:
             return REJECT_QUEUE_FULL
         self._queue.append((req, self.clock()))
         return None
+
+    def shed(self, n: int) -> List[Tuple[Request, float]]:
+        """Drop up to ``n`` requests from the queue TAIL (newest first —
+        the oldest are closest to service and fresh arrivals are the
+        cheapest to turn away). Overload-shedding support
+        (faults.watchdog.LoadShedder drives the policy)."""
+        out: List[Tuple[Request, float]] = []
+        while self._queue and len(out) < n:
+            out.append(self._queue.pop())
+        return out
 
     def cancel(self, request_id: str) -> bool:
         """Remove a still-queued request; True if it was found (an

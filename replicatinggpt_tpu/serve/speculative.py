@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import ModelConfig
+from ..faults.inject import fire as fault_fire
 from ..models.gpt import (decode_step_multi, init_kv_cache, param_count,
                           prefill_chunk_into_slot)
 from ..ops.attention import NEG_INF
@@ -174,6 +175,17 @@ class Drafter:
 
     def on_release(self, slot: int) -> None:
         pass
+
+    def resync(self, slot: int, history: np.ndarray) -> None:
+        """Rebuild the drafter's per-slot state from the slot's full
+        committed history (prompt + generated). The engine calls this
+        when re-enabling a drafter after a degraded window: tokens were
+        committed by the plain decode path while the drafter sat idle,
+        so a stateful drafter's cache is behind the frontier. The
+        default treats the history as a fresh admission — which is
+        exactly a chunked re-prefill for the model drafter and a no-op
+        for the stateless n-gram drafter."""
+        self.on_admit(slot, history)
 
     def draft(self, ctx: DraftContext) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
@@ -364,11 +376,22 @@ def make_drafter(mode: str, k: int, ngram: int, pool_size: int,
     raise ValueError(f"unknown drafter mode {mode!r}")
 
 
-def timed_draft(drafter: Drafter, ctx: DraftContext
+def timed_draft(drafter: Drafter, ctx: DraftContext,
+                vocab_size: int = 0
                 ) -> Tuple[np.ndarray, np.ndarray, float]:
     """``drafter.draft`` + wall-clock overhead (seconds) — the engine
     records it per step so the drafter's cost is visible next to the
-    verify step it amortizes."""
+    verify step it amortizes.
+
+    Chaos seam ``spec/draft`` (kind ``collapse``): shifts every proposed
+    token by one (mod the vocab), turning the drafter's proposals into
+    deterministic garbage — the accept rate collapses toward zero while
+    every token stays a valid vocab id, which is exactly the failure the
+    engine's speculative auto-disable must catch. No-op without an
+    installed FaultPlan."""
     t0 = time.perf_counter()
     toks, lens = drafter.draft(ctx)
+    f = fault_fire("spec/draft")
+    if f is not None and f.kind == "collapse" and vocab_size > 1:
+        toks = (toks + 1) % vocab_size
     return toks, lens, time.perf_counter() - t0

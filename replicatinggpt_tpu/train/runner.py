@@ -18,6 +18,10 @@ import numpy as np
 from ..config import Config
 from ..data.dataset import TokenDataset, load_corpus
 from ..data.loader import make_batcher, prefetch
+from ..faults.inject import (apply_loss_fault, apply_train_state_fault,
+                             fire as fault_fire)
+from ..faults.supervise import (LossTracker, NonFiniteLossError,
+                                SupervisionConfig)
 from ..models.gpt import param_count
 from ..tokenizers import get_tokenizer
 from ..utils.logging import StepLogger
@@ -66,13 +70,24 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
           checkpoint_manager=None, resume: bool = False,
           profile_dir: Optional[str] = None,
           profile_start: int = 10, profile_steps: int = 5,
-          stop_event=None) -> TrainResult:
+          stop_event=None,
+          supervision: Optional[SupervisionConfig] = None,
+          skip_data_steps: int = 0) -> TrainResult:
     """``stop_event`` (a ``threading.Event``-like object) requests a
     graceful stop: the loop finishes the in-flight dispatch, saves a
     checkpoint (when a manager is present), and returns normally — the
     preemption story for TPU VMs, where SIGTERM precedes eviction (the
     CLI wires this to SIGTERM/SIGINT; the reference loses the entire run,
-    SURVEY.md §5 failure-detection row)."""
+    SURVEY.md §5 failure-detection row).
+
+    ``supervision`` (a :class:`~replicatinggpt_tpu.faults.supervise.
+    SupervisionConfig`) turns on per-dispatch loss checks: a non-finite
+    or spiking loss raises a typed error that
+    ``faults.supervise.supervised_train`` converts into a rollback to
+    the last verified checkpoint — each check is one host sync, the
+    price of detection latency. ``skip_data_steps`` (supervisor-driven)
+    advances the data cursor that many optimizer steps after restore,
+    stepping past a data window that keeps blowing the loss up."""
     logger = logger or StepLogger()
     text = load_corpus(cfg.dataset)
     tokenizer = get_tokenizer(cfg.tokenizer, corpus_text=text,
@@ -277,6 +292,15 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
             else np.uint16 if mcfg.vocab_size <= 0xffff else np.int32)
     narrow = ((x.astype(wire), y.astype(wire))
               for x, y in iter(train_batcher))
+    if skip_data_steps:
+        # supervisor-directed recovery: the same data window blew the
+        # loss up twice — draw and discard whole optimizer steps so the
+        # resumed run trains past it (the cursor snapshot feed() saves
+        # reflects the advanced position)
+        for _ in range(skip_data_steps * accum):
+            next(narrow)
+        logger.log(f"supervisor: data cursor advanced {skip_data_steps} "
+                   f"optimizer step(s) past the offending window")
 
     def chunk_at(i: int) -> int:
         """Steps the dispatch issued at iteration ``i`` advances: scan_k,
@@ -379,6 +403,14 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     tokens_since_log = 0
     lr_at = _make_lr_reader(tcfg)
     stopped_early = False
+    tracker = None
+    n_dispatches = 0
+    if supervision is not None:
+        tracker = LossTracker(supervision)
+        logger.log(f"supervision: loss checked every "
+                   f"{supervision.check_every} dispatch(es)"
+                   + (f", spike budget {supervision.spike_factor:.1f}x EMA"
+                      if supervision.spike_factor else ""))
     import contextlib
     sanitizer = contextlib.ExitStack()
     if sanitize_enabled():
@@ -390,6 +422,12 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     try:
         it = start_step
         while it < tcfg.max_iters:
+            # chaos seam (no-op without an installed FaultPlan): raises
+            # SIGTERM through the real handler, or corrupts the live
+            # state — the faults the supervision layer must survive
+            flt = fault_fire("train/step", index=it)
+            if flt is not None:
+                state = apply_train_state_fault(flt, state)
             if _stop_requested(it):
                 stopped_early = True
                 logger.log(f"stop requested at step {it}; "
@@ -418,6 +456,18 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
             prev_it, it = it, it + chunk
             tokens_seen += tokens_per_batch * chunk
             tokens_since_log += tokens_per_batch * chunk
+            n_dispatches += 1
+            if (tracker is not None
+                    and n_dispatches % supervision.check_every == 0):
+                losses_arr = metrics["loss"]
+                # one reviewed sync per supervised dispatch — detection
+                # latency is what supervision buys with it
+                sup_loss = float(losses_arr if chunk == 1    # graftlint: disable=GL004
+                                 else losses_arr[-1])
+                flt = fault_fire("train/loss", index=it - 1)
+                if flt is not None:
+                    sup_loss = apply_loss_fault(flt, sup_loss)
+                tracker.check(it - 1, sup_loss)
             if tcfg.log_interval:
                 # most recent log boundary crossed by this chunk (one line
                 # per chunk even if it spans several boundaries)
@@ -431,6 +481,13 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                     loss_val = float(loss_b)  # graftlint: disable=GL004
                     if sanitize_enabled():
                         check_finite(loss_val, f"train loss at step {b - 1}")
+                    if not np.isfinite(loss_val):
+                        # a NaN loss is a dead run whether or not anyone
+                        # is supervising — raise the typed error (the
+                        # supervisor rolls back; an unsupervised caller
+                        # at least dies naming the step, not 10k steps
+                        # later at the final eval)
+                        raise NonFiniteLossError(b - 1, loss_val)
                     logger.log_step(b - 1, loss_val, tokens_since_log,
                                     n_chips, lr=lr_at(b - 1))
                     tokens_since_log = 0

@@ -1,0 +1,16 @@
+"""GL009 bad: broad except swallowing checkpoint/device I/O failures."""
+
+
+def rng_shape(mngr, step):
+    try:
+        return mngr.item_metadata(step)["state"]["rng"].shape
+    except Exception:            # corrupt step vanishes here
+        return None
+
+
+def fetch_loss(metrics):
+    import jax
+    try:
+        return jax.device_get(metrics["loss"])
+    except:                      # noqa: E722 — bare except, no trace left
+        return 0.0

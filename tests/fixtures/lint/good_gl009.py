@@ -1,0 +1,22 @@
+"""GL009 good: narrow the exception, or log / re-raise typed."""
+
+
+class CorruptCheckpointError(RuntimeError):
+    pass
+
+
+def rng_shape(mngr, step):
+    try:
+        return mngr.item_metadata(step)["state"]["rng"].shape
+    except (KeyError, TypeError, OSError) as e:
+        raise CorruptCheckpointError(
+            f"checkpoint step {step} is corrupt: {e}") from e
+
+
+def fetch_loss(metrics, logger):
+    import jax
+    try:
+        return jax.device_get(metrics["loss"])
+    except Exception as e:       # broad, but the failure is logged
+        logger.warning(f"loss fetch failed: {e!r}")
+        return 0.0

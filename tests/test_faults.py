@@ -1,0 +1,567 @@
+"""Chaos tests: every injected fault class must be *survived*, not just
+detected.
+
+Each test installs a deterministic FaultPlan (faults/inject.py) and
+asserts the matching recovery contract from docs/robustness.md:
+
+- corrupt / truncated / partial checkpoints -> named CorruptCheckpointError
+  + restore_latest fallback to the newest verified step;
+- transient save/restore I/O -> exponential-backoff retries succeed;
+- NaN / loss-spike -> supervised rollback; a transient fault resumes
+  BITWISE identical to an uninterrupted run; a persistent one advances
+  the data cursor, then dies after K rollbacks;
+- SIGTERM mid-step -> graceful checkpoint, bitwise-identical resume;
+- stalled engine steps -> watchdog counts, requests still finish;
+- drafter accept-rate collapse -> speculative auto-disable + re-probe,
+  greedy output parity across every transition, zero recompiles;
+- sustained overload -> load shedding with every request accounted for;
+- engine crash -> journal requeue; every admitted request is served.
+
+Fast deterministic tests run in tier-1 (`-m chaos` selects them); the
+replay soak is additionally marked slow.
+"""
+
+import dataclasses
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from replicatinggpt_tpu.config import ModelConfig, get_config
+from replicatinggpt_tpu.faults import (Fault, FaultPlan, ResilienceConfig,
+                                       SupervisionConfig,
+                                       SupervisionExhausted, installed,
+                                       supervised_train)
+from replicatinggpt_tpu.faults.watchdog import (LoadShedder, SpecHealth,
+                                                StepWatchdog)
+from replicatinggpt_tpu.models.gpt import init_params
+from replicatinggpt_tpu.sample import GenerateConfig, generate
+from replicatinggpt_tpu.serve import (Engine, EngineConfig, NGramDrafter,
+                                      Request, RequestJournal,
+                                      SamplingParams, compile_counts)
+from replicatinggpt_tpu.serve.requests import (FINISH_DEADLINE, FINISH_SHED,
+                                               FINISH_MAX_TOKENS)
+from replicatinggpt_tpu.train.checkpoint import (CheckpointManager,
+                                                 CorruptCheckpointError)
+from replicatinggpt_tpu.train.runner import train
+from replicatinggpt_tpu.train.state import create_train_state
+
+CFG = ModelConfig(vocab_size=65, block_size=32, n_layer=2, n_head=2,
+                  n_embd=32, dropout=0.0, attn_dropout=0.0, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _train_cfg(max_iters=8, checkpoint_every=4):
+    cfg = get_config("test-tiny")
+    return cfg.replace(
+        train=dataclasses.replace(cfg.train, max_iters=max_iters,
+                                  eval_interval=0, eval_iters=2,
+                                  log_interval=0, batch_size=8,
+                                  sampling="sequential",
+                                  checkpoint_every=checkpoint_every),
+        dataset="datasets/shakespeare.txt")
+
+
+@pytest.fixture(scope="module")
+def full_run8():
+    """Uninterrupted 8-step run — the bitwise oracle for every
+    rollback/resume test in this module."""
+    return train(_train_cfg())
+
+
+def _trees_equal(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def _offline_greedy(params, req):
+    """The request's NEW tokens under offline generate (greedy)."""
+    return np.asarray(generate(
+        params, req.prompt[None, :], CFG,
+        GenerateConfig(max_new_tokens=req.max_new_tokens,
+                       greedy=True)))[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan mechanics
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_is_deterministic_and_one_shot():
+    plan = FaultPlan(Fault(site="a", kind="x", at=2),
+                     Fault(site="b", kind="y", at=0, times=2))
+    # index-keyed: fires at index 2 exactly once, even if the caller
+    # replays index 2 (the rollback-replay contract)
+    assert plan.fire("a", index=0) is None
+    assert plan.fire("a", index=2).kind == "x"
+    assert plan.fire("a", index=2) is None      # one-shot across replay
+    # counter-keyed: first two calls fire, later ones don't
+    assert plan.fire("b").kind == "y"
+    assert plan.fire("b").kind == "y"
+    assert plan.fire("b") is None
+    assert plan.count("a", "x") == 1 and plan.count("b") == 2
+    # seeded payload RNG is stable per (seed, site)
+    a = FaultPlan(seed=7).rng("s").integers(0, 100, 4)
+    b = FaultPlan(seed=7).rng("s").integers(0, 100, 4)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_no_plan_seams_are_noops():
+    from replicatinggpt_tpu.faults import active, clear, fire
+    clear()
+    assert active() is None and fire("anything") is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: transient I/O, corruption, fallback
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_transient_save_and_restore_io_retries(tmp_path):
+    cfg = get_config("test-tiny")
+    state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    with installed(FaultPlan(Fault(site="ckpt/save", kind="io", times=2))):
+        assert ck.save(state, wait=True) == 0
+    assert ck.recovery["save_retries"] == 2
+    with installed(FaultPlan(Fault(site="ckpt/restore", kind="io",
+                                   times=2))):
+        restored = ck.restore_latest(state)
+    assert restored is not None
+    assert ck.recovery["restore_retries"] == 2
+    assert ck.recovery["ckpt_fallbacks"] == 0
+    _trees_equal(state, restored)
+    ck.close()
+
+
+@pytest.mark.chaos
+def test_persistent_restore_failure_raises_not_none(tmp_path):
+    """Checkpoints that EXIST but cannot be restored must raise — a
+    None return would read as 'fresh run' and silently restart from
+    step 0, destroying the run the caller asked to continue. None is
+    reserved for a genuinely empty directory."""
+    cfg = get_config("test-tiny")
+    state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
+    ck = CheckpointManager(str(tmp_path / "ck"), retries=1)
+    assert ck.restore_latest(state) is None       # empty dir: fresh run
+    ck.save(state, wait=True)
+    with installed(FaultPlan(Fault(site="ckpt/restore", kind="io",
+                                   times=99))):
+        with pytest.raises(CorruptCheckpointError,
+                           match="no restorable checkpoint"):
+            ck.restore_latest(state)
+    assert ck.recovery["ckpt_fallbacks"] == 1
+    ck.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind", ["corrupt", "truncate"])
+def test_corrupt_checkpoint_named_and_fallen_past(tmp_path, kind):
+    """Silent bit rot / a partial write in the NEWEST step: restore(step)
+    raises an explicit 'step N is corrupt' error, restore_latest falls
+    back to the previous verified step."""
+    from replicatinggpt_tpu.train.steps import make_train_step
+    cfg = get_config("test-tiny")
+    m, t = cfg.model, cfg.train
+    state = create_train_state(jax.random.PRNGKey(0), m, t)
+    step = make_train_step(m, t, donate=False)
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, m.block_size), 0,
+                           m.vocab_size)
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    ck.save(state, wait=True)                       # good step 0
+    state1, _ = step(state, (x, x))
+    with installed(FaultPlan(Fault(site="ckpt/finalize", kind=kind,
+                                   at=1))):
+        ck.save(state1, wait=True)                  # corrupted step 1
+    with pytest.raises(CorruptCheckpointError, match="step 1 is corrupt"):
+        ck.restore(1, state)
+    restored = ck.restore_latest(state)
+    assert restored is not None and int(restored.step) == 0
+    assert ck.recovery["ckpt_fallbacks"] == 1
+    _trees_equal(state, restored)
+    ck.close()
+
+
+@pytest.mark.chaos
+def test_nan_poisoned_checkpoint_rejected_at_restore(tmp_path):
+    """A checkpoint whose params were already non-finite at save time
+    must never be a rollback target — the manifest's finite bit rejects
+    it and restore_latest falls back."""
+    cfg = get_config("test-tiny")
+    state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    ck.save(state, wait=True)
+    leaves, treedef = jax.tree_util.tree_flatten(state.params)
+    leaves[0] = leaves[0] * float("nan")
+    poisoned = state._replace(
+        params=jax.tree_util.tree_unflatten(treedef, leaves),
+        step=state.step + 1)
+    ck.save(poisoned, wait=True)
+    with pytest.raises(CorruptCheckpointError, match="non-finite"):
+        ck.restore(1, state)
+    restored = ck.restore_latest(state)
+    assert int(restored.step) == 0
+    assert ck.recovery["ckpt_fallbacks"] == 1
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# train: NaN/spike rollback, data-cursor advance, SIGTERM
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_nan_rollback_resumes_bitwise_identical(tmp_path, full_run8):
+    """One-shot state corruption at step 6: the supervisor detects the
+    non-finite loss, rolls back to the step-4 checkpoint, and the
+    replayed tail is BITWISE identical to an uninterrupted run (state,
+    cursor, and step-keyed RNG all restore exactly)."""
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    with installed(FaultPlan(Fault(site="train/step", kind="nan_params",
+                                   at=6))) as plan:
+        out = supervised_train(
+            _train_cfg(), checkpoint_manager=ck,
+            supervision=SupervisionConfig(check_every=1), max_rollbacks=3)
+    assert plan.count("train/step", "nan_params") == 1
+    assert out.counters.get("rollbacks") == 1
+    assert out.counters.get("data_skips") is None   # transient: no skip
+    assert int(jax.device_get(out.result.state.step)) == 8
+    _trees_equal(full_run8.state.params, out.result.state.params)
+    ck.close()
+
+
+@pytest.mark.chaos
+def test_loss_spike_rolls_back(tmp_path, full_run8):
+    """An injected 1000x spike in the observed loss (params untouched)
+    trips the EMA budget and rolls back; the replay is clean so the
+    final state is again bitwise the uninterrupted run."""
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    with installed(FaultPlan(Fault(site="train/loss", kind="spike", at=6,
+                                   arg=1000.0))):
+        out = supervised_train(
+            _train_cfg(), checkpoint_manager=ck,
+            supervision=SupervisionConfig(check_every=1, spike_factor=10.0,
+                                          warmup_checks=2),
+            max_rollbacks=3)
+    assert out.counters.get("rollbacks") == 1
+    _trees_equal(full_run8.state.params, out.result.state.params)
+    ck.close()
+
+
+@pytest.mark.chaos
+def test_repeat_failure_advances_data_cursor_then_recovers(tmp_path):
+    """The same step failing twice implicates the data window: the
+    supervisor advances the cursor past it on the next attempt. With
+    the fault exhausted after two firings, attempt 3 completes."""
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    with installed(FaultPlan(Fault(site="train/loss", kind="nan", at=5,
+                                   times=2))):
+        out = supervised_train(
+            _train_cfg(), checkpoint_manager=ck,
+            supervision=SupervisionConfig(check_every=1), max_rollbacks=3)
+    assert out.counters.get("rollbacks") == 2
+    assert out.counters.get("data_skips") == 1
+    assert int(jax.device_get(out.result.state.step)) == 8
+    ck.close()
+
+
+@pytest.mark.chaos
+def test_supervision_dies_after_k_failed_rollbacks(tmp_path):
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    with installed(FaultPlan(Fault(site="train/loss", kind="nan", at=5,
+                                   times=99))):
+        with pytest.raises(SupervisionExhausted):
+            supervised_train(
+                _train_cfg(), checkpoint_manager=ck,
+                supervision=SupervisionConfig(check_every=1),
+                max_rollbacks=2)
+    ck.close()
+
+
+@pytest.mark.chaos
+def test_sigterm_mid_step_checkpoints_then_resumes_bitwise(tmp_path,
+                                                           full_run8):
+    """Injected SIGTERM at step 5 goes through a real signal handler
+    (wired exactly like the CLI's): the loop checkpoints and exits
+    cleanly; resuming trains to 8 bitwise-identical to uninterrupted."""
+    import threading
+    stop = threading.Event()
+    prev = signal.signal(signal.SIGTERM, lambda s, f: stop.set())
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    try:
+        with installed(FaultPlan(Fault(site="train/step", kind="sigterm",
+                                       at=5))):
+            res = train(_train_cfg(), checkpoint_manager=ck,
+                        stop_event=stop)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    stopped = int(jax.device_get(res.state.step))
+    assert stopped == 5
+    ck.wait()
+    assert ck.latest_step() == 5
+    resumed = train(_train_cfg(), checkpoint_manager=ck, resume=True)
+    assert int(jax.device_get(resumed.state.step)) == 8
+    _trees_equal(full_run8.state.params, resumed.state.params)
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# serve: expired deadlines, watchdog, collapse, shedding, journal
+# ---------------------------------------------------------------------------
+
+def _req(rid, prompt, max_new, seed=0):
+    return Request(id=rid, prompt=np.asarray(prompt, np.int32),
+                   max_new_tokens=max_new,
+                   sampling=SamplingParams(greedy=True), rng_seed=seed)
+
+
+def test_submit_rejects_already_expired_deadline(params):
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=4))
+    r = _req("dead", [1, 2], 4)
+    r.deadline = eng.clock() - 1.0          # expired before submit
+    res = eng.submit(r)
+    assert res is not None and res.finish_reason == FINISH_DEADLINE
+    assert eng.metrics.counters["finished_deadline"] == 1
+    assert len(eng.scheduler) == 0          # never queued
+    # a live deadline still queues
+    r2 = _req("alive", [1, 2], 2)
+    r2.deadline = eng.clock() + 60.0
+    assert eng.submit(r2) is None
+    out = {x.id: x for x in eng.drain()}
+    assert out["alive"].finish_reason == FINISH_MAX_TOKENS
+
+
+@pytest.mark.chaos
+def test_watchdog_counts_stall_and_requests_finish(params):
+    rcfg = ResilienceConfig(stall_factor=2.0, stall_floor_s=0.02,
+                            stall_min_steps=5)
+    eng = Engine(params, CFG, EngineConfig(pool_size=2, max_queue=8),
+                 rcfg=rcfg)
+    for i in range(3):
+        assert eng.submit(_req(f"r{i}", [1 + i, 2, 3], 12)) is None
+    with installed(FaultPlan(Fault(site="serve/step", kind="delay", at=8,
+                                   arg=0.3))):
+        out = eng.drain()
+    assert len(out) == 3
+    assert all(r.finish_reason == FINISH_MAX_TOKENS for r in out)
+    assert eng.metrics.counters.get("watchdog_stalls", 0) >= 1
+    assert any("stall" in e for e in eng.events)
+
+
+@pytest.mark.chaos
+def test_accept_collapse_disables_then_reprobes_with_parity(params):
+    """Drafter corruption collapses the accept rate: the engine
+    auto-disables speculation (plain decode keeps serving), re-probes
+    after the cooldown, resyncs the stateful drafter's cache over the
+    tokens committed while degraded, finds it healthy again (with
+    draft params == target params the accept rate is exactly 1.0, so
+    any resync bug would re-collapse it), and the greedy token streams
+    match offline generate across every transition — with ZERO
+    compiles beyond the warmed program set."""
+    from replicatinggpt_tpu.serve import ModelDrafter
+    ecfg = EngineConfig(pool_size=2, max_queue=8)
+    rcfg = ResilienceConfig(spec_disable_threshold=0.4, spec_window=3,
+                            spec_reprobe_after=4)
+
+    def drafter():
+        return ModelDrafter(params, CFG, k=2, pool_size=2)
+
+    # warm both steady-state paths (spec verify + degraded decode) the
+    # way replay warmup does, then pin the compile counts
+    w = Engine(params, CFG, ecfg, drafter=drafter())
+    assert w.submit(_req("w0", [3, 4, 3, 4, 3, 4], 4)) is None
+    w.drain()
+    w.set_spec_active(False)
+    assert w.submit(_req("w1", [3, 4, 3, 4, 3, 4], 4)) is None
+    w.drain()
+    warm = compile_counts()
+
+    eng = Engine(params, CFG, ecfg, drafter=drafter(), rcfg=rcfg)
+    reqs = [_req("a", [5, 6, 5, 6, 5, 6], 24),
+            _req("b", [7, 8, 7, 8, 7, 8], 24)]
+    for r in reqs:
+        assert eng.submit(r) is None
+    with installed(FaultPlan(Fault(site="spec/draft", kind="collapse",
+                                   times=3))):
+        out = {r.id: r for r in eng.drain()}
+    c = eng.metrics.counters
+    assert c.get("spec_disables", 0) == 1, eng.events
+    assert c.get("spec_reprobes", 0) == 1, eng.events
+    assert eng.spec_active                  # probe found it healthy
+    for r in reqs:
+        assert out[r.id].finish_reason == FINISH_MAX_TOKENS
+        assert out[r.id].tokens == _offline_greedy(params, r)
+    assert compile_counts() == warm         # degraded transitions free
+
+
+@pytest.mark.chaos
+def test_load_shedding_under_sustained_overload(params):
+    rcfg = ResilienceConfig(shed_watermark=0.25, shed_patience=2)
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=16),
+                 rcfg=rcfg)
+    n = 12
+    for i in range(n):
+        assert eng.submit(_req(f"r{i}", [1 + (i % 7), 2], 6,
+                               seed=i)) is None
+    out = eng.drain()
+    assert len(out) == n                    # every request accounted for
+    shed = [r for r in out if r.finish_reason == FINISH_SHED]
+    done = [r for r in out if r.finish_reason == FINISH_MAX_TOKENS]
+    assert len(shed) == eng.metrics.counters["shed_requests"] > 0
+    assert len(shed) + len(done) == n
+    assert all(not r.tokens for r in shed)  # shed before any work
+
+
+@pytest.mark.chaos
+def test_journal_requeues_inflight_requests_after_crash(params, tmp_path):
+    """Crash mid-flight: a fresh engine requeues the journal's
+    accepted-but-unfinished requests and serves them to completion,
+    greedy-identical to offline generate (per-request seeds make
+    regeneration exact)."""
+    path = str(tmp_path / "journal.jsonl")
+    jr = RequestJournal(path)
+    eng = Engine(params, CFG, EngineConfig(pool_size=2, max_queue=8),
+                 journal=jr)
+    reqs = [_req(f"r{i}", [2 + i, 3, 4], 3 if i < 2 else 10, seed=i)
+            for i in range(6)]
+    for r in reqs:
+        assert eng.submit(r) is None
+    finished_before = set()
+    for _ in range(4):                      # run partway, then "crash"
+        for r in eng.step():
+            finished_before.add(r.id)
+    del eng                                 # no drain, no goodbye
+    jr.close()
+
+    pending = RequestJournal.unfinished(path)
+    assert {r.id for r in pending} == {r.id for r in reqs} - finished_before
+    assert pending, "test must crash with work in flight"
+
+    jr2 = RequestJournal(path)
+    eng2 = Engine(params, CFG, EngineConfig(pool_size=2, max_queue=8),
+                 journal=jr2)
+    for r in pending:
+        assert eng2.submit(r) is None
+    out = {r.id: r for r in eng2.drain()}
+    for r in pending:
+        assert out[r.id].finish_reason == FINISH_MAX_TOKENS
+        orig = next(q for q in reqs if q.id == r.id)
+        assert out[r.id].tokens == _offline_greedy(params, orig)
+    # the journal now shows nothing outstanding
+    jr2.close()
+    assert RequestJournal.unfinished(path) == []
+
+
+@pytest.mark.chaos
+def test_journal_tolerates_torn_tail_record(params, tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    jr = RequestJournal(path)
+    jr.record_submit(_req("whole", [1, 2], 4))
+    jr.close()
+    with open(path, "a") as f:
+        f.write('{"ev": "submit", "id": "torn", "pro')   # crash mid-write
+    pending = RequestJournal.unfinished(path)
+    assert [r.id for r in pending] == ["whole"]
+
+
+@pytest.mark.chaos
+def test_operator_spec_pin_sticks(params):
+    """set_spec_active(False) is an operator pin: the auto-re-probe
+    policy must NOT undo it (only auto-disables are re-probeable)."""
+    rcfg = ResilienceConfig(spec_disable_threshold=0.4, spec_window=3,
+                            spec_reprobe_after=1)
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=4),
+                 drafter=NGramDrafter(k=2), rcfg=rcfg)
+    eng.set_spec_active(False)
+    assert eng.submit(_req("a", [5, 6, 5, 6], 6)) is None
+    out = eng.drain()
+    assert out[0].finish_reason == FINISH_MAX_TOKENS
+    assert not eng.spec_active                  # pin survived the run
+    assert eng.metrics.counters.get("spec_reprobes", 0) == 0
+    eng.set_spec_active(True)                   # lifting the pin works
+    assert eng.spec_active
+
+
+# ---------------------------------------------------------------------------
+# policy units (host-only, no device)
+# ---------------------------------------------------------------------------
+
+def test_step_watchdog_budget():
+    cfg = ResilienceConfig(stall_factor=3.0, stall_floor_s=0.0,
+                           stall_min_steps=4)
+    wd = StepWatchdog(cfg)
+    for _ in range(8):
+        assert not wd.observe(0.010)
+    assert wd.observe(0.050)                # 5x the p99
+    assert not wd.observe(0.011)
+
+
+def test_spec_health_disable_reprobe_backoff():
+    cfg = ResilienceConfig(spec_disable_threshold=0.5, spec_window=3,
+                           spec_reprobe_after=2, spec_reprobe_backoff=2.0)
+    h = SpecHealth(cfg)
+    assert not h.observe(3, 3)              # window not full yet
+    assert not h.observe(3, 3)
+    assert not h.observe(3, 3)              # healthy at rate 1.0
+    for _ in range(3):
+        bad = h.observe(3, 0)
+    assert bad
+    h.on_disable()
+    assert not h.tick_disabled() and h.tick_disabled()   # 2-step cooldown
+    h.on_disable()                          # failed probe: backoff 2x
+    assert [h.tick_disabled() for _ in range(4)] == [False] * 3 + [True]
+    h.on_reenable()                         # healthy probe resets it
+    h.on_disable()
+    assert [h.tick_disabled() for _ in range(2)] == [False, True]
+
+
+def test_load_shedder_patience_and_amount():
+    cfg = ResilienceConfig(shed_watermark=0.5, shed_patience=2)
+    sh = LoadShedder(cfg)
+    assert sh.observe(9, 16) == 0           # over, but patience 1/2
+    assert sh.observe(9, 16) == 1           # sustained: down to 8
+    assert sh.observe(4, 16) == 0           # back under: streak resets
+    assert sh.observe(9, 16) == 0
+
+
+# ---------------------------------------------------------------------------
+# soak: replay with overlapping fault classes (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_replay_soak_with_overlapping_faults(params, tmp_path):
+    """A 48-request replay with stalls + drafter collapse + shedding all
+    enabled: every request gets a terminal result, the engine ends
+    healthy, and the steady state stays at zero recompiles after a
+    both-path warmup."""
+    from replicatinggpt_tpu.serve import ReplayConfig, run_replay
+    jr = RequestJournal(str(tmp_path / "soak.jsonl"))
+    rcfg = ReplayConfig(n_requests=48, rate=500.0, seed=3,
+                        prompt_len_max=CFG.block_size // 2,
+                        max_new_tokens=12, greedy=True,
+                        prompt_mode="repeat", spec="ngram", spec_k=3)
+    resilience = ResilienceConfig(stall_factor=4.0, stall_floor_s=0.05,
+                                  stall_min_steps=10,
+                                  spec_disable_threshold=0.3,
+                                  spec_window=4, spec_reprobe_after=8,
+                                  shed_watermark=0.9, shed_patience=8)
+    with installed(FaultPlan(
+            Fault(site="serve/step", kind="delay", at=20, arg=0.2),
+            Fault(site="spec/draft", kind="collapse", at=5, times=4))):
+        summary = run_replay(params, CFG, rcfg,
+                             EngineConfig(pool_size=4, max_queue=96),
+                             resilience=resilience, journal=jr)
+    assert summary["recompiles_after_warmup"] == 0
+    rec = summary["recovery"]
+    assert rec["spec_disables"] >= 1
+    c = summary["counters"]
+    terminal = sum(v for k, v in c.items() if k.startswith("finished_")) \
+        + sum(v for k, v in c.items() if k.startswith("rejected_"))
+    assert terminal == 48
+    jr.close()
+    assert RequestJournal.unfinished(str(tmp_path / "soak.jsonl")) == []

@@ -201,6 +201,85 @@ def test_cancellation_frees_slot_and_queue(params):
     assert not eng2.cancel("nonexistent")
 
 
+def test_cancel_admitted_request_mid_decode_and_slot_reuse(params):
+    """Engine-side cancellation of an ALREADY-ADMITTED request: the slot
+    frees immediately, the partial output is preserved on the terminal
+    result, the freed slot serves the next request with exact greedy
+    parity, and the surviving neighbor's stream is untouched — all
+    without a recompile (the cancel only flips host-side state)."""
+    from replicatinggpt_tpu.serve import compile_counts
+    eng = Engine(params, CFG, EngineConfig(pool_size=2, max_queue=4))
+    doomed = Request(id="doomed", prompt=np.array([5, 6, 7], np.int32),
+                     max_new_tokens=25,
+                     sampling=SamplingParams(greedy=True))
+    neighbor = Request(id="neighbor", prompt=np.array([9, 10], np.int32),
+                       max_new_tokens=8,
+                       sampling=SamplingParams(greedy=True))
+    assert eng.submit(doomed) is None
+    assert eng.submit(neighbor) is None
+    for _ in range(4):
+        eng.step()
+    assert eng.pool.slot_of("doomed") is not None
+    counts = compile_counts()
+    assert eng.cancel("doomed")
+    assert eng.pool.slot_of("doomed") is None    # slot freed immediately
+    assert eng.pool.n_free == 1
+    assert not eng.cancel("doomed")              # already gone
+    successor = Request(id="successor", prompt=np.array([3, 4], np.int32),
+                        max_new_tokens=6,
+                        sampling=SamplingParams(greedy=True))
+    assert eng.submit(successor) is None
+    res = {r.id: r for r in eng.drain()}
+    assert res["doomed"].finish_reason == FINISH_CANCELLED
+    assert len(res["doomed"].tokens) == 4        # partial output kept
+    offline = _offline_greedy(params, [neighbor, successor])
+    for rid in ("neighbor", "successor"):
+        assert res[rid].finish_reason == FINISH_MAX_TOKENS
+        assert res[rid].tokens == offline[rid]
+    assert compile_counts() == counts            # cancel is host-only
+
+
+def test_cancel_admitted_request_speculative_path(params):
+    """The same engine-side cancel under speculative decoding: the
+    drafter's slot lifecycle (on_release) stays in sync and the freed
+    slot is reusable with a drafter attached."""
+    from replicatinggpt_tpu.serve import NGramDrafter
+
+    class TrackingDrafter(NGramDrafter):
+        def __init__(self, k):
+            super().__init__(k)
+            self.released = []
+
+        def on_release(self, slot):
+            self.released.append(slot)
+            super().on_release(slot)
+
+    drafter = TrackingDrafter(k=2)
+    eng = Engine(params, CFG, EngineConfig(pool_size=1, max_queue=4),
+                 drafter=drafter)
+    doomed = Request(id="doomed",
+                     prompt=np.array([5, 6, 5, 6, 5, 6], np.int32),
+                     max_new_tokens=20,
+                     sampling=SamplingParams(greedy=True))
+    assert eng.submit(doomed) is None
+    for _ in range(3):
+        eng.step()
+    slot = eng.pool.slot_of("doomed")
+    assert slot is not None
+    n_before = len(eng._slots[slot].tokens)
+    assert n_before > 0
+    assert eng.cancel("doomed")
+    assert drafter.released == [slot]            # drafter told exactly once
+    nxt = Request(id="next", prompt=np.array([7, 8, 7, 8], np.int32),
+                  max_new_tokens=6, sampling=SamplingParams(greedy=True))
+    assert eng.submit(nxt) is None
+    res = {r.id: r for r in eng.drain()}
+    assert res["doomed"].finish_reason == FINISH_CANCELLED
+    assert len(res["doomed"].tokens) == n_before
+    assert res["next"].finish_reason == FINISH_MAX_TOKENS
+    assert res["next"].tokens == _offline_greedy(params, [nxt])["next"]
+
+
 # ---------------------------------------------------------------------------
 # admission control: backpressure, validation, deadlines, length caps
 # ---------------------------------------------------------------------------
