@@ -336,7 +336,14 @@ def bench_serve(args) -> None:
     artifact is the serving-side multiplier: accept rate and mean
     committed tokens per slot-step, which is 1.0 exactly without
     speculation). ``--draft-model <preset>`` swaps the host-side
-    n-gram drafter for a small random-init draft model."""
+    n-gram drafter for a small random-init draft model.
+
+    ``--serve-prefix-trace`` replays the system-prompt traffic shape
+    instead (every prompt shares one common prefix) TWICE — radix
+    prefix cache on, then off on the same trace — so the artifact's
+    TTFT delta is the prefix cache's, not the workload's. Every serve
+    artifact carries the paged-pool block (pages_in_use /
+    page_utilization / prefix_hit_rate / evictions / cow_copies)."""
     import jax
 
     from replicatinggpt_tpu.config import get_config
@@ -347,10 +354,12 @@ def bench_serve(args) -> None:
     dev = jax.devices()[0]
     spec_mode = ("model" if args.spec and args.draft_model
                  else "ngram" if args.spec else "off")
+    prompt_mode = ("shared_prefix" if args.serve_prefix_trace
+                   else "repeat" if args.spec else "random")
     log(f"serve replay: {args.serve_requests} requests @ "
         f"{args.serve_rate}/s, pool {args.serve_pool}, spec {spec_mode}, "
-        f"model {cfg.model.n_layer}L/{cfg.model.n_head}H/"
-        f"{cfg.model.n_embd}C on {dev.device_kind}")
+        f"trace {prompt_mode}, model {cfg.model.n_layer}L/"
+        f"{cfg.model.n_head}H/{cfg.model.n_embd}C on {dev.device_kind}")
     state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
     rcfg = ReplayConfig(n_requests=args.serve_requests,
                         rate=args.serve_rate, seed=0,
@@ -361,7 +370,7 @@ def bench_serve(args) -> None:
                         # multiplier where drafting can win: repetitive
                         # prompts, greedy (deterministic accept rule)
                         greedy=bool(args.spec),
-                        prompt_mode="repeat" if args.spec else "random",
+                        prompt_mode=prompt_mode,
                         spec=spec_mode, spec_k=args.spec_k)
     draft_params = draft_cfg = None
     if spec_mode == "model":
@@ -376,16 +385,45 @@ def bench_serve(args) -> None:
     # robustness overhead this artifact's trajectory tracks), shedding
     # off (it would change the measured workload)
     from replicatinggpt_tpu.faults import DEFAULT_SERVE_RESILIENCE
-    summary = run_replay(state.params, cfg.model, rcfg,
-                         EngineConfig(pool_size=args.serve_pool,
-                                      max_queue=2 * args.serve_requests),
+    ecfg = EngineConfig(pool_size=args.serve_pool,
+                        max_queue=2 * args.serve_requests,
+                        page_size=args.serve_page_size,
+                        n_pages=args.serve_n_pages)
+    summary = run_replay(state.params, cfg.model, rcfg, ecfg,
                          draft_params=draft_params, draft_cfg=draft_cfg,
                          resilience=DEFAULT_SERVE_RESILIENCE)
     h = summary["histograms"]
     sp = summary.get("speculative") or {}
+    pg = summary["pages"]
+    prefix_ab: dict = {}
+    if args.serve_prefix_trace:
+        # same trace, radix prefix cache OFF: the TTFT delta isolates
+        # the prefix cache (prompt lengths, arrivals, sampling all fixed)
+        import dataclasses
+        off = run_replay(state.params, cfg.model, rcfg,
+                         dataclasses.replace(ecfg, prefix_cache=False),
+                         draft_params=draft_params, draft_cfg=draft_cfg,
+                         resilience=DEFAULT_SERVE_RESILIENCE)
+        ttft_on = h.get("ttft_s", {}).get("mean", 0) * 1e3
+        ttft_off = (off["histograms"].get("ttft_s", {}).get("mean", 0)
+                    * 1e3)
+        prefix_ab = {
+            "ttft_mean_ms": round(ttft_on, 3),
+            "ttft_mean_ms_no_prefix_cache": round(ttft_off, 3),
+            "ttft_mean_speedup": (round(ttft_off / ttft_on, 3)
+                                  if ttft_on > 0 else 0.0),
+            "prefill_tokens": summary["counters"].get("prefill_tokens", 0),
+            "prefill_tokens_no_prefix_cache":
+                off["counters"].get("prefill_tokens", 0),
+        }
+        log(f"prefix A/B: TTFT mean {ttft_on:.2f} ms cached vs "
+            f"{ttft_off:.2f} ms uncached "
+            f"({pg['prefix_hit_tokens']} prefix-hit tokens)")
     log(f"serve: {summary['aggregate_tokens_per_s']} tok/s aggregate, "
         f"TTFT p50 {h.get('ttft_s', {}).get('p50', 0) * 1e3:.1f} ms, "
-        f"{summary['recompiles_after_warmup']} recompiles after warmup"
+        f"{summary['recompiles_after_warmup']} recompiles after warmup, "
+        f"pages {pg['pages_in_use']}/{pg['n_pages']}, prefix hit rate "
+        f"{pg['prefix_hit_rate']}"
         + (f", accept rate {sp['accept_rate']}, "
            f"{sp['mean_tokens_per_step']} tok/slot-step" if sp else ""))
     emit({
@@ -402,12 +440,22 @@ def bench_serve(args) -> None:
             h.get("batch_fill_ratio", {}).get("mean", 0), 3),
         "recompiles_after_warmup": summary["recompiles_after_warmup"],
         "device_kind": dev.device_kind,
+        # paged KV pool health (serve/pages.py) — the dashboard keys the
+        # acceptance criteria name explicitly
+        "pages_in_use": pg["pages_in_use"],
+        "page_utilization": pg["page_utilization"],
+        "page_size": pg["page_size"],
+        "prefix_hit_rate": pg["prefix_hit_rate"],
+        "prefix_hit_tokens": pg["prefix_hit_tokens"],
+        "evictions": pg["evictions"],
+        "cow_copies": pg["cow_copies"],
         # self-healing counters (faults/): nonzero means the measured
         # run was degraded — the number is then not a healthy-path claim
         "recovery": {k: summary["recovery"][k]
                      for k in ("watchdog_stalls", "spec_disables",
                                "spec_reprobes", "shed_requests")},
         **({"speculative": sp} if sp else {}),
+        **({"prefix_ab": prefix_ab} if prefix_ab else {}),
     })
 
 
@@ -763,6 +811,17 @@ def main() -> None:
                    help="--mode serve: KV-cache pool slots")
     p.add_argument("--serve-max-new-tokens", type=int, default=32,
                    help="--mode serve: per-request decode budget")
+    p.add_argument("--serve-prefix-trace", action="store_true",
+                   help="--mode serve: shared-prefix trace (every prompt "
+                        "shares one system-prompt-style prefix), replayed "
+                        "with the radix prefix cache ON and OFF — the "
+                        "artifact carries the TTFT A/B and prefix metrics")
+    p.add_argument("--serve-page-size", type=int, default=0,
+                   help="--mode serve: tokens per KV page "
+                        "(0 = min(16, block_size))")
+    p.add_argument("--serve-n-pages", type=int, default=0,
+                   help="--mode serve: physical KV pages (0 = "
+                        "pool * pages-per-slot, the contiguous pool's HBM)")
     p.add_argument("--spec", action="store_true",
                    help="--mode serve: speculative decoding over a "
                         "repetitive greedy trace (n-gram drafter unless "

@@ -241,9 +241,12 @@ def cmd_serve_replay(args) -> int:
         max_new_tokens=args.request_max_new_tokens, greedy=args.greedy,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         deadline_s=args.deadline_s, prompt_mode=args.prompt_mode,
+        shared_prefix_len=args.shared_prefix_len,
         spec=args.spec, spec_k=args.spec_k, spec_ngram=args.spec_ngram)
     ecfg = EngineConfig(pool_size=args.pool_size, max_queue=args.max_queue,
-                        prefill_chunk=args.prefill_chunk)
+                        prefill_chunk=args.prefill_chunk,
+                        page_size=args.page_size, n_pages=args.n_pages,
+                        prefix_cache=not args.no_prefix_cache)
     draft_params = draft_cfg = None
     if rcfg.spec == "model":
         from .models.gpt import init_params, param_count
@@ -380,6 +383,20 @@ def main(argv=None) -> int:
     ps.add_argument("--prefill-chunk", type=int, default=0,
                     help="prompt tokens per prefill dispatch "
                          "(0 = min(64, block_size))")
+    ps.add_argument("--page-size", type=int, default=0,
+                    help="tokens per KV-cache page (0 = min(16, "
+                         "block_size)); see docs/serving.md")
+    ps.add_argument("--n-pages", type=int, default=0,
+                    help="physical KV pages in the pool (0 = "
+                         "pool_size * pages-per-slot — the contiguous "
+                         "pool's HBM exactly; fewer pages shrinks HBM "
+                         "and admission gates on free pages)")
+    ps.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable radix prefix reuse (pages only) — "
+                         "the A/B arm for prefix-hit TTFT claims")
+    ps.add_argument("--shared-prefix-len", type=int, default=0,
+                    help="--prompt-mode shared_prefix: common prefix "
+                         "length (0 = prompt-len-max // 2)")
     ps.add_argument("--prompt-len-min", type=int, default=1)
     ps.add_argument("--prompt-len-max", type=int, default=0,
                     help="0 = block_size // 2")
@@ -405,9 +422,11 @@ def main(argv=None) -> int:
                          "the draft model (vocab/block/dtype forced to "
                          "the target's)")
     ps.add_argument("--prompt-mode", default="random",
-                    choices=["random", "repeat"],
-                    help="'repeat' tiles small patterns — the "
-                         "speculative-friendly repetitive trace")
+                    choices=["random", "repeat", "shared_prefix"],
+                    help="'repeat' tiles small patterns (the "
+                         "speculative-friendly repetitive trace); "
+                         "'shared_prefix' gives every prompt one common "
+                         "prefix (the radix-prefix-cache traffic shape)")
     ps.add_argument("--json", action="store_true",
                     help="also print the summary as one JSON line")
     ps.set_defaults(fn=cmd_serve_replay)

@@ -864,6 +864,320 @@ def verify_step_multi(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
     return (x @ head).astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
+# ---------------------------------------------------------------------------
+# Paged KV pool (serving engine: page tables instead of contiguous slots)
+# ---------------------------------------------------------------------------
+
+def init_paged_kv_pool(cfg: ModelConfig, n_pages: int, page_size: int,
+                       dtype=None) -> Dict[str, jnp.ndarray]:
+    """Paged KV storage for the serving engine (serve/pages.py): the
+    batch/slot axis of ``init_kv_cache`` becomes a PHYSICAL PAGE axis —
+    (L, n_pages, page, C) for the packed layout, (L, n_pages, H, page, D)
+    for heads. A slot's logical sequence is the concatenation of the
+    pages its (host-side) page table maps, so HBM is sized by pages in
+    use, not slots*block_size, and pages holding a shared prompt prefix
+    appear in many tables while existing once."""
+    dt = dtype or _dtype(cfg.dtype)
+    if cfg.decode_cache_layout == "packed":
+        shape = (cfg.n_layer, n_pages, page_size, cfg.n_embd)
+    else:
+        shape = (cfg.n_layer, n_pages, cfg.n_head, page_size, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def paged_page_size(cfg: ModelConfig, cache: Dict[str, jnp.ndarray]) -> int:
+    """Page length of a paged pool — layout-dependent axis, one accessor
+    (the paged decode/prefill/verify programs derive it from the arrays
+    they are handed, never from config)."""
+    return int(cache["k"].shape[
+        2 if cfg.decode_cache_layout == "packed" else 3])
+
+
+def _gather_pages(c_layer: jnp.ndarray, tables: jnp.ndarray,
+                  packed: bool, n_head: int) -> jnp.ndarray:
+    """Assemble per-slot logical K or V from one layer's page pool.
+
+    c_layer: (N, page, C) packed or (N, H, page, D) heads; tables:
+    (B, max_pages) int32 physical-page ids (unmapped entries clamp to 0
+    — the positions they cover are beyond every query's mask, so the
+    garbage rows get exactly zero softmax weight). Returns the
+    (B, H, max_pages*page, D) logical view the attention cores consume.
+    This materialized gather streams the same bytes per step as the old
+    contiguous (B, S, ...) slot read; the Pallas fast path
+    (ops/paged_pallas.py) is the route that skips unmapped pages."""
+    g = c_layer[tables]
+    if packed:
+        B, mp, psz, C = g.shape
+        return _split_heads(g.reshape(B, mp * psz, C), n_head)
+    B, mp, H, psz, D = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(B, H, mp * psz, D)
+
+
+def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
+                      active: jnp.ndarray, tables: jnp.ndarray,
+                      cache: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
+                      use_pallas: bool = False
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """``decode_step_multi`` over a PAGED pool: per-slot positions are
+    logical, and each slot's K/V is gathered through its page table.
+
+    idx_t/pos: (B,) tokens and logical positions; active: (B,) bool;
+    tables: (B, max_pages) int32; cache: ``init_paged_kv_pool`` arrays.
+    The fresh K/V row for slot b lands at physical page
+    ``tables[b, pos//page]``, offset ``pos % page``. INACTIVE rows run
+    at position 0 and their writes are routed off the page axis
+    (mode='drop'): a released slot's stale table may reference pages
+    now owned by another request, so the contiguous pool's
+    "next occupant overwrites before attending" invariant does NOT
+    carry over — dropping is correctness, not tidiness. Per-row math is
+    ``decode_step_multi``'s exactly (the gathered view holds the same
+    values at the same logical offsets), which is what keeps the paged
+    engine's greedy stream token-identical to offline ``generate``.
+    """
+    cd = _dtype(cfg.dtype)
+    B = idx_t.shape[0]
+    packed = cfg.decode_cache_layout == "packed"
+    psz = paged_page_size(cfg, cache)
+    mp = tables.shape[1]
+    H = cfg.n_head
+    bidx = jnp.arange(B)
+    pos_eff = jnp.where(active, pos, 0)
+    # eager calls assert; the engine bounds pos host-side at admission
+    check_in_bounds(pos_eff, 1, mp * psz, what="paged decode write")
+    x = params["wte"].astype(cd)[idx_t] + params["wpe"].astype(cd)[pos_eff]
+    x = x[:, None, :]  # (B, 1, C)
+    phys = tables[bidx, jnp.minimum(pos_eff // psz, mp - 1)]
+    woff = jnp.where(active, pos_eff % psz, psz)   # inactive -> dropped
+
+    def body(carry, inputs):
+        h_in, ck, cv = carry
+        lp, layer_idx = inputs
+        if packed:
+            q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)
+            if use_pallas:
+                # kernel attends the STALE pages + fresh column (bit-
+                # equivalent to write-then-attend); write lands after
+                from ..ops.paged_pallas import paged_decode_attention
+                k_layer = jax.lax.dynamic_index_in_dim(ck, layer_idx, 0,
+                                                       keepdims=False)
+                v_layer = jax.lax.dynamic_index_in_dim(cv, layer_idx, 0,
+                                                       keepdims=False)
+                attn_merged = paged_decode_attention(
+                    q_m[:, 0, :], k_m[:, 0, :], v_m[:, 0, :],
+                    k_layer, v_layer, tables, pos_eff, n_head=H)[:, None, :]
+                ck = ck.at[layer_idx, phys, woff, :].set(
+                    k_m[:, 0, :].astype(ck.dtype), mode="drop")
+                cv = cv.at[layer_idx, phys, woff, :].set(
+                    v_m[:, 0, :].astype(cv.dtype), mode="drop")
+            else:
+                ck = ck.at[layer_idx, phys, woff, :].set(
+                    k_m[:, 0, :].astype(ck.dtype), mode="drop")
+                cv = cv.at[layer_idx, phys, woff, :].set(
+                    v_m[:, 0, :].astype(cv.dtype), mode="drop")
+                k_all = _gather_pages(
+                    jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
+                    tables, packed, H)
+                v_all = _gather_pages(
+                    jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
+                    tables, packed, H)
+                attn_merged = _merge_heads(cached_attention(
+                    _split_heads(q_m, H), k_all, v_all, pos_eff))
+        else:
+            q, k, v = _cached_qkv(h_in, lp, cfg, cd)  # (B, H, 1, D)
+            ck = ck.at[layer_idx, phys, :, woff, :].set(
+                k[:, :, 0, :].astype(ck.dtype), mode="drop")
+            cv = cv.at[layer_idx, phys, :, woff, :].set(
+                v[:, :, 0, :].astype(cv.dtype), mode="drop")
+            k_all = _gather_pages(
+                jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
+                tables, packed, H)
+            v_all = _gather_pages(
+                jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
+                tables, packed, H)
+            attn_merged = _merge_heads(
+                cached_attention(q, k_all, v_all, pos_eff))
+        return (_cached_block_tail(h_in, attn_merged, lp, cfg, cd),
+                ck, cv), None
+
+    if cfg.use_layer_scan:
+        layer_ids = jnp.arange(cfg.n_layer)
+        (x, new_k, new_v), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], layer_ids))
+    else:
+        carry = (x, cache["k"], cache["v"])
+        for i in range(cfg.n_layer):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            carry, _ = body(carry, (lp, i))
+        x, new_k, new_v = carry
+    return _decode_head(x, params, cfg, cd), {"k": new_k, "v": new_v}
+
+
+def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
+                      n_valid: jnp.ndarray, active: jnp.ndarray,
+                      tables: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+                      cfg: ModelConfig
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """``verify_step_multi`` over a paged pool: the speculative window's
+    K/V scatters through each slot's page table and the whole drafted
+    window attends the gathered logical view.
+
+    Window token j of slot b sits at logical position pos[b]+j, physical
+    page ``tables[b, (pos+j)//page]`` offset ``(pos+j) % page``. Padding
+    positions (j > n_valid) AND every position of inactive rows route
+    their page offset to ``page`` — out of bounds, where the scatter
+    drops the update (a stale table must never be written through; see
+    ``decode_step_paged``). Per-row logits are ``verify_step_multi``'s
+    exactly, so speculative greedy parity survives paging unchanged.
+    """
+    cd = _dtype(cfg.dtype)
+    B, W = window.shape
+    packed = cfg.decode_cache_layout == "packed"
+    psz = paged_page_size(cfg, cache)
+    mp = tables.shape[1]
+    H = cfg.n_head
+    Smax = mp * psz
+    offs = jnp.arange(W, dtype=jnp.int32)[None, :]      # (1, W)
+    pos_eff = jnp.where(active, pos, 0)
+    m_eff = jnp.where(active, n_valid, 0)
+    abs_pos = pos_eff[:, None] + offs                   # (B, W)
+    # wpe gather clamps padding rows (real window positions are bounded
+    # host-side: pos + n_valid <= block_size - 1)
+    x = (params["wte"].astype(cd)[window]
+         + params["wpe"].astype(cd)[jnp.minimum(abs_pos,
+                                                cfg.block_size - 1)])
+    valid = (offs <= m_eff[:, None]) & active[:, None]
+    lpage = jnp.minimum(abs_pos // psz, mp - 1)
+    phys = jnp.take_along_axis(tables, lpage, axis=1)   # (B, W)
+    woff = jnp.where(valid & (abs_pos < Smax), abs_pos % psz, psz)
+
+    def body(carry, inputs):
+        h_in, ck, cv = carry
+        lp, layer_idx = inputs
+        if packed:
+            q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)  # (B, W, C)
+            ck = ck.at[layer_idx, phys, woff, :].set(
+                k_m.astype(ck.dtype), mode="drop")
+            cv = cv.at[layer_idx, phys, woff, :].set(
+                v_m.astype(cv.dtype), mode="drop")
+            q_h = _split_heads(q_m, H)
+        else:
+            q, k, v = _cached_qkv(h_in, lp, cfg, cd)    # (B, H, W, D)
+            # scatter value laid out (B, W, H, D): advanced indices
+            # (phys, woff) broadcast to (B, W) and land first
+            ck = ck.at[layer_idx, phys, :, woff, :].set(
+                k.transpose(0, 2, 1, 3).astype(ck.dtype), mode="drop")
+            cv = cv.at[layer_idx, phys, :, woff, :].set(
+                v.transpose(0, 2, 1, 3).astype(cv.dtype), mode="drop")
+            q_h = q
+        k_all = _gather_pages(
+            jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
+            tables, packed, H)
+        v_all = _gather_pages(
+            jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
+            tables, packed, H)
+        attn = windowed_cached_attention(q_h, k_all, v_all, pos_eff)
+        return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
+                ck, cv), None
+
+    if cfg.use_layer_scan:
+        layer_ids = jnp.arange(cfg.n_layer)
+        (x, new_k, new_v), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], layer_ids))
+    else:
+        carry = (x, cache["k"], cache["v"])
+        for i in range(cfg.n_layer):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            carry, _ = body(carry, (lp, i))
+        x, new_k, new_v = carry
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                    cfg.layernorm_eps)
+    head = (params["wte"].astype(cd).T if cfg.tied_head
+            else params["lm_head"].astype(cd))
+    return (x @ head).astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def prefill_chunk_paged(params: Params, idx: jnp.ndarray,
+                        offset: jnp.ndarray, limit: jnp.ndarray,
+                        table_row: jnp.ndarray,
+                        cache: Dict[str, jnp.ndarray], cfg: ModelConfig
+                        ) -> Dict[str, jnp.ndarray]:
+    """Chunked prefill of ONE slot's prompt through its page table.
+
+    idx: (1, Pc) chunk of the prompt; offset: scalar int32 first
+    absolute position (with a prefix-cache hit the first chunk starts at
+    the first UNCACHED token, any position — no chunk-alignment
+    requirement); limit: scalar int32 true prompt length — writes at
+    positions >= limit are DROPPED. Dropping padding is load-bearing
+    here where the contiguous pool merely tolerated it: a padded final
+    chunk's tail positions can fall past the slot's reserved pages,
+    where the clamped table entry (0) references a page owned by a
+    DIFFERENT request. Queries attend the gathered logical view masked
+    to k <= offset+i (``windowed_cached_attention`` — write-then-attend
+    across chunks, exactly ``prefill_chunk_into_slot``'s discipline);
+    padded queries' outputs are garbage and discarded.
+    """
+    cd = _dtype(cfg.dtype)
+    _, Pc = idx.shape
+    packed = cfg.decode_cache_layout == "packed"
+    psz = paged_page_size(cfg, cache)
+    mp = table_row.shape[0]
+    H = cfg.n_head
+    Smax = mp * psz
+    positions = offset + jnp.arange(Pc, dtype=jnp.int32)   # (Pc,)
+    # eager calls assert; the engine bounds [offset, limit) at admission
+    check_in_bounds(offset, 1, cfg.block_size, what="paged prefill chunk")
+    x = (params["wte"].astype(cd)[idx]
+         + params["wpe"].astype(cd)[jnp.minimum(positions,
+                                                cfg.block_size - 1)][None])
+    lpage = jnp.minimum(positions // psz, mp - 1)
+    phys = table_row[lpage]                                # (Pc,)
+    woff = jnp.where((positions < limit) & (positions < Smax),
+                     positions % psz, psz)
+    base = jnp.reshape(offset, (1,))
+
+    def body(carry, inputs):
+        h_in, ck, cv = carry
+        lp, layer_idx = inputs
+        q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)  # (1, Pc, C)
+        if packed:
+            ck = ck.at[layer_idx, phys, woff, :].set(
+                k_m[0].astype(ck.dtype), mode="drop")
+            cv = cv.at[layer_idx, phys, woff, :].set(
+                v_m[0].astype(cv.dtype), mode="drop")
+        else:
+            k0 = _split_heads(k_m, H)[0].transpose(1, 0, 2)  # (Pc, H, D)
+            v0 = _split_heads(v_m, H)[0].transpose(1, 0, 2)
+            ck = ck.at[layer_idx, phys, :, woff, :].set(
+                k0.astype(ck.dtype), mode="drop")
+            cv = cv.at[layer_idx, phys, :, woff, :].set(
+                v0.astype(cv.dtype), mode="drop")
+        k_all = _gather_pages(
+            jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
+            table_row[None], packed, H)
+        v_all = _gather_pages(
+            jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
+            table_row[None], packed, H)
+        attn = windowed_cached_attention(_split_heads(q_m, H), k_all,
+                                         v_all, base)
+        return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
+                ck, cv), None
+
+    if cfg.use_layer_scan:
+        layer_ids = jnp.arange(cfg.n_layer)
+        (_, ck, cv), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], layer_ids))
+    else:
+        carry = (x, cache["k"], cache["v"])
+        for i in range(cfg.n_layer):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            carry, _ = body(carry, (lp, i))
+        _, ck, cv = carry
+    return {"k": ck, "v": cv}
+
+
 def prefill_chunk_into_slot(params: Params, idx: jnp.ndarray,
                             offset: jnp.ndarray, slot: jnp.ndarray,
                             cache: Dict[str, jnp.ndarray], cfg: ModelConfig

@@ -1,0 +1,180 @@
+"""Paged decode attention: one Pallas kernel whose scalar-prefetched
+page table streams ONLY a slot's mapped pages.
+
+The XLA paged decode path (models/gpt.py decode_step_paged) gathers
+every slot's full (max_pages, page, C) view each layer each step —
+simple and parity-exact, but it fetches max_pages pages per slot
+regardless of how short the slot's sequence actually is. This kernel
+puts the page table in scalar-prefetch SMEM and lets the BLOCK INDEX
+MAP translate (slot, logical page) -> physical page right before the
+DMA: grid (B, max_pages), page minor, and logical pages past the slot's
+live frontier map to the SAME physical page as the previous grid step —
+Pallas skips the re-fetch for a repeated block index (the exact trick
+the streamed flash kernels' triangular tile map uses for fully-masked
+tiles), so a slot at position p streams ceil(p/page) pages, not
+max_pages. Accumulation is online softmax across page steps (f32
+running max / denominator per head in VMEM scratch); the fresh K/V
+column rides separately and folds in at the final page step, so the
+kernel attends the STALE pool bit-equivalently to write-then-attend
+(cache[pos] would hold exactly the fresh k/v) — the caller scatters the
+fresh row afterwards, mirroring ops/decode_pallas.py's packed kernel.
+
+Packed (page, C) layout only: heads are static D-wide lane slices of
+the fully-packed row (no D-minor tile padding in the stream). Gated to
+TPU (`_paged_attn_backend_ok`, monkeypatched by tests to exercise the
+interpreter on CPU) and to shapes inside `paged_decode_supported`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_pallas import (LANES, NEG_INF, _compiler_params,
+                           _interpret_mode, _vmem_spec, pltpu)
+
+# VMEM budget: one (page, C) K and V block per grid step, double-
+# buffered, plus the (1, C) rows and f32 accumulators. 4 MiB covers
+# C=768 pages of 1024 tokens bf16 with margin.
+PAGED_DECODE_BYTES = 4 * 1024 * 1024
+
+
+def _paged_attn_backend_ok() -> bool:
+    """Pallas lowering gate (tests monkeypatch this to run the
+    interpret-mode kernel on CPU). Sharding safety is the caller's
+    concern — the serve engine is single-device by construction."""
+    return jax.default_backend() == "tpu"
+
+
+def paged_decode_supported(n_head: int, head_dim: int, page_size: int,
+                           itemsize: int = 2) -> bool:
+    """Envelope: lane-sliceable heads, sublane-aligned page length,
+    per-head accumulator lanes available, both page blocks in budget."""
+    if head_dim not in (32, 64, 128, 256) or n_head > LANES:
+        return False
+    if page_size % 8 != 0:
+        return False
+    if pltpu is None and not _interpret_mode():
+        return False
+    C = n_head * head_dim
+    return 2 * page_size * C * itemsize <= PAGED_DECODE_BYTES
+
+
+def _paged_kernel(tables_ref, pos_ref, q_ref, knew_ref, vnew_ref,
+                  kp_ref, vp_ref, out_ref, acc_ref, m_ref, l_ref, *,
+                  n_head, head_dim, page_size, n_pages_per_slot, scale):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    D, psz = head_dim, page_size
+    pos = pos_ref[b]
+    live = (pos + psz - 1) // psz        # pages holding positions < pos
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(p < live)
+    def _accumulate():
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (psz, 1), 0) + p * psz
+        for i in range(n_head):
+            sl = slice(i * D, (i + 1) * D)
+            q = q_ref[:, sl].astype(jnp.float32)                 # (1, D)
+            kc = kp_ref[:, sl]                                   # (psz, D)
+            vc = vp_ref[:, sl]
+            s = jnp.sum(kc.astype(jnp.float32) * q, axis=-1,
+                        keepdims=True) * scale                   # (psz, 1)
+            s = jnp.where(kpos < pos, s, NEG_INF)
+            m_prev = m_ref[0, i]
+            m_new = jnp.maximum(m_prev, jnp.max(s))
+            alpha = jnp.exp(m_prev - m_new)
+            # masked rows contribute EXACTLY zero (not exp(0)): with a
+            # fully-masked page m_new stays NEG_INF and s - m_new == 0
+            pexp = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+            l_ref[0, i] = l_ref[0, i] * alpha + jnp.sum(pexp)
+            acc_ref[:, sl] = (acc_ref[:, sl] * alpha
+                              + jnp.sum(pexp.astype(jnp.float32)
+                                        * vc.astype(jnp.float32),
+                                        axis=0, keepdims=True))
+            m_ref[0, i] = m_new
+
+    @pl.when(p == n_pages_per_slot - 1)
+    def _finalize():
+        for i in range(n_head):
+            sl = slice(i * D, (i + 1) * D)
+            q = q_ref[:, sl].astype(jnp.float32)
+            s_new = jnp.sum(knew_ref[:, sl].astype(jnp.float32)
+                            * q) * scale                         # scalar
+            m2 = jnp.maximum(m_ref[0, i], s_new)
+            alpha = jnp.exp(m_ref[0, i] - m2)
+            p_new = jnp.exp(s_new - m2)
+            denom = l_ref[0, i] * alpha + p_new   # >= p_new > 0 always
+            out = (acc_ref[:, sl] * alpha
+                   + p_new * vnew_ref[:, sl].astype(jnp.float32)) / denom
+            out_ref[:, sl] = out.astype(out_ref.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray, k_new: jnp.ndarray,
+                           v_new: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray, tables: jnp.ndarray,
+                           pos: jnp.ndarray, *, n_head: int) -> jnp.ndarray:
+    """Decode attention for one layer of a paged packed pool.
+
+    q, k_new, v_new: (B, C) fresh merged rows; k_pages/v_pages:
+    (n_pages, page, C) STALE pool (position ``pos`` not yet written);
+    tables: (B, max_pages) int32; pos: (B,) int32 logical positions.
+    Returns the merged (B, C) attention output — bit-equivalent to
+    scattering k_new/v_new at ``pos`` and attending positions <= pos.
+    """
+    N, psz, C = k_pages.shape
+    B, mp = tables.shape
+    D = C // n_head
+    kernel = functools.partial(
+        _paged_kernel, n_head=n_head, head_dim=D, page_size=psz,
+        n_pages_per_slot=mp, scale=D ** -0.5)
+
+    def row_map(b, p, tables, pos):
+        return (b, 0, 0)
+
+    def page_map(b, p, tables, pos):
+        live = (pos[b] + psz - 1) // psz
+        # past the frontier: repeat the previous step's physical page —
+        # a repeated block index skips the DMA (the fetch-skip trick)
+        pm = jnp.where(p < live, p, jnp.maximum(live - 1, 0))
+        return (tables[b, pm], 0, 0)
+
+    row = _vmem_spec((None, 1, C), row_map)
+    kw = {}
+    cp = _compiler_params(0, 2)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    if pltpu is not None:
+        scratch = [pltpu.VMEM((1, C), jnp.float32),
+                   pltpu.VMEM((1, LANES), jnp.float32),
+                   pltpu.VMEM((1, LANES), jnp.float32)]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, mp),
+            in_specs=[row, row, row,
+                      _vmem_spec((None, psz, C), page_map),
+                      _vmem_spec((None, psz, C), page_map)],
+            out_specs=row,
+            scratch_shapes=scratch,
+        )
+        out = pl.pallas_call(
+            kernel, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, 1, C), q.dtype),
+            interpret=_interpret_mode(), **kw,
+        )(jnp.asarray(tables, jnp.int32), jnp.asarray(pos, jnp.int32),
+          q[:, None, :], k_new[:, None, :], v_new[:, None, :],
+          k_pages, v_pages)
+    else:  # pragma: no cover — pltpu-less installs are gated out by
+        # paged_decode_supported; kept so an explicit call still errors
+        # with a clear message instead of a pallas internals traceback
+        raise RuntimeError("paged_decode_attention needs pallas TPU "
+                           "memory spaces (jax.experimental.pallas.tpu)")
+    return out[:, 0, :]

@@ -1,7 +1,10 @@
 """Continuous-batching serving subsystem.
 
-- ``cache_pool``: fixed slot pool over one pre-allocated multi-slot KV
-  cache (slot assignment/free + per-slot position counters);
+- ``pages``: paged KV pool + radix prefix cache — refcounted page
+  allocator, per-slot page tables (host-mirrored, device-fed), LRU
+  eviction of cached prefixes, copy-on-write splits of shared pages;
+- ``cache_pool``: the original fixed-slot contiguous pool (kept for
+  offline callers; per-slot position counters live here either way);
 - ``scheduler``: bounded admission queue with backpressure and deadline
   dropping;
 - ``engine``: the per-step loop — admit (chunked prefill into the
@@ -25,6 +28,7 @@ is opt-in via ``faults.watchdog.ResilienceConfig`` on the Engine.
 from .cache_pool import CachePool
 from .engine import Engine, EngineConfig, compile_counts
 from .journal import RequestJournal
+from .pages import PageAllocator, PagedCachePool, RadixIndex
 from .replay import ReplayConfig, format_summary, make_trace, run_replay
 from .requests import Request, RequestResult, SamplingParams
 from .scheduler import Scheduler
@@ -32,6 +36,7 @@ from .speculative import (Drafter, ModelDrafter, NGramDrafter,
                           draft_config_from_preset, make_drafter)
 
 __all__ = ["CachePool", "Engine", "EngineConfig", "compile_counts",
+           "PageAllocator", "PagedCachePool", "RadixIndex",
            "RequestJournal",
            "ReplayConfig", "format_summary", "make_trace", "run_replay",
            "Request", "RequestResult", "SamplingParams", "Scheduler",

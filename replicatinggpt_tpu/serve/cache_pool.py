@@ -72,6 +72,9 @@ class CachePool:
             cfg, n_slots, max_len=self.max_len, dtype=dtype))
         self._free: List[int] = list(range(n_slots - 1, -1, -1))
         self._owner: Dict[int, str] = {}        # slot -> request id
+        self._slot_by_request: Dict[str, int] = {}  # reverse index: the
+        # engine resolves request id -> slot on EVERY finish/cancel, and
+        # the old linear scan made that O(n_slots) per call
         # host-side per-slot positions, updated by the engine in place
         # (its step arrays alias this buffer). Living on the pool makes
         # the committed frontier readable by a drafter
@@ -105,19 +108,21 @@ class CachePool:
             return None
         slot = self._free.pop()
         self._owner[slot] = request_id
+        self._slot_by_request[request_id] = slot
         self.positions[slot] = position
         return slot
 
     def release(self, slot: int) -> None:
         owner = self._owner.pop(slot, None)
         assert owner is not None, f"slot {slot} double-free"
+        # conditional: never KeyError another slot's mapping if a caller
+        # slipped duplicate request ids past its own validation
+        if self._slot_by_request.get(owner) == slot:
+            del self._slot_by_request[owner]
         self._free.append(slot)
 
     def owner(self, slot: int) -> Optional[str]:
         return self._owner.get(slot)
 
     def slot_of(self, request_id: str) -> Optional[int]:
-        for slot, rid in self._owner.items():
-            if rid == request_id:
-                return slot
-        return None
+        return self._slot_by_request.get(request_id)

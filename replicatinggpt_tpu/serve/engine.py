@@ -10,13 +10,16 @@ bookkeeping plus small per-step input arrays.
 Per step the engine:
 
 1. expires deadlines (queued and active),
-2. admits queued prompts into free pool slots — chunked prefill
-   (``models.gpt.prefill_chunk_into_slot``) writes the prompt's K/V
-   into the slot's cache region under ONE compiled program regardless
-   of prompt length,
-3. runs ONE jitted ``decode_step_multi`` over ALL slots — per-slot
-   positions, per-slot active mask, per-slot RNG streams, per-slot
-   sampling params (``sample.generate.sample_tokens_batched``) — and
+2. admits queued prompts into free pool slots, gated on free PAGES as
+   well as free slots (serve/pages.py: the KV cache is a paged pool +
+   per-slot page tables with radix prefix reuse) — admission claims the
+   longest cached prefix and chunked prefill
+   (``models.gpt.prefill_chunk_paged``) writes only the UNCACHED tail's
+   K/V through the slot's page table, under ONE compiled program
+   regardless of prompt length or prefix-hit length,
+3. runs ONE jitted ``decode_step_paged`` over ALL slots — per-slot
+   page tables, positions, active mask, RNG streams and sampling
+   params (``sample.generate.sample_tokens_batched``) — and
    fetches the (n_slots,) sampled tokens. With a drafter attached
    (serve/speculative.py) the decode phase is instead ONE jitted
    ``_engine_verify``: score a static (k+1)-token drafted window per
@@ -25,10 +28,14 @@ Per step the engine:
    chunked prefill admissions exactly like plain decode.
 
 Zero recompiles at steady state: the decode/verify programs are keyed
-only on the (static) model config, pool shape and draft width, the
-prefill program only on the chunk shape; all are module-level jits
-whose cache sizes the tests assert stay flat across a long replay
-(tests/test_serve.py, tests/test_speculative.py).
+only on the (static) model config, pool/page shapes and draft width,
+the prefill program only on the chunk shape, the COW page copy on the
+pool shape alone; page tables, positions and every other request-level
+input are traced fixed-shape arrays, so admissions, prefix hits, LRU
+evictions and copy-on-write splits all happen without a recompile. All
+are module-level jits whose cache sizes the tests assert stay flat
+across a long replay (tests/test_serve.py, tests/test_speculative.py,
+tests/test_pages.py).
 
 Observability: per-request TTFT / decode tok/s / queue wait, engine
 counters (admissions, rejections, completions, tokens), slot-occupancy
@@ -52,15 +59,16 @@ from ..config import ModelConfig
 from ..faults.inject import fire as fault_fire
 from ..faults.watchdog import (LoadShedder, ResilienceConfig, SpecHealth,
                                StepWatchdog)
-from ..models.gpt import (decode_step_multi, prefill_chunk_into_slot,
-                          verify_step_multi)
+from ..models.gpt import (decode_step_paged, prefill_chunk_paged,
+                          verify_step_paged)
 from ..sample.generate import sample_tokens_batched
 from ..utils.logging import Metrics
 from ..utils.profiling import StepTimer, annotate
 from ..utils.sanitize import CompileGuard, check_in_bounds, sanitize_enabled
-from .cache_pool import CachePool
+from .pages import PagedCachePool
 from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_LENGTH_CAP,
-                       FINISH_MAX_TOKENS, FINISH_SHED, Request, RequestResult)
+                       FINISH_MAX_TOKENS, FINISH_SHED, REJECT_BAD_REQUEST,
+                       Request, RequestResult)
 from .scheduler import Scheduler
 from .speculative import (DraftContext, Drafter, spec_accept_and_sample,
                           timed_draft)
@@ -76,6 +84,15 @@ class EngineConfig:
     pool_size: int = 8
     max_queue: int = 64
     prefill_chunk: int = 0
+    # --- paged KV cache (serve/pages.py) --------------------------------
+    page_size: int = 0        # tokens per KV page; 0 = min(16, block_size)
+    max_pages: int = 0        # logical pages per slot; 0 = ceil(block/page)
+    n_pages: int = 0          # physical pool pages; 0 = pool_size*max_pages
+                              # (the contiguous pool's HBM exactly); fewer
+                              # pages shrinks HBM and admission gates on it
+    prefix_cache: bool = True  # radix prefix reuse (False: pages only)
+    paged_kernel: bool = False  # opt-in Pallas paged decode fast path
+                                # (TPU, packed cache layout only)
 
     def chunk(self, block_size: int) -> int:
         """Effective prefill chunk — see ``cache_pool.prefill_chunk_size``
@@ -98,19 +115,24 @@ class _Active:
     t_last_token: float = 0.0
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def _engine_decode(params, tok, pos, active, cache, rngs, temp, top_k,
-                   top_p, greedy, cfg: ModelConfig):
-    """The steady-state program: one multi-slot decode + batched sample.
+@partial(jax.jit, static_argnames=("cfg", "use_pallas"),
+         donate_argnames=("cache",))
+def _engine_decode(params, tok, pos, active, tables, cache, rngs, temp,
+                   top_k, top_p, greedy, cfg: ModelConfig,
+                   use_pallas: bool = False):
+    """The steady-state program: one multi-slot PAGED decode + batched
+    sample.
 
-    All request-level inputs are small (n_slots,) arrays — traced, so
-    admissions/completions/sampling changes never retrace. Inactive
-    slots run at position 0 (their writes land in cache regions the
-    next occupant's prefill overwrites before attending) and their
-    sampled token is masked to 0.
+    All request-level inputs are small traced arrays — the (n_slots,)
+    step vectors plus the (n_slots, max_pages) page tables — so
+    admissions/completions/prefix-hits/evictions/COW remaps never
+    retrace. Inactive slots run at position 0 with their cache writes
+    DROPPED inside ``decode_step_paged`` (a released slot's stale table
+    may reference pages another request now owns) and their sampled
+    token is masked to 0.
     """
-    pos_eff = jnp.where(active, pos, 0)
-    logits, cache = decode_step_multi(params, tok, pos_eff, cache, cfg)
+    logits, cache = decode_step_paged(params, tok, pos, active, tables,
+                                      cache, cfg, use_pallas=use_pallas)
     splits = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
     nxt = sample_tokens_batched(splits[:, 0], logits, temp, top_k, top_p,
                                 greedy)
@@ -118,45 +140,63 @@ def _engine_decode(params, tok, pos, active, cache, rngs, temp, top_k,
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def _engine_prefill(params, chunk, offset, slot, cache, cfg: ModelConfig):
-    return prefill_chunk_into_slot(params, chunk, offset, slot, cache, cfg)
+def _engine_prefill(params, chunk, offset, limit, table_row, cache,
+                    cfg: ModelConfig):
+    return prefill_chunk_paged(params, chunk, offset, limit, table_row,
+                               cache, cfg)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def _engine_verify(params, window, pos, m, active, cache, rngs, temp,
-                   top_k, top_p, greedy, cfg: ModelConfig):
+def _engine_verify(params, window, pos, m, active, tables, cache, rngs,
+                   temp, top_k, top_p, greedy, cfg: ModelConfig):
     """The speculative steady-state program: ONE target forward over a
-    static (n_slots, k+1) window + per-position acceptance. Draft count
-    k is carried by the window's static width, so a fixed --spec-k
-    means exactly one extra compiled program next to decode/prefill.
-    All request-level inputs — positions, valid-draft counts, sampling
-    params, the drafted tokens themselves — are traced (n_slots,)-sized
-    arrays, so acceptance outcomes never retrace. Inactive slots run at
-    position 0 with zero valid drafts (their writes land in regions the
-    next occupant's prefill overwrites) and their outputs are masked.
+    static (n_slots, k+1) window against the PAGED pool + per-position
+    acceptance. Draft count k is carried by the window's static width,
+    so a fixed --spec-k means exactly one extra compiled program next
+    to decode/prefill. All request-level inputs — positions, valid-
+    draft counts, page tables, sampling params, the drafted tokens —
+    are traced fixed-shape arrays, so acceptance outcomes never
+    retrace. Inactive slots run at position 0 with zero valid drafts
+    and dropped writes; their outputs are masked.
     """
-    pos_eff = jnp.where(active, pos, 0)
+    logits, cache = verify_step_paged(params, window, pos, m, active,
+                                      tables, cache, cfg)
     m_eff = jnp.where(active, m, 0)
-    logits, cache = verify_step_multi(params, window, pos_eff, m_eff,
-                                      cache, cfg)
     n_acc, out, rngs = spec_accept_and_sample(rngs, logits, window, m_eff,
                                               temp, top_k, top_p, greedy)
     return (jnp.where(active, n_acc, 0),
             jnp.where(active[:, None], out, 0), cache, rngs)
 
 
+@partial(jax.jit, donate_argnames=("cache",))
+def _engine_page_copy(cache, src, dst):
+    """Copy-on-write page split: duplicate physical page ``src`` into
+    ``dst`` across all layers of both pool arrays. One program for any
+    (src, dst) — both traced scalars — warmed at engine construction so
+    the first real COW mid-replay cannot cost a compile. The caller
+    bounds dst host-side (check_in_bounds below no-ops on tracers)."""
+    out = {}
+    for name, arr in cache.items():
+        check_in_bounds(dst, 1, arr.shape[1], what="COW page copy")
+        page = jax.lax.dynamic_index_in_dim(arr, src, 1, keepdims=True)
+        out[name] = jax.lax.dynamic_update_slice_in_dim(arr, page, dst,
+                                                        axis=1)
+    return out
+
+
 def compile_counts() -> Dict[str, int]:
     """Process-wide compiled-program counts for the engine entry points
     (module-level jits, so they accumulate across engines), including
-    the speculative verify step and the model drafter's two programs.
-    The replay driver's before/after bookkeeping reads these; the
-    *live* steady-state enforcement is per-engine via
-    :class:`CompileGuard` (utils.sanitize), which raises from the
-    offending step instead of reporting after the fact."""
+    the speculative verify step, the COW page copy, and the model
+    drafter's two programs. The replay driver's before/after
+    bookkeeping reads these; the *live* steady-state enforcement is
+    per-engine via :class:`CompileGuard` (utils.sanitize), which raises
+    from the offending step instead of reporting after the fact."""
     from .speculative import _draft_decode_k, _draft_prefill
     return {"decode": _engine_decode._cache_size(),
             "prefill": _engine_prefill._cache_size(),
             "verify": _engine_verify._cache_size(),
+            "page_copy": _engine_page_copy._cache_size(),
             "draft_decode": _draft_decode_k._cache_size(),
             "draft_prefill": _draft_prefill._cache_size()}
 
@@ -201,13 +241,26 @@ class Engine:
                     "draft model must share the target block_size"
                 assert drafter.pool_size == ecfg.pool_size, \
                     "draft pool must match the engine pool"
-        self.pool = CachePool(cfg, ecfg.pool_size)
+        self.pool = PagedCachePool(
+            cfg, ecfg.pool_size, page_size=ecfg.page_size,
+            max_pages=ecfg.max_pages, n_pages=ecfg.n_pages,
+            prefix_cache=ecfg.prefix_cache)
         self.scheduler = Scheduler(ecfg.max_queue, cfg.block_size,
                                    clock=clock)
         self.metrics = Metrics()
         self.step_timer = StepTimer()
         P = ecfg.pool_size
         self._chunk = ecfg.chunk(cfg.block_size)
+        # Pallas paged-decode route: static per engine (one compiled
+        # program either way); packed layout + TPU backend + envelope
+        from ..ops import paged_pallas
+        self._use_pallas = bool(
+            ecfg.paged_kernel
+            and cfg.decode_cache_layout == "packed"
+            and paged_pallas._paged_attn_backend_ok()
+            and paged_pallas.paged_decode_supported(
+                cfg.n_head, cfg.head_dim, self.pool.page_size,
+                jnp.dtype(self.pool.cache["k"].dtype).itemsize))
         self._tok = np.zeros((P,), np.int32)
         # ALIAS of pool.positions (one host buffer): the pool exposes the
         # committed frontier to drafters, the engine advances it in place
@@ -236,6 +289,12 @@ class Engine:
         self._decode_guard = CompileGuard(_engine_decode, "serve/decode")
         self._prefill_guard = CompileGuard(_engine_prefill, "serve/prefill")
         self._verify_guard = CompileGuard(_engine_verify, "serve/verify")
+        self._copy_guard = CompileGuard(_engine_page_copy, "serve/page-copy")
+        # warm the COW program NOW (page 0 onto itself — a value no-op):
+        # the first real copy-on-write happens mid-replay, where a
+        # compile would break the pinned-flat compile_counts invariant
+        self.pool.cache = self._copy_guard(self.pool.cache, jnp.int32(0),
+                                           jnp.int32(0))
         self._sanitize = sanitize_enabled()
         # self-healing (faults.watchdog): all policies opt-in via rcfg.
         # Degraded transitions move between the two already-budgeted
@@ -261,6 +320,14 @@ class Engine:
 
     def submit(self, req: Request) -> Optional[RequestResult]:
         self.metrics.inc("requests_submitted")
+        if (self.pool.slot_of(req.id) is not None
+                or self.scheduler.contains(req.id)):
+            # an id must be unique among in-flight requests: results,
+            # cancellation, the journal and the pool's reverse index all
+            # key on it
+            self.metrics.inc(REJECT_BAD_REQUEST)
+            return RequestResult(id=req.id, tokens=[],
+                                 finish_reason=REJECT_BAD_REQUEST)
         reason = self.scheduler.submit(req)
         if reason is not None:
             # an expired-at-submit deadline is a terminal finish, not a
@@ -324,16 +391,25 @@ class Engine:
                                    f"queued request(s) under sustained "
                                    f"overload")
 
-        admitted, dropped = self.scheduler.admit(self.pool.n_free, now)
-        for req, t_submit, reason in dropped:
-            finished.append(self._finish_unstarted(req, t_submit, reason,
-                                                   now))
-        for req, t_submit in admitted:
+        # one-at-a-time admission: each _admit changes page availability,
+        # so the fits check must see fresh allocator state per request
+        # (FIFO preserved — a head that does not fit blocks the queue
+        # rather than being skipped, so big requests cannot starve)
+        while self.pool.n_free > 0:
+            admitted, dropped = self.scheduler.admit(1, now,
+                                                     fits=self._fits)
+            for req, t_submit, reason in dropped:
+                finished.append(self._finish_unstarted(req, t_submit,
+                                                       reason, now))
+            if not admitted:
+                break
+            req, t_submit = admitted[0]
             self._admit(req, t_submit, now)
 
         self.metrics.gauge("queue_depth", self.scheduler.depth)
         self.metrics.gauge("slots_active", int(self._active.sum()))
         self.metrics.gauge("slot_occupancy", self.pool.occupancy)
+        self.metrics.gauge("pages_in_use", self.pool.alloc.pages_in_use)
 
         # speculative re-probe countdown while degraded (auto-disabled
         # only: an operator pin via set_spec_active(False) must stick)
@@ -358,6 +434,9 @@ class Engine:
             use_spec = self.drafter is not None and self._spec_active
             finished.extend(self._verify_once() if use_spec
                             else self._decode_once())
+            # deferred radix registration: the full prompt page holding
+            # position P-1 becomes shareable once the frontier passed it
+            self.pool.flush_pending()
             if self._watchdog is not None:
                 dur = time.perf_counter() - t_wall
                 if self._watchdog.observe(dur):
@@ -417,7 +496,11 @@ class Engine:
         s["compile_counts"] = compile_counts()
         s["compile_guards"] = {"decode": self._decode_guard.stats(),
                                "prefill": self._prefill_guard.stats(),
-                               "verify": self._verify_guard.stats()}
+                               "verify": self._verify_guard.stats(),
+                               "page_copy": self._copy_guard.stats()}
+        # paged-pool health: bench dashboards key on this block (schema
+        # pinned in tests/test_pages.py)
+        s["pages"] = self.pool.stats()
         c = self.metrics.counters
         s["recovery"] = {
             "watchdog_stalls": int(c.get("watchdog_stalls", 0)),
@@ -446,39 +529,69 @@ class Engine:
 
     # ----------------------------------------------------------- internals
 
+    def _cap(self, req: Request) -> int:
+        """Decode budget for a request: decode step i runs at position
+        P-1+i (the first rewrites the last prompt position), so a slot
+        supports S - P + 1 new tokens before the write position would
+        leave the logical buffer."""
+        return min(req.max_new_tokens,
+                   self.pool.seq_len - int(req.prompt.size) + 1)
+
+    def _fits(self, req: Request) -> bool:
+        """Admission gate beyond free slots: enough free (or LRU-
+        reclaimable) pages for the request's WHOLE lifetime — prompt
+        minus cached prefix plus the full decode budget, reserved
+        eagerly so an admitted request can never strand mid-decode."""
+        return self.pool.can_admit(req.prompt, self._cap(req))
+
     def _admit(self, req: Request, t_submit: float, now: float) -> None:
         P = int(req.prompt.size)
-        # acquire sets pool.positions[slot] = P - 1, which self._pos
-        # aliases — the first decode step rewrites the last prompt index
-        slot = self.pool.acquire(req.id, position=P - 1)
-        assert slot is not None, "scheduler admitted past pool capacity"
+        cap = self._cap(req)
+        # acquire claims the longest radix-cached prefix, reserves the
+        # remaining pages, and sets pool.positions[slot] = P - 1 (which
+        # self._pos aliases — the first decode rewrites the last prompt
+        # index)
+        adm = self.pool.acquire(req.id, req.prompt, cap)
+        assert adm is not None, "scheduler admitted past pool capacity"
+        slot = adm.slot
+        for src, dst in adm.cow:
+            # copy-on-write split of a fully-cached prompt's frontier
+            # page; program warmed at construction (budget 1)
+            check_in_bounds(dst, 1, self.pool.n_pages, what="COW page")
+            self.pool.cache = self._copy_guard(self.pool.cache,
+                                               jnp.int32(src),
+                                               jnp.int32(dst))
+        claimed = adm.claimed
         S = self.pool.seq_len
-        # decode step i runs at position P-1+i (the first rewrites the
-        # last prompt position), so the slot supports S - P + 1 new
-        # tokens before the write position would leave the buffer
-        room = S - P + 1
-        cap = min(req.max_new_tokens, room)
-        chunk = self._chunk
-        n_chunks = -(-P // chunk)
-        # the host-side bound the jitted prefill (offset traced) relies
-        # on: the LAST padded chunk must land inside the slot buffer,
-        # else dynamic_update_slice clamp-corrupts earlier K/V (lint
-        # GL006 / the PR 1 bug). Holds by construction — scheduler
-        # rejects P > block_size and EngineConfig.chunk divides it —
-        # this assert keeps the invariant from silently rotting.
-        check_in_bounds((n_chunks - 1) * chunk, chunk, S,
-                        what=f"prefill of {P}-token prompt in {chunk}-chunks")
-        padded = np.zeros((n_chunks * chunk,), np.int32)
-        padded[:P] = req.prompt
-        cache = self.pool.cache
-        with annotate("serve/prefill"):
-            for c in range(n_chunks):
-                cache = self._prefill_guard(
-                    self.params, jnp.asarray(padded[None,
-                                                    c * chunk:(c + 1) * chunk]),
-                    jnp.int32(c * chunk), jnp.int32(slot), cache, self.cfg)
-        self.pool.cache = cache
+        if claimed < P:
+            chunk = self._chunk
+            n_chunks = -(-(P - claimed) // chunk)
+            # host-side bound for the jitted prefill (offset traced):
+            # every REAL token position must sit inside the logical
+            # buffer — padded tail positions are routed to scatter-drop
+            # inside prefill_chunk_paged, so only [claimed, P) matters
+            check_in_bounds(claimed, P - claimed, S,
+                            what=f"prefill of {P}-token prompt from "
+                                 f"{claimed} in {chunk}-chunks")
+            padded = np.zeros((n_chunks * chunk,), np.int32)
+            padded[:P - claimed] = req.prompt[claimed:]
+            table_row = jnp.asarray(self.pool.tables[slot])
+            cache = self.pool.cache
+            with annotate("serve/prefill"):
+                for c in range(n_chunks):
+                    cache = self._prefill_guard(
+                        self.params,
+                        jnp.asarray(padded[None,
+                                           c * chunk:(c + 1) * chunk]),
+                        jnp.int32(claimed + c * chunk), jnp.int32(P),
+                        table_row, cache, self.cfg)
+            self.pool.cache = cache
+        # registration AFTER the prefill wrote the pages: a same-step
+        # neighbor may claim them the moment they hit the radix
+        self.pool.commit_admission(slot)
         if self.drafter is not None:
+            # drafters keep their own (unpaged) cache and see the full
+            # prompt — prefix reuse is a target-pool concern
             self.drafter.on_admit(slot, req.prompt)
         self._tok[slot] = req.prompt[-1]
         self._active[slot] = True
@@ -492,7 +605,8 @@ class Engine:
                                     cap=cap,
                                     capped=cap < req.max_new_tokens)
         self.metrics.inc("requests_admitted")
-        self.metrics.inc("prefill_tokens", P)
+        self.metrics.inc("prefill_tokens", P - claimed)
+        self.metrics.inc("prefix_hit_tokens", claimed)
         self.metrics.observe("queue_wait_s", now - t_submit)
 
     def _decode_once(self) -> List[RequestResult]:
@@ -500,10 +614,11 @@ class Engine:
             self.step_timer.start()
             nxt, cache, rngs = self._decode_guard(
                 self.params, jnp.asarray(self._tok), jnp.asarray(self._pos),
-                jnp.asarray(self._active), self.pool.cache, self._rngs,
+                jnp.asarray(self._active), jnp.asarray(self.pool.tables),
+                self.pool.cache, self._rngs,
                 jnp.asarray(self._temp), jnp.asarray(self._top_k),
                 jnp.asarray(self._top_p), jnp.asarray(self._greedy),
-                self.cfg)
+                self.cfg, use_pallas=self._use_pallas)
             self.step_timer.lap(nxt)
         self.pool.cache = cache
         self._rngs = rngs
@@ -597,7 +712,8 @@ class Engine:
             self.step_timer.start()
             n_acc, out, cache, rngs = self._verify_guard(
                 self.params, jnp.asarray(window), jnp.asarray(self._pos),
-                jnp.asarray(m), jnp.asarray(self._active), self.pool.cache,
+                jnp.asarray(m), jnp.asarray(self._active),
+                jnp.asarray(self.pool.tables), self.pool.cache,
                 self._rngs, jnp.asarray(self._temp),
                 jnp.asarray(self._top_k), jnp.asarray(self._top_p),
                 jnp.asarray(self._greedy), self.cfg)

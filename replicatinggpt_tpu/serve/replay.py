@@ -40,6 +40,12 @@ class ReplayConfig:
     deadline_s: float = 0.0        # per-request deadline after arrival; 0=off
     prompt_mode: str = "random"    # 'random' | 'repeat' (tiled small
                                    # pattern — the speculative bench trace)
+                                   # | 'shared_prefix' (every prompt =
+                                   # one common random prefix + a random
+                                   # suffix — the system-prompt traffic
+                                   # shape the radix prefix cache serves)
+    shared_prefix_len: int = 0     # 'shared_prefix' common-prefix length;
+                                   # 0 = prompt_len_max // 2
     spec: str = "off"              # drafter: 'off' | 'ngram' | 'model'
     spec_k: int = 4                # drafted tokens per slot per step
     spec_ngram: int = 3            # n-gram drafter match width
@@ -55,6 +61,11 @@ def make_trace(mcfg: ModelConfig, rcfg: ReplayConfig
     rng = np.random.default_rng(rcfg.seed)
     hi = min(rcfg.prompt_len_max, mcfg.block_size)
     lo = min(rcfg.prompt_len_min, hi)
+    shared = None
+    if rcfg.prompt_mode == "shared_prefix":
+        n_shared = min(rcfg.shared_prefix_len or max(hi // 2, 1), hi - 1)
+        shared = rng.integers(0, mcfg.vocab_size, (n_shared,),
+                              dtype=np.int64)
     t = 0.0
     trace = []
     sp = SamplingParams(temperature=rcfg.temperature, top_k=rcfg.top_k,
@@ -62,13 +73,22 @@ def make_trace(mcfg: ModelConfig, rcfg: ReplayConfig
     for i in range(rcfg.n_requests):
         # host numpy RNG: float() here is not a device round-trip
         t += float(rng.exponential(1.0 / max(rcfg.rate, 1e-9)))  # graftlint: disable=GL004
-        P = int(rng.integers(lo, hi + 1))
-        if rcfg.prompt_mode == "repeat":
+        if shared is not None:
+            # the system-prompt shape: identical prefix + unique tail
+            # (>= 1 token so requests are distinct streams); total
+            # length still honors the [lo, hi] knobs
+            P = int(rng.integers(max(lo, shared.size + 1), hi + 1))
+            prompt = np.concatenate([
+                shared, rng.integers(0, mcfg.vocab_size,
+                                     (P - shared.size,), dtype=np.int64)])
+        elif rcfg.prompt_mode == "repeat":
+            P = int(rng.integers(lo, hi + 1))
             pat = rng.integers(0, mcfg.vocab_size,
                                (min(int(rng.integers(1, 5)), P),),
                                dtype=np.int64)
             prompt = np.tile(pat, -(-P // pat.size))[:P]
         else:
+            P = int(rng.integers(lo, hi + 1))
             prompt = rng.integers(0, mcfg.vocab_size, (P,), dtype=np.int64)
         trace.append((t, Request(
             id=f"r{i:04d}", prompt=prompt.astype(np.int32),
@@ -191,6 +211,16 @@ def format_summary(s: dict) -> str:
         f" (pool), queue wait {pct('queue_wait_s', 1e3, ' ms')}",
         f"recompiles after warmup: {s['recompiles_after_warmup']}",
     ]
+    pg = s.get("pages")
+    if pg:
+        lines.insert(2, (
+            f"pages: {pg['pages_in_use']}/{pg['n_pages']} in use "
+            f"({pg['page_size']} tok/page, util "
+            f"{pg['page_utilization']:.2f}), prefix hits "
+            f"{pg['prefix_hits']}/{pg['prefix_lookups']} "
+            f"({pg['prefix_hit_tokens']} tok, rate "
+            f"{pg['prefix_hit_rate']:.2f}), {pg['evictions']} evictions, "
+            f"{pg['cow_copies']} COW copies"))
     sp = s.get("speculative")
     if sp:
         lines.insert(2, (

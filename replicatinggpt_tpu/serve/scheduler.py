@@ -37,6 +37,11 @@ class Scheduler:
     def depth(self) -> int:
         return len(self._queue)
 
+    def contains(self, request_id: str) -> bool:
+        """Whether ``request_id`` is still queued (submit-time duplicate
+        check; O(queue), which is bounded by max_queue)."""
+        return any(req.id == request_id for req, _ in self._queue)
+
     def submit(self, req: Request) -> Optional[str]:
         """Enqueue ``req``; returns None on acceptance or a rejection
         reason (backpressure / validation) — the caller must surface
@@ -73,24 +78,34 @@ class Scheduler:
                 return True
         return False
 
-    def admit(self, n_free: int, now: Optional[float] = None
+    def admit(self, n_free: int, now: Optional[float] = None,
+              fits: Optional[Callable[[Request], bool]] = None
               ) -> Tuple[List[Tuple[Request, float]],
                          List[Tuple[Request, float, str]]]:
         """Pop up to ``n_free`` admissible requests (arrival order).
 
-        Returns (admitted, dropped): admitted as (request, t_submit)
-        pairs; dropped as (request, t_submit, reason) for queued
-        requests whose deadline expired before a slot freed up.
+        ``fits`` is the engine's resource gate beyond free slots (the
+        paged pool's free-page check): a head that does not fit BLOCKS
+        the queue rather than being skipped — strict FIFO, so a large
+        request cannot be starved by a stream of small ones slipping
+        past it. Returns (admitted, dropped): admitted as
+        (request, t_submit) pairs; dropped as (request, t_submit,
+        reason) for queued requests whose deadline expired before a
+        slot freed up.
         """
         if now is None:
             now = self.clock()
         admitted: List[Tuple[Request, float]] = []
         dropped: List[Tuple[Request, float, str]] = []
         while self._queue and len(admitted) < n_free:
-            req, t_submit = self._queue.popleft()
+            req, t_submit = self._queue[0]
             if req.deadline is not None and now >= req.deadline:
+                self._queue.popleft()
                 dropped.append((req, t_submit, FINISH_DEADLINE))
                 continue
+            if fits is not None and not fits(req):
+                break
+            self._queue.popleft()
             admitted.append((req, t_submit))
         return admitted, dropped
 
