@@ -170,7 +170,7 @@ def torch_cpu_baseline(mcfg, batch_size: int, remeasure: bool) -> float:
         try:
             with open(CACHE_PATH) as f:
                 cache = json.load(f)
-        except Exception:
+        except (OSError, ValueError):   # unreadable/corrupt cache: remeasure
             cache = {}
     if not remeasure and key in cache:
         log(f"torch-CPU baseline (cached): {cache[key]:,.0f} tok/s")
@@ -705,11 +705,15 @@ def bench_train(args) -> None:
 
     log(f"compiling... ({k} steps/dispatch)")
     t0 = time.perf_counter()
+    warm_metrics = None
     for _ in range(n_warmup):
-        state, metrics = run(state, next(batches))
-        # real fetch, not block_until_ready — the axon backend's
-        # block_until_ready returns early (verify-skill finding)
-        jax.device_get(metrics["loss"])
+        state, warm_metrics = run(state, next(batches))
+    if warm_metrics is not None:
+        # one real fetch of the LAST dispatch blocks on the whole warmup
+        # queue (device execution is in-order) — real fetch, not
+        # block_until_ready: the axon backend's block_until_ready
+        # returns early (verify-skill finding)
+        jax.device_get(warm_metrics["loss"])
     log(f"warmup done in {time.perf_counter() - t0:.1f}s")
 
     t0 = time.perf_counter()
@@ -759,7 +763,7 @@ def bench_train(args) -> None:
             try:
                 with open(CACHE_PATH) as f:
                     base = json.load(f).get(_baseline_key(mcfg, B), 0.0)
-            except Exception:
+            except (OSError, ValueError):   # no cache: no baseline column
                 base = 0.0
     else:
         try:

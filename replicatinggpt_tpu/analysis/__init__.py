@@ -6,13 +6,17 @@ utils/sanitize.py for the runtime half (CompileGuard, donation checks,
 GRAFT_SANITIZE mode).
 """
 
-from .baseline import (DEFAULT_BASELINE, diff_against_baseline,
-                       finding_key, load_baseline, write_baseline)
+from .baseline import (DEFAULT_BASELINE, check_ratchet,
+                       diff_against_baseline, finding_key, load_baseline,
+                       write_baseline)
+from .callgraph import ProjectIndex
 from .docgen import render_rule_docs
-from .linter import LintResult, lint_paths, lint_source
+from .linter import (DEFAULT_SEVERITY, LintResult, lint_paths, lint_source,
+                     severity_for)
 from .rules import RULES, Finding, Rule, all_rule_ids
 
-__all__ = ["DEFAULT_BASELINE", "Finding", "LintResult", "RULES", "Rule",
-           "all_rule_ids", "diff_against_baseline", "finding_key",
-           "lint_paths", "lint_source", "load_baseline",
-           "render_rule_docs", "write_baseline"]
+__all__ = ["DEFAULT_BASELINE", "DEFAULT_SEVERITY", "Finding", "LintResult",
+           "ProjectIndex", "RULES", "Rule", "all_rule_ids", "check_ratchet",
+           "diff_against_baseline", "finding_key", "lint_paths",
+           "lint_source", "load_baseline", "render_rule_docs",
+           "severity_for", "write_baseline"]

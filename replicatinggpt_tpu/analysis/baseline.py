@@ -1,26 +1,38 @@
 """Committed lint baseline: pre-existing findings that must not block CI.
 
-A new static analyzer over an existing ~8.6k-line package always finds
+A new static analyzer over an existing ~10k-line package always finds
 things; blocking every PR on a full cleanup guarantees the tool gets
 turned off. Instead the accepted findings are frozen into
 ``graftlint_baseline.json`` and ``lint --baseline`` fails only on NEW
 findings. Fixing a baselined finding then requires refreshing the file
 (``lint --write-baseline``) — the tier-1 test asserts the committed
-baseline matches a fresh whole-package run exactly, so it can go stale
+baseline matches a fresh whole-project run exactly, so it can go stale
 in neither direction.
 
-Baseline entries key on ``(path, rule, stripped source line)`` with
-multiplicity — line numbers are recorded for humans but ignored for
-matching, so findings survive unrelated edits that shift lines.
+v2 semantics:
+
+- Entries key on ``(path, rule, stripped source line)`` — line numbers
+  are recorded for humans but ignored for matching, so findings survive
+  unrelated edits that shift lines. Keys are a SET, not a multiset: one
+  entry absorbs every finding with that key (two findings on one line
+  produce one reviewable entry, the duplicate-entry bug the v1 writer
+  had), and the writer dedupes + stably sorts so baseline diffs read as
+  plain add/remove lines.
+- The baseline is a **ratchet**: :func:`check_ratchet` refuses a
+  refresh whose key set is not a subset of the committed one, so the
+  suppressed-findings count can only go down. Growing the baseline is a
+  reviewed, explicit act (``--allow-growth``), never a side effect of
+  re-running the writer.
+- Only error-severity findings participate; warning-tier directories
+  (tests/) never enter the file.
 """
 
 from __future__ import annotations
 
 import json
-from collections import Counter
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Sequence, Set, Tuple
 
 from .linter import REPO_ROOT
 from .rules import Finding
@@ -34,20 +46,29 @@ def finding_key(f: Finding) -> Key:
     return (f.path, f.rule, f.text)
 
 
-def write_baseline(findings: Sequence[Finding], path: Path) -> None:
+def write_baseline(findings: Sequence[Finding], path: Path) -> int:
+    """Write the deduped, stably-sorted baseline; returns the entry
+    count (== distinct keys, not raw findings)."""
+    by_key: Dict[Key, Finding] = {}
+    for f in findings:
+        k = finding_key(f)
+        if k not in by_key or f.line < by_key[k].line:
+            by_key[k] = f
     entries = [{"path": f.path, "rule": f.rule, "line": f.line,
                 "text": f.text}
-               for f in sorted(findings,
-                               key=lambda f: (f.path, f.line, f.rule))]
+               for f in sorted(by_key.values(),
+                               key=lambda f: (f.path, f.line, f.rule,
+                                              f.text))]
     Path(path).write_text(json.dumps(
-        {"version": 1, "tool": "graftlint", "findings": entries},
+        {"version": 2, "tool": "graftlint", "findings": entries},
         indent=1) + "\n")
+    return len(entries)
 
 
-def load_baseline(path: Path) -> Counter:
+def load_baseline(path: Path) -> Set[Key]:
     data = json.loads(Path(path).read_text())
-    return Counter((e["path"], e["rule"], e["text"])
-                   for e in data.get("findings", []))
+    return {(e["path"], e["rule"], e["text"])
+            for e in data.get("findings", [])}
 
 
 @dataclass
@@ -68,16 +89,46 @@ class BaselineDiff:
 
 
 def diff_against_baseline(findings: Sequence[Finding],
-                          baseline: Counter) -> BaselineDiff:
-    budget: Dict[Key, int] = dict(baseline)
+                          baseline: Set[Key]) -> BaselineDiff:
     new: List[Finding] = []
     matched = 0
+    seen: Set[Key] = set()
     for f in findings:
         k = finding_key(f)
-        if budget.get(k, 0) > 0:
-            budget[k] -= 1
+        if k in baseline:
             matched += 1
+            seen.add(k)
         else:
             new.append(f)
-    stale = sorted(k for k, n in budget.items() for _ in range(n))
+    stale = sorted(baseline - seen)
     return BaselineDiff(new=new, matched=matched, stale=stale)
+
+
+@dataclass
+class RatchetViolation:
+    """Keys a proposed refresh would ADD relative to the committed
+    baseline — the thing ``--write-baseline`` refuses to do."""
+
+    grown: List[Key]
+
+    def format(self) -> str:
+        lines = [f"  + {p}: {r}: {t}" for p, r, t in self.grown]
+        return ("baseline ratchet: refusing to grow the baseline by "
+                f"{len(self.grown)} entr"
+                f"{'y' if len(self.grown) == 1 else 'ies'}:\n"
+                + "\n".join(lines)
+                + "\nfix the finding(s), suppress with a reviewed pragma, "
+                  "or pass --allow-growth for an explicitly reviewed "
+                  "baseline expansion")
+
+
+def check_ratchet(findings: Sequence[Finding],
+                  committed_path: Path) -> List[Key]:
+    """Keys the findings would add vs the committed baseline (empty ==
+    the refresh only shrinks or holds). A missing committed file is a
+    bootstrap, not growth."""
+    if not Path(committed_path).exists():
+        return []
+    committed = load_baseline(committed_path)
+    proposed = {finding_key(f) for f in findings}
+    return sorted(proposed - committed)

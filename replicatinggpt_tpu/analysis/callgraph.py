@@ -1,0 +1,719 @@
+"""Project-wide call graph with per-function summaries (graftlint v2).
+
+One pass parses every lint target, resolves imports between them, and
+builds a :class:`FunctionSummary` per module-level function / method:
+does it host-sync, does it device-call, which params flow into
+shape/static positions, which params are donated, which returns alias
+parameters, which names it captures from enclosing scope. dataflow.py
+then re-runs the rule set with these summaries available, which is what
+turns the per-file syntactic rules interprocedural — GL004 fires when
+the ``.item()`` is two helper calls below the step loop, GL002 when the
+device call hides behind a re-exported wrapper.
+
+Everything here is still pure host Python over ``ast`` — no jax import,
+no tracing — so the project pass stays a sub-second tier-1 check.
+
+Resolution is deliberately conservative (this is a heuristic analysis
+of a dynamic language): a call resolves only when its target is
+unambiguous — a module-level function of the same module (not shadowed
+by a local binding), a name imported from another linted module
+(re-export chains followed), a ``module.attr`` access through an
+imported module, or ``self.method`` within the defining class.
+Unresolved calls simply don't propagate; we prefer a silent miss over
+an interprocedural false positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import PurePosixPath
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# same pragma grammar as linter.py (kept here so callgraph stays
+# import-free of the driver): summaries must not propagate a sync the
+# author explicitly reviewed and suppressed at its site.
+PRAGMA = re.compile(r"#\s*graftlint:\s*(disable(?:-file)?)\s*=\s*"
+                    r"([A-Za-z0-9_,\s]+)")
+
+
+def parse_pragmas(lines: Sequence[str],
+                  all_rule_ids: Sequence[str]) -> Tuple[Dict[int, Set[str]],
+                                                        Set[str]]:
+    """(line -> disabled rule ids, file-wide disabled ids)."""
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    for i, line in enumerate(lines, start=1):
+        m = PRAGMA.search(line)
+        if not m:
+            continue
+        ids = {tok.strip().upper() for tok in m.group(2).split(",")
+               if tok.strip()}
+        if "ALL" in ids:
+            ids = set(all_rule_ids) | {"ALL"}
+        if m.group(1) == "disable-file":
+            per_file |= ids
+        else:
+            per_line.setdefault(i, set()).update(ids)
+    return per_line, per_file
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# --------------------------------------------------------------------------
+# site classifiers shared with the syntactic rules' vocabulary
+# --------------------------------------------------------------------------
+
+_SYNC_FUNCS = {"np.asarray": "np.asarray", "numpy.asarray": "np.asarray",
+               "np.array": "np.array", "numpy.array": "np.array",
+               "jax.device_get": "jax.device_get"}
+
+#: kinds that PROPAGATE through the call graph. ``np.asarray``/``np.array``
+#: deliberately don't: outside a loop they are overwhelmingly host-side
+#: dtype coercion (e.g. utils.sanitize.check_in_bounds normalizing an
+#: index that is already a Python int), and propagating them
+#: interprocedurally drowns real chains in guard-helper noise. Inside a
+#: loop the per-file GL004 still flags them directly.
+PROPAGATING_SYNCS = {".item()", "float(...)", "jax.device_get"}
+
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.random.")
+_DEVICE_EXACT = {"jax.device_put"}
+
+_JIT_WRAPPERS = {"jax.jit", "jit", "pjit", "jax.pmap", "pmap",
+                 "jax.experimental.pjit.pjit"}
+_PARTIAL = {"functools.partial", "partial"}
+
+_SHAPE_BUILDERS = {"jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty",
+                   "jnp.arange", "jnp.eye", "jnp.tri", "jnp.linspace",
+                   "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+                   "jax.numpy.empty", "jax.numpy.arange",
+                   "np.zeros", "np.ones", "np.full", "np.empty",
+                   "np.arange"}
+_SHAPE_METHODS = {"reshape", "broadcast_to"}
+
+
+def sync_call_kind(node: ast.Call) -> Optional[str]:
+    """'np.asarray' / '.item()' / 'float(...)' when this call forces a
+    device->host sync (GL004's vocabulary), else None."""
+    f = dotted(node.func)
+    if (isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+            and not node.args):
+        return ".item()"
+    if f in _SYNC_FUNCS:
+        return _SYNC_FUNCS[f]
+    if (isinstance(node.func, ast.Name) and node.func.id == "float"
+            and len(node.args) == 1
+            and not isinstance(node.args[0], ast.Constant)):
+        return "float(...)"
+    return None
+
+
+def device_call_kind(node: ast.Call) -> Optional[str]:
+    """Dotted name when this call allocates/computes on device (GL002's
+    vocabulary), else None."""
+    f = dotted(node.func)
+    if f is None:
+        return None
+    if f in _DEVICE_EXACT or any(f.startswith(p) for p in _DEVICE_PREFIXES):
+        return f
+    return None
+
+
+def jit_wrap_call(node: ast.AST) -> Optional[ast.Call]:
+    if isinstance(node, ast.Call):
+        f = dotted(node.func)
+        if f in _JIT_WRAPPERS:
+            return node
+        if f in _PARTIAL and node.args and dotted(node.args[0]) in _JIT_WRAPPERS:
+            return node
+    return None
+
+
+def is_jit_wrapper(node: ast.AST) -> bool:
+    return (dotted(node) in _JIT_WRAPPERS) or jit_wrap_call(node) is not None
+
+
+def jit_kwargs(node: ast.AST) -> Dict[str, ast.expr]:
+    call = jit_wrap_call(node)
+    if call is None:
+        return {}
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def const_str_items(node: Optional[ast.expr]) -> List[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def const_int_items(node: Optional[ast.expr]) -> List[int]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+# --------------------------------------------------------------------------
+# summaries
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class CallSite:
+    """One call expression inside a function body (or at module scope)."""
+
+    node: ast.Call
+    func_expr: ast.expr           # the callee expression
+    loop_depth: int               # enclosing loops within this function
+    guarded: bool                 # under an `if` inside the innermost loop
+    loop_vars: Set[str]           # for-targets of enclosing loops
+
+
+@dataclass
+class FunctionSummary:
+    label: str                    # file label the function lives in
+    name: str                     # local qualname: "f" or "Class.f"
+    node: ast.FunctionDef = None
+    params: List[str] = field(default_factory=list)
+    jitted: bool = False
+    static_params: Set[str] = field(default_factory=set)
+    donated_params: Set[str] = field(default_factory=set)
+    shard_annotated: bool = False    # jitted with in_/out_shardings
+    #: params that flow into shape-building / static positions (the
+    #: recompile-per-value surface of GL013)
+    shape_params: Set[str] = field(default_factory=set)
+    #: direct host-sync sites in the body (pragma-suppressed ones are
+    #: already dropped): (node, kind)
+    sync_sites: List[Tuple[ast.AST, str]] = field(default_factory=list)
+    #: direct device-call sites (GL002 vocabulary), pragma-filtered
+    device_sites: List[Tuple[ast.AST, str]] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    #: names read but never bound locally (captured from enclosing scope)
+    free_reads: Set[str] = field(default_factory=set)
+    #: params returned as-is (possibly through a trivial local alias)
+    returns_params: Set[str] = field(default_factory=set)
+    local_names: Set[str] = field(default_factory=set)
+
+    @property
+    def qname(self) -> str:
+        return f"{self.label}::{self.name}"
+
+
+@dataclass
+class ImportBinding:
+    """What a local name means: a module, or a symbol of a module."""
+
+    module: str                   # python dotted module name
+    symbol: Optional[str] = None  # None => the name IS the module
+
+
+@dataclass
+class ModuleInfo:
+    label: str
+    tree: ast.Module
+    lines: Sequence[str]
+    functions: Dict[str, FunctionSummary] = field(default_factory=dict)
+    imports: Dict[str, ImportBinding] = field(default_factory=dict)
+    #: module-scope simple assignments: name -> value expression
+    globals: Dict[str, ast.expr] = field(default_factory=dict)
+    #: names whose module-scope value is a raw device/host array build
+    #: with no sharding attached (GL011's candidates)
+    unsharded_array_globals: Set[str] = field(default_factory=set)
+    #: summary of module top-level code (import-time execution)
+    toplevel: FunctionSummary = None
+
+
+def _python_module_name(label: str) -> Optional[str]:
+    """'replicatinggpt_tpu/serve/engine.py' -> 'replicatinggpt_tpu.serve.engine'."""
+    p = PurePosixPath(label)
+    if p.suffix != ".py":
+        return None
+    parts = list(p.with_suffix("").parts)
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else None
+
+
+_ARRAYISH_PREFIXES = ("jnp.", "jax.numpy.", "np.", "numpy.", "jax.random.")
+_SHARD_BLESSED = {"jax.device_put", "device_put"}
+
+
+def _is_unsharded_array_build(value: ast.expr) -> bool:
+    """Module-scope value that builds an array with no sharding attached
+    (a ``device_put`` with an explicit sharding argument is blessed)."""
+    if not isinstance(value, ast.Call):
+        return False
+    f = dotted(value.func)
+    if f is None:
+        return False
+    if f in _SHARD_BLESSED:
+        return len(value.args) + len(value.keywords) < 2
+    return any(f.startswith(p) for p in _ARRAYISH_PREFIXES)
+
+
+class _FnScanner(ast.NodeVisitor):
+    """Single linear walk of one function body building its summary.
+    Nested function defs are skipped (they get no summary; a captured
+    closure is opaque to resolution anyway)."""
+
+    def __init__(self, summary: FunctionSummary,
+                 suppressed=lambda line, rule: False):
+        self.s = summary
+        self.suppressed = suppressed
+        self.loop_depth = 0
+        self.if_depth_in_loop = 0
+        self.cond_depth = 0            # `if` nesting anywhere in the body
+        self.loop_vars: List[Set[str]] = []
+
+    def _collect_store_names(self, target: ast.AST) -> Set[str]:
+        return {n.id for n in ast.walk(target)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+
+    # -- structure ---------------------------------------------------------
+
+    def visit_FunctionDef(self, node):      # nested def: opaque
+        self.s.local_names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _visit_loop(self, children, targets: Set[str]):
+        self.loop_depth += 1
+        saved_if = self.if_depth_in_loop
+        self.if_depth_in_loop = 0
+        self.loop_vars.append(targets)
+        for child in children:
+            self.visit(child)
+        self.loop_vars.pop()
+        self.if_depth_in_loop = saved_if
+        self.loop_depth -= 1
+
+    def visit_For(self, node):
+        # the iterator expression evaluates ONCE, before the loop — it
+        # is visited at the enclosing depth, not as loop-body work
+        self.visit(node.iter)
+        tgt = self._collect_store_names(node.target)
+        self.s.local_names |= tgt
+        self._visit_loop((node.target, *node.body, *node.orelse), tgt)
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node):
+        # the test IS re-evaluated per iteration: it belongs to the loop
+        self._visit_loop((node.test, *node.body, *node.orelse), set())
+
+    def visit_If(self, node):
+        self.visit(node.test)
+        self.cond_depth += 1
+        if self.loop_depth > 0:
+            self.if_depth_in_loop += 1
+        for child in (*node.body, *node.orelse):
+            self.visit(child)
+        if self.loop_depth > 0:
+            self.if_depth_in_loop -= 1
+        self.cond_depth -= 1
+
+    # -- bindings ----------------------------------------------------------
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        for t in node.targets:
+            self.s.local_names |= self._collect_store_names(t)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+        self.s.local_names |= self._collect_store_names(node.target)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        self.s.local_names |= self._collect_store_names(node.target)
+
+    def visit_NamedExpr(self, node):
+        self.visit(node.value)
+        self.s.local_names |= self._collect_store_names(node.target)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.s.local_names.add((a.asname or a.name).split(".")[0])
+
+    visit_ImportFrom = visit_Import
+
+    def visit_comprehension(self, node):
+        self.s.local_names |= self._collect_store_names(node.target)
+        self.generic_visit(node)
+
+    def visit_Return(self, node):
+        if isinstance(node.value, ast.Name):
+            self.s.returns_params.add(node.value.id)
+        elif isinstance(node.value, ast.Tuple):
+            for e in node.value.elts:
+                if isinstance(e, ast.Name):
+                    self.s.returns_params.add(e.id)
+        if node.value is not None:
+            self.visit(node.value)
+
+    # -- reads & calls -----------------------------------------------------
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.s.free_reads.add(node.id)    # filtered against locals later
+
+    def visit_Call(self, node):
+        line = getattr(node, "lineno", 0)
+        kind = sync_call_kind(node)
+        # a sync under a conditional is treated as intentional (cadence,
+        # rank-0, debug) — the same exemption the loop-side guard check
+        # applies — so it must not propagate through the call graph either
+        if kind in PROPAGATING_SYNCS and self.cond_depth == 0 \
+                and not self.suppressed(line, "GL004"):
+            self.s.sync_sites.append((node, kind))
+        dev = device_call_kind(node)
+        if dev is not None and not self.suppressed(line, "GL002"):
+            self.s.device_sites.append((node, dev))
+        enclosing = set().union(*self.loop_vars) if self.loop_vars else set()
+        self.s.calls.append(CallSite(
+            node=node, func_expr=node.func, loop_depth=self.loop_depth,
+            guarded=self.if_depth_in_loop > 0, loop_vars=enclosing))
+        # shape-building positions: names feeding them
+        self._note_shape_args(node)
+        self.generic_visit(node)
+
+    def _note_shape_args(self, node: ast.Call):
+        f = dotted(node.func)
+        shape_exprs: List[ast.expr] = []
+        if f in _SHAPE_BUILDERS:
+            if node.args:
+                shape_exprs.append(node.args[0])
+            for kw in node.keywords:
+                if kw.arg in ("shape", "num", "N", "M"):
+                    shape_exprs.append(kw.value)
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _SHAPE_METHODS):
+            shape_exprs.extend(node.args)
+        elif f in ("jnp.broadcast_to", "jax.numpy.broadcast_to") \
+                and len(node.args) >= 2:
+            shape_exprs.append(node.args[1])
+        for e in shape_exprs:
+            for n in ast.walk(e):
+                if isinstance(n, ast.Name):
+                    self.s.shape_params.add(n.id)  # intersected with params
+
+
+def _summarize_function(label: str, qual: str, fn: ast.FunctionDef,
+                        suppressed) -> FunctionSummary:
+    s = FunctionSummary(label=label, name=qual, node=fn)
+    s.params = param_names(fn)
+    s.local_names |= set(s.params)
+    dec = None
+    for d in fn.decorator_list:
+        if is_jit_wrapper(d):
+            dec = d
+            break
+    if dec is not None:
+        s.jitted = True
+        kw = jit_kwargs(dec)
+        _apply_jit_kwargs(s, kw)
+    sc = _FnScanner(s, suppressed)
+    for d in fn.decorator_list:
+        sc.visit(d)
+    for stmt in fn.body:
+        sc.visit(stmt)
+    s.free_reads -= s.local_names
+    s.shape_params = (s.shape_params & set(s.params)) | s.static_params
+    s.returns_params &= set(s.params)
+    return s
+
+
+def _apply_jit_kwargs(s: FunctionSummary, kw: Dict[str, ast.expr]) -> None:
+    s.static_params |= set(const_str_items(kw.get("static_argnames")))
+    for i in const_int_items(kw.get("static_argnums")):
+        if 0 <= i < len(s.params):
+            s.static_params.add(s.params[i])
+    s.donated_params |= set(const_str_items(kw.get("donate_argnames")))
+    for i in const_int_items(kw.get("donate_argnums")):
+        if 0 <= i < len(s.params):
+            s.donated_params.add(s.params[i])
+    if "in_shardings" in kw or "out_shardings" in kw:
+        s.shard_annotated = True
+
+
+def _is_main_guard(stmt: ast.stmt) -> bool:
+    """``if __name__ == "__main__":`` — runs as a script, not at import."""
+    if not isinstance(stmt, ast.If) or not isinstance(stmt.test, ast.Compare):
+        return False
+    names = {n.id for n in ast.walk(stmt.test) if isinstance(n, ast.Name)}
+    return "__name__" in names
+
+
+def _summarize_toplevel(label: str, tree: ast.Module,
+                        suppressed) -> FunctionSummary:
+    """Module top-level code as a pseudo-function (import-time loops and
+    calls; function/class bodies excluded, their decorators/defaults
+    included — mirroring GL002's import-time evaluation model)."""
+    s = FunctionSummary(label=label, name="<module>")
+    sc = _FnScanner(s, suppressed)
+    for stmt in tree.body:
+        if _is_main_guard(stmt):
+            continue                      # script entry, not import time
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in stmt.decorator_list:
+                sc.visit(d)
+            for default in (*stmt.args.defaults,
+                            *[d for d in stmt.args.kw_defaults if d]):
+                sc.visit(default)
+        elif isinstance(stmt, ast.ClassDef):
+            for d in stmt.decorator_list:
+                sc.visit(d)
+        else:
+            sc.visit(stmt)
+    s.free_reads -= s.local_names
+    return s
+
+
+# --------------------------------------------------------------------------
+# the index
+# --------------------------------------------------------------------------
+
+
+class ProjectIndex:
+    """Everything dataflow.py needs: modules by label, functions by
+    qname, call resolution, and memoized transitive reachability."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self._by_pyname: Dict[str, str] = {}      # python module -> label
+        self._sync_memo: Dict[str, Optional[List[str]]] = {}
+        self._dev_memo: Dict[str, Optional[List[str]]] = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, files: Sequence[Tuple[str, ast.Module, Sequence[str]]],
+              all_rule_ids: Sequence[str] = ()) -> "ProjectIndex":
+        """``files`` is (label, parsed tree, source lines) triples."""
+        idx = cls()
+        for label, tree, lines in files:
+            per_line, per_file = parse_pragmas(lines, all_rule_ids)
+
+            def suppressed(line, rule, _pl=per_line, _pf=per_file):
+                return rule in _pf or rule in _pl.get(line, set())
+
+            mod = ModuleInfo(label=label, tree=tree, lines=lines)
+            pyname = _python_module_name(label)
+            if pyname:
+                idx._by_pyname[pyname] = label
+            for stmt in tree.body:
+                idx._index_stmt(mod, stmt, suppressed)
+            mod.toplevel = _summarize_toplevel(label, tree, suppressed)
+            idx.modules[label] = mod
+        return idx
+
+    def _index_stmt(self, mod: ModuleInfo, stmt: ast.stmt, suppressed):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.functions[stmt.name] = _summarize_function(
+                mod.label, stmt.name, stmt, suppressed)
+        elif isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{stmt.name}.{sub.name}"
+                    mod.functions[qual] = _summarize_function(
+                        mod.label, qual, sub, suppressed)
+        elif isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                mod.imports[a.asname or a.name.split(".")[0]] = \
+                    ImportBinding(module=a.name if a.asname
+                                  else a.name.split(".")[0])
+        elif isinstance(stmt, ast.ImportFrom):
+            base = self._from_base(mod.label, stmt)
+            if base is None:
+                return
+            for a in stmt.names:
+                if a.name == "*":
+                    continue
+                mod.imports[a.asname or a.name] = ImportBinding(
+                    module=base, symbol=a.name)
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            mod.globals[name] = stmt.value
+            if _is_unsharded_array_build(stmt.value):
+                mod.unsharded_array_globals.add(name)
+
+    @staticmethod
+    def _from_base(label: str, stmt: ast.ImportFrom) -> Optional[str]:
+        """Python module name an ImportFrom pulls from, resolving
+        relative imports against the importing file's package path."""
+        if stmt.level == 0:
+            return stmt.module
+        parts = list(PurePosixPath(label).parts[:-1])  # package dir
+        up = stmt.level - 1
+        if up > len(parts):
+            return None
+        base_parts = parts[:len(parts) - up] if up else parts
+        if stmt.module:
+            base_parts = base_parts + stmt.module.split(".")
+        return ".".join(base_parts) if base_parts else None
+
+    # -- lookup ------------------------------------------------------------
+
+    def module_for(self, pyname: str) -> Optional[ModuleInfo]:
+        label = self._by_pyname.get(pyname)
+        return self.modules.get(label) if label else None
+
+    def _lookup_symbol(self, pyname: str, symbol: str,
+                       depth: int = 0) -> Optional[FunctionSummary]:
+        """Find ``symbol`` in module ``pyname``, following re-export
+        chains (``from .engine import step`` in an ``__init__``)."""
+        if depth > 4:
+            return None
+        mod = self.module_for(pyname)
+        if mod is None:
+            return None
+        if symbol in mod.functions:
+            return mod.functions[symbol]
+        b = mod.imports.get(symbol)
+        if b is not None:
+            if b.symbol is None:
+                return None                   # a module, not a function
+            return self._lookup_symbol(b.module, b.symbol, depth + 1)
+        return None
+
+    def resolve_call(self, mod: ModuleInfo,
+                     caller: Optional[FunctionSummary],
+                     func_expr: ast.expr) -> Optional[FunctionSummary]:
+        """Resolve a callee expression to a summarized project function,
+        or None when the target is ambiguous/external."""
+        # plain name: local module function or imported symbol, unless
+        # the caller rebinds the name locally
+        if isinstance(func_expr, ast.Name):
+            name = func_expr.id
+            # module top-level "locals" ARE the module's defs/imports —
+            # only an actual module-scope assignment shadows there;
+            # inside a function any local binding (param, assign, local
+            # import) makes the name opaque
+            if (caller is not None and caller.name != "<module>"
+                    and name in caller.local_names):
+                return None
+            if name in mod.globals:           # rebound at module scope
+                return None
+            if name in mod.functions:
+                return mod.functions[name]
+            b = mod.imports.get(name)
+            if b is not None and b.symbol is not None:
+                return self._lookup_symbol(b.module, b.symbol)
+            return None
+        if not isinstance(func_expr, ast.Attribute):
+            return None
+        # self.method() inside a class
+        if (isinstance(func_expr.value, ast.Name)
+                and func_expr.value.id in ("self", "cls")
+                and caller is not None and "." in caller.name):
+            cls_name = caller.name.split(".", 1)[0]
+            return mod.functions.get(f"{cls_name}.{func_expr.attr}")
+        # module_alias.func() through an imported module
+        d = dotted(func_expr.value)
+        if d is None:
+            return None
+        head = d.split(".")[0]
+        if caller is not None and head in caller.local_names:
+            return None
+        b = mod.imports.get(head)
+        if b is None or b.symbol is not None:
+            # unknown object, or attribute access on an imported symbol
+            # (a method on an instance we can't type) — don't guess
+            return None
+        tail = d.split(".")[1:]
+        pyname = ".".join([b.module] + tail) if tail else b.module
+        return self._lookup_symbol(pyname, func_expr.attr)
+
+    # -- transitive properties --------------------------------------------
+
+    def _transitive(self, s: FunctionSummary, direct_attr: str,
+                    memo: Dict[str, Optional[List[str]]],
+                    depth: int, stack: Set[str],
+                    ) -> Tuple[Optional[List[str]], bool]:
+        """(chain of qnames from ``s`` to a function with a direct site
+        of the given kind, search-was-exhaustive). Depth-limited; cycles
+        break via the visiting stack. A negative result is only
+        MEMOIZED when the search was exhaustive — a None produced by
+        depth/cycle truncation must not poison later, shallower queries
+        (results would depend on query order)."""
+        if s.qname in memo:
+            return memo[s.qname], True
+        direct = getattr(s, direct_attr)
+        if direct:
+            memo[s.qname] = [s.qname]
+            return memo[s.qname], True
+        if depth >= 4 or s.qname in stack:
+            return None, False
+        stack = stack | {s.qname}
+        mod = self.modules.get(s.label)
+        if mod is None:
+            memo[s.qname] = None
+            return None, True
+        complete = True
+        for site in s.calls:
+            callee = self.resolve_call(mod, s, site.func_expr)
+            if callee is None:
+                continue
+            if direct_attr == "sync_sites" and callee.jitted:
+                continue                      # a jitted body can't host-sync
+            sub, sub_complete = self._transitive(callee, direct_attr, memo,
+                                                 depth + 1, stack)
+            if sub is not None:
+                memo[s.qname] = [s.qname] + sub
+                return memo[s.qname], True
+            complete = complete and sub_complete
+        if complete:
+            memo[s.qname] = None
+        return None, complete
+
+    def sync_chain(self, s: FunctionSummary) -> Optional[List[str]]:
+        """qname chain to a host-sync site reachable from ``s``'s body
+        (s itself first), or None. A pragma at the sync site stops the
+        chain at the source."""
+        return self._transitive(s, "sync_sites", self._sync_memo,
+                                0, set())[0]
+
+    def device_chain(self, s: FunctionSummary) -> Optional[List[str]]:
+        return self._transitive(s, "device_sites", self._dev_memo,
+                                0, set())[0]
+
+    def sync_site_of(self, qname: str) -> Optional[Tuple[str, int, str]]:
+        """(label, line, kind) of the first direct sync site of a
+        summarized function, for chain-naming messages."""
+        label, name = qname.split("::", 1)
+        mod = self.modules.get(label)
+        fn = (mod.functions.get(name) if mod and name != "<module>"
+              else (mod.toplevel if mod else None))
+        if fn and fn.sync_sites:
+            node, kind = fn.sync_sites[0]
+            return (label, getattr(node, "lineno", 0), kind)
+        return None

@@ -1,45 +1,73 @@
 """The ``lint`` subcommand (wired into replicatinggpt_tpu.cli).
 
 Fast and CPU-only by construction — the analysis package never imports
-jax — so it runs as a tier-1 gate. Default invocation lints the
-package against the committed baseline (exit 1 on any NEW finding);
-``--write-baseline`` refreshes the committed file after a reviewed
-change; ``--docs`` regenerates the rule reference.
+jax — so it runs as a tier-1 gate. Default invocation lints the whole
+project (package + bench.py + tools/ + tests/) against the committed
+baseline (exit 1 on any NEW error finding; tests/ findings are
+warnings and never gate); ``--write-baseline`` refreshes the committed
+file through the ratchet (it refuses to grow the baseline);
+``--changed <git-ref>`` restricts *reporting* to files that differ
+from the ref while still indexing the whole project, so
+interprocedural findings in changed files keep their cross-file
+context; ``--format sarif`` emits SARIF 2.1.0 for CI annotation;
+``--docs`` regenerates the rule reference.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
-from typing import List
+from typing import Dict, List, Optional, Sequence, Set
 
-from .baseline import (DEFAULT_BASELINE, diff_against_baseline,
-                       load_baseline, write_baseline)
+from .baseline import (DEFAULT_BASELINE, RatchetViolation, check_ratchet,
+                       diff_against_baseline, load_baseline, write_baseline)
 from .docgen import render_rule_docs
-from .linter import lint_paths
+from .linter import DEFAULT_SEVERITY, REPO_ROOT, lint_paths, rel_label
 from .rules import RULES, Finding
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def add_lint_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("paths", nargs="*", default=[],
-                   help="files/dirs to lint (default: the "
-                        "replicatinggpt_tpu package)")
+                   help="files/dirs to lint (default: the package plus "
+                        "bench.py, tools/ and tests/)")
     p.add_argument("--baseline", nargs="?", const=str(DEFAULT_BASELINE),
                    default=None, metavar="PATH",
                    help="compare against a committed baseline; fail only "
                         "on NEW findings (default path: "
                         "graftlint_baseline.json; auto-applied for a "
-                        "bare package lint when the file exists)")
+                        "bare project lint when the file exists)")
     p.add_argument("--no-baseline", action="store_true",
                    help="report every finding even when the committed "
                         "baseline exists")
     p.add_argument("--write-baseline", action="store_true",
-                   help="write the current findings as the new baseline")
+                   help="write the current findings as the new baseline "
+                        "(deduped, sorted, and RATCHETED: refuses to add "
+                        "entries the committed baseline doesn't have)")
+    p.add_argument("--allow-growth", action="store_true",
+                   help="override the ratchet for an explicitly reviewed "
+                        "baseline expansion")
+    p.add_argument("--changed", metavar="GIT_REF", default=None,
+                   help="diff-aware mode: report only findings in files "
+                        "that differ from GIT_REF (plus untracked files); "
+                        "the whole project is still indexed so cross-file "
+                        "dataflow stays sound")
+    p.add_argument("--severity", action="append", default=None,
+                   metavar="DIR=LEVEL",
+                   help="per-directory severity tier, e.g. "
+                        "'tests/=warning' (repeatable; default: "
+                        "tests/=warning). LEVEL is error|warning; "
+                        "warnings are reported but never fail the gate "
+                        "or enter the baseline")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule ids to run (default: all)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     p.add_argument("--docs", action="store_true",
@@ -51,6 +79,80 @@ def _print_findings(findings: List[Finding], stream=None) -> None:
     stream = stream or sys.stdout
     for f in findings:
         print(f.format(), file=stream)
+
+
+def _parse_severity(args) -> Optional[Dict[str, str]]:
+    if not args.severity:
+        return None                      # the linter default (tests/=warning)
+    out = dict(DEFAULT_SEVERITY)
+    for spec in args.severity:
+        if "=" not in spec:
+            raise SystemExit(f"bad --severity {spec!r} (want DIR=LEVEL)")
+        prefix, level = spec.split("=", 1)
+        if level not in ("error", "warning"):
+            raise SystemExit(f"bad --severity level {level!r}")
+        out[prefix] = level
+    return out
+
+
+def changed_files(ref: str) -> Set[str]:
+    """Repo-relative labels of .py files differing from ``ref`` in the
+    working tree, plus untracked ones."""
+    out: Set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "--diff-filter=d", ref],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(cmd, cwd=REPO_ROOT, capture_output=True,
+                              text=True, timeout=60)
+        if proc.returncode != 0:
+            raise SystemExit(f"--changed: `{' '.join(cmd)}` failed: "
+                             f"{proc.stderr.strip()}")
+        out |= {line.strip() for line in proc.stdout.splitlines()
+                if line.strip().endswith(".py")}
+    return out
+
+
+def render_sarif(findings: Sequence[Finding],
+                 warnings: Sequence[Finding]) -> dict:
+    """SARIF 2.1.0 payload: one run, the full rule table on the driver,
+    one result per finding with severity mapped to SARIF level."""
+    rule_ids = sorted(RULES)
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for f in (*findings, *warnings):
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": rule_index.get(f.rule, -1),
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "docs/graftlint_rules.md",
+                "rules": [{
+                    "id": rid,
+                    "name": RULES[rid].name,
+                    "shortDescription": {"text": RULES[rid].name},
+                    "fullDescription": {"text": RULES[rid].rationale},
+                } for rid in rule_ids],
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": REPO_ROOT.as_uri()
+                                               + "/"}},
+            "results": results,
+        }],
+    }
 
 
 def run_lint(args) -> int:
@@ -67,12 +169,34 @@ def run_lint(args) -> int:
         if r not in RULES:
             print(f"unknown rule {r!r} (see --list-rules)", file=sys.stderr)
             return 2
-    res = lint_paths(args.paths, rule_ids)
+    if args.write_baseline:
+        # the committed baseline is a whole-project contract: writing it
+        # from a diff-filtered or path-restricted view would silently
+        # DROP every entry outside the view (and the ratchet would
+        # pass, because the key set only shrank)
+        if args.changed is not None:
+            print("--write-baseline needs the full project view; "
+                  "drop --changed", file=sys.stderr)
+            return 2
+        target = Path(args.baseline or DEFAULT_BASELINE).resolve()
+        if args.paths and target == DEFAULT_BASELINE.resolve():
+            print("--write-baseline of the committed baseline needs the "
+                  "full project view; drop the path arguments (an "
+                  "explicit --baseline PATH elsewhere may scope freely)",
+                  file=sys.stderr)
+            return 2
+    res = lint_paths(args.paths, rule_ids, severity=_parse_severity(args))
+
+    findings, warnings = res.findings, res.warnings
+    if args.changed is not None:
+        scope = changed_files(args.changed)
+        findings = [f for f in findings if f.path in scope]
+        warnings = [f for f in warnings if f.path in scope]
 
     baseline_path = args.baseline
     if (baseline_path is None and not args.no_baseline and not args.paths
             and not args.write_baseline and DEFAULT_BASELINE.exists()):
-        # bare `lint` over the package: the committed baseline is the
+        # bare `lint` over the project: the committed baseline is the
         # contract (the acceptance criterion's "runs clean" mode)
         baseline_path = str(DEFAULT_BASELINE)
     if args.no_baseline:
@@ -80,25 +204,37 @@ def run_lint(args) -> int:
 
     if args.write_baseline:
         out = Path(args.baseline or DEFAULT_BASELINE)
-        write_baseline(res.findings, out)
-        print(f"wrote {len(res.findings)} finding(s) to {out}")
+        if not args.allow_growth:
+            grown = check_ratchet(findings, out)
+            if grown:
+                print(RatchetViolation(grown).format(), file=sys.stderr)
+                return 2
+        n = write_baseline(findings, out)
+        print(f"wrote {n} entr{'y' if n == 1 else 'ies'} "
+              f"({len(findings)} finding(s)) to {out}")
         return 0
 
     if baseline_path is None:
         if args.format == "json":
             print(json.dumps({
                 "files": res.files,
-                "findings": [vars(f) for f in res.findings],
+                "findings": [vars(f) for f in findings],
+                "warnings": [vars(f) for f in warnings],
                 "suppressed": [vars(f) for f in res.suppressed],
             }))
+        elif args.format == "sarif":
+            print(json.dumps(render_sarif(findings, warnings)))
         else:
-            _print_findings(res.findings)
-            print(f"graftlint: {len(res.findings)} finding(s), "
+            _print_findings(findings)
+            _print_findings(warnings)
+            print(f"graftlint: {len(findings)} finding(s), "
+                  f"{len(warnings)} warning(s), "
                   f"{len(res.suppressed)} suppressed, {res.files} file(s)",
                   file=sys.stderr)
-        return 1 if res.findings else 0
+        return 1 if findings else 0
 
-    diff = diff_against_baseline(res.findings, load_baseline(baseline_path))
+    diff = diff_against_baseline(findings, load_baseline(baseline_path))
+    stale = [] if args.changed is not None else diff.stale
     if args.format == "json":
         # the diffed view IS the result under a baseline: `findings`
         # holds only NEW hazards (matching the exit code); baselined
@@ -106,18 +242,22 @@ def run_lint(args) -> int:
         print(json.dumps({
             "files": res.files,
             "findings": [vars(f) for f in diff.new],
+            "warnings": [vars(f) for f in warnings],
             "baselined": diff.matched,
-            "stale": [list(k) for k in diff.stale],
+            "stale": [list(k) for k in stale],
             "suppressed": [vars(f) for f in res.suppressed],
         }))
+    elif args.format == "sarif":
+        print(json.dumps(render_sarif(diff.new, warnings)))
     else:
         _print_findings(diff.new)
-        for key in diff.stale:
+        for key in stale:
             print(f"stale baseline entry (finding fixed? refresh with "
                   f"--write-baseline): {key[0]}: {key[1]}: {key[2]}",
                   file=sys.stderr)
         print(f"graftlint: {len(diff.new)} new finding(s), "
-              f"{diff.matched} baselined, {len(diff.stale)} stale, "
+              f"{diff.matched} baselined, {len(stale)} stale, "
+              f"{len(warnings)} warning(s), "
               f"{len(res.suppressed)} suppressed, {res.files} file(s)",
               file=sys.stderr)
     return 1 if diff.new else 0

@@ -1,0 +1,809 @@
+"""Interprocedural rule passes over the project call graph (graftlint v2).
+
+Two rule families live here, both consuming :class:`callgraph.ProjectIndex`:
+
+1. **Interprocedural upgrades** of the per-file syntactic rules —
+   GL004 fires when the host sync hides in a helper called (possibly
+   through two more helpers, possibly in another file) from inside a
+   loop; GL002 when a module-scope call reaches a device computation
+   through a re-exported wrapper; GL005 when a donated buffer is read
+   after the jitted call, including through a local alias.
+
+2. **The mesh/sharding family GL010–GL014** — PartitionSpec axes vs the
+   constructing mesh, unsharded module-array capture under annotated
+   programs, ``in_shardings``/``in_specs`` arity vs the wrapped
+   function, per-iteration Python scalars flowing into shape/static
+   positions of jitted calls, and donation of a buffer the jitted body
+   also captures as a closure constant.
+
+Conservatism contract (same as callgraph.py): every check here only
+fires on *resolved* facts — an unresolvable callee, a mesh with
+non-constant axis names, or a spec behind an opaque variable simply
+doesn't participate. Calls under ANY conditional inside the loop are
+exempt from the interprocedural GL004: a conditioned sync is almost
+always intentional (eval cadence ``if step % k == 0:``, rank-0 logging,
+debug dumps), and distinguishing those from a data-dependent
+per-iteration stall is beyond a syntactic guard test — the rule trades
+that recall for zero false positives on the standard logging patterns.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import (CallSite, FunctionSummary, ModuleInfo, ProjectIndex,
+                        const_int_items, const_str_items, dotted,
+                        jit_kwargs, jit_wrap_call)
+from .rules import Finding
+
+
+def _line_of(node: ast.AST, lines: Sequence[str]) -> str:
+    i = getattr(node, "lineno", 1) - 1
+    return lines[i].strip() if 0 <= i < len(lines) else ""
+
+
+def _finding(rule_id: str, node: ast.AST, message: str, mod: ModuleInfo,
+             ) -> Finding:
+    return Finding(path=mod.label, rule=rule_id,
+                   line=getattr(node, "lineno", 1),
+                   col=getattr(node, "col_offset", 0), message=message,
+                   text=_line_of(node, mod.lines))
+
+
+def _display(qname: str) -> str:
+    label, name = qname.split("::", 1)
+    return name if name != "<module>" else label
+
+
+def _map_args(call: ast.Call, callee: FunctionSummary) -> Dict[str, ast.expr]:
+    """param name -> argument expression for a plain-function call
+    (methods and *args/**kwargs splats give up on the splatted part)."""
+    out: Dict[str, ast.expr] = {}
+    params = callee.params
+    if "." in callee.name and params and params[0] in ("self", "cls"):
+        params = params[1:]
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            break
+        if i < len(params):
+            out[params[i]] = a
+    for kw in call.keywords:
+        if kw.arg:
+            out[kw.arg] = kw.value
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL004 — host sync reached through helpers called from a loop
+# --------------------------------------------------------------------------
+
+
+def check_sync_through_helpers(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        for fn in (*mod.functions.values(), mod.toplevel):
+            for site in fn.calls:
+                if site.loop_depth <= 0 or site.guarded:
+                    continue
+                callee = idx.resolve_call(mod, fn, site.func_expr)
+                if callee is None or callee.jitted:
+                    continue
+                chain = idx.sync_chain(callee)
+                if chain is None:
+                    continue
+                src = idx.sync_site_of(chain[-1])
+                where = (f"`{src[2]}` at {src[0]}:{src[1]}" if src
+                         else "a device->host sync")
+                via = " -> ".join(_display(q) for q in chain)
+                findings.append(_finding(
+                    "GL004", site.node,
+                    f"call to `{_display(chain[0])}` inside a loop reaches "
+                    f"{where} (via {via}) — one device->host stall per "
+                    f"iteration, just hidden behind the call; accumulate "
+                    f"on device and sync once after the loop",
+                    mod))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# GL002 — import-time device work through re-exported wrappers
+# --------------------------------------------------------------------------
+
+
+def check_device_call_at_import(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        fn = mod.toplevel
+        for site in fn.calls:
+            callee = idx.resolve_call(mod, fn, site.func_expr)
+            if callee is None or callee.jitted:
+                continue
+            chain = idx.device_chain(callee)
+            if chain is None:
+                continue
+            via = " -> ".join(_display(q) for q in chain)
+            findings.append(_finding(
+                "GL002", site.node,
+                f"module-scope call to `{_display(chain[0])}` runs device "
+                f"computation at import time (via {via}) — same hazard as "
+                f"a bare module-scope jnp call, one wrapper deep; build "
+                f"lazily or inside the jitted fn",
+                mod))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# GL005 — donated buffer read after the jitted call (alias-aware)
+# --------------------------------------------------------------------------
+
+
+class _DonationScanner:
+    """Linear source-order walk of one function: track names donated
+    into jitted calls (plus trivial ``alias = name`` aliases) and flag
+    loads of them in later statements. Rebinding clears. `if`/`else`
+    branches walk from the same pre-branch state (mutually exclusive)."""
+
+    def __init__(self, idx: ProjectIndex, mod: ModuleInfo,
+                 fn: FunctionSummary):
+        self.idx, self.mod, self.fn = idx, mod, fn
+        self.aliases: Dict[str, str] = {}       # alias -> root name
+        #: root name -> (call, callee display, param, callee-returns-it)
+        self.donated: Dict[str, Tuple[ast.Call, str, str, bool]] = {}
+        self.findings: List[Finding] = []
+        self.flagged: Set[Tuple[int, str]] = set()
+
+    def run(self) -> List[Finding]:
+        if self.fn.node is None:
+            return []
+        for stmt in self.fn.node.body:
+            self._stmt(stmt)
+        return self.findings
+
+    def _root(self, name: str) -> str:
+        seen = set()
+        while name in self.aliases and name not in seen:
+            seen.add(name)
+            name = self.aliases[name]
+        return name
+
+    def _check_loads(self, stmt: ast.stmt, skip: Set[int]) -> None:
+        if not self.donated:
+            return
+        for node in ast.walk(stmt):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                root = self._root(node.id)
+                rec = self.donated.get(root)
+                if rec is None:
+                    continue
+                call, callee, param, returned = rec
+                key = (node.lineno, node.id)
+                if key in self.flagged:
+                    continue
+                self.flagged.add(key)
+                hint = (f"`{callee}` returns `{param}`'s successor — "
+                        f"read the value the call returned"
+                        if returned else "use the returned value instead")
+                self.findings.append(_finding(
+                    "GL005", node,
+                    f"`{node.id}` was donated to jitted `{callee}` (param "
+                    f"`{param}`, line {call.lineno}) and is read again "
+                    f"here — donated buffers are deallocated/aliased by "
+                    f"XLA, so this read sees freed or overwritten memory; "
+                    f"{hint}",
+                    self.mod))
+
+    def _register_donations(self, stmt: ast.stmt) -> None:
+        """Record donations made by calls inside ``stmt`` (loads in the
+        same statement were already checked, with donating-call
+        arguments excluded, by _check_loads_excluding_call_args)."""
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.idx.resolve_call(self.mod, self.fn, node.func)
+            if callee is None or not callee.donated_params:
+                continue
+            for param, arg in _map_args(node, callee).items():
+                if param in callee.donated_params \
+                        and isinstance(arg, ast.Name):
+                    self.donated[self._root(arg.id)] = (
+                        node, _display(callee.qname), param,
+                        param in callee.returns_params)
+
+    def _rebind(self, target: ast.AST) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                self.donated.pop(n.id, None)
+                self.aliases.pop(n.id, None)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.If):
+            saved_d, saved_a = dict(self.donated), dict(self.aliases)
+            self._expr_stmtlike(stmt.test)
+            for s in stmt.body:
+                self._stmt(s)
+            after_body = self.donated
+            self.donated = dict(saved_d)
+            self.aliases = dict(saved_a)
+            for s in stmt.orelse:
+                self._stmt(s)
+            # a branch that cannot fall through contributes nothing to
+            # the statements after the If — in either direction
+            terminal = (ast.Return, ast.Raise, ast.Continue, ast.Break)
+            body_term = stmt.body and isinstance(stmt.body[-1], terminal)
+            else_term = stmt.orelse and isinstance(stmt.orelse[-1],
+                                                   terminal)
+            if else_term:
+                self.donated = after_body
+            elif not body_term:
+                self.donated.update(after_body)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr_stmtlike(stmt.iter)
+                self._rebind(stmt.target)
+            else:
+                self._expr_stmtlike(stmt.test)
+            for s in (*stmt.body, *stmt.orelse):
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr_stmtlike(item.context_expr)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in (*stmt.body, *stmt.orelse, *stmt.finalbody):
+                self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            return
+        # leaf statement: loads first (against donations from EARLIER
+        # statements), then new donations, then rebinds/aliases
+        self._check_loads_excluding_call_args(stmt)
+        if isinstance(stmt, ast.AugAssign) \
+                and isinstance(stmt.target, ast.Name):
+            # `state += 1` READS state before rebinding it, but the
+            # target carries Store ctx so the load walk misses it
+            loadlike = ast.copy_location(
+                ast.Name(id=stmt.target.id, ctx=ast.Load()), stmt.target)
+            self._check_loads(ast.copy_location(ast.Expr(value=loadlike),
+                                                stmt), set())
+        self._register_donations(stmt)
+        if isinstance(stmt, ast.Assign):
+            if (len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Name)):
+                # alias AFTER rebind bookkeeping: `a = state`
+                self._rebind(stmt.targets[0])
+                self.aliases[stmt.targets[0].id] = self._root(stmt.value.id)
+            else:
+                for t in stmt.targets:
+                    self._rebind(t)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+            self._rebind(stmt.target)
+
+    def _check_loads_excluding_call_args(self, stmt: ast.stmt) -> None:
+        """Loads in this statement, excluding names that only appear as
+        arguments of donating calls registered this statement (the
+        donation itself isn't a use-after-donate)."""
+        donating_arg_ids: Set[int] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                callee = self.idx.resolve_call(self.mod, self.fn, node.func)
+                if callee is not None and callee.donated_params:
+                    for a in (*node.args,
+                              *(kw.value for kw in node.keywords)):
+                        for x in ast.walk(a):
+                            donating_arg_ids.add(id(x))
+        self._check_loads(stmt, donating_arg_ids)
+
+    def _expr_stmtlike(self, expr: ast.expr) -> None:
+        wrapper = ast.Expr(value=expr)
+        ast.copy_location(wrapper, expr)
+        self._check_loads_excluding_call_args(wrapper)
+        self._register_donations(wrapper)
+
+
+def check_use_after_donate(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        for fn in mod.functions.values():
+            findings.extend(_DonationScanner(idx, mod, fn).run())
+    return findings
+
+
+# --------------------------------------------------------------------------
+# GL010 — PartitionSpec axis names vs the constructing mesh
+# --------------------------------------------------------------------------
+
+_MESH_CTORS = {"Mesh", "jax.sharding.Mesh", "sharding.Mesh",
+               "jax.make_mesh", "make_mesh"}
+_SPEC_CTORS = {"P", "PartitionSpec", "jax.sharding.PartitionSpec",
+               "sharding.PartitionSpec"}
+_NAMED_SHARDING = {"NamedSharding", "jax.sharding.NamedSharding",
+                   "sharding.NamedSharding"}
+_SHARD_MAP = {"shard_map", "jax.experimental.shard_map.shard_map",
+              "shard_map.shard_map"}
+
+
+def _mesh_axes(call: ast.Call) -> Optional[List[str]]:
+    """Constant axis names of a Mesh/make_mesh construction, or None
+    when they aren't statically known."""
+    axis_expr = None
+    if len(call.args) >= 2:
+        axis_expr = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "axis_names":
+            axis_expr = kw.value
+    if axis_expr is None:
+        return None
+    axes = const_str_items(axis_expr)
+    if isinstance(axis_expr, ast.Constant) and isinstance(axis_expr.value,
+                                                          str):
+        return [axis_expr.value]
+    if isinstance(axis_expr, (ast.Tuple, ast.List)) \
+            and len(axes) == len(axis_expr.elts):
+        return axes
+    return None
+
+
+def _spec_axes(expr: ast.expr,
+               local_assigns: Dict[str, List[ast.expr]],
+               ) -> List[Tuple[str, ast.AST]]:
+    """(axis name, spec node) pairs for every PartitionSpec constant
+    axis inside ``expr``. Names bound one level away resolve through
+    ``local_assigns`` — only when bound exactly once (flow-insensitive:
+    a rebound spec name is ambiguous and yields nothing)."""
+    if isinstance(expr, ast.Name) and len(local_assigns.get(expr.id,
+                                                            ())) == 1:
+        expr = local_assigns[expr.id][0]
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(expr):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func) in _SPEC_CTORS):
+            continue
+        for a in node.args:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                out.append((a.value, node))
+            elif isinstance(a, (ast.Tuple, ast.List)):
+                for e in a.elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, str):
+                        out.append((e.value, node))
+    return out
+
+
+def _walk_scope(body: Sequence[ast.stmt]):
+    """ast.walk over statements, PRUNING nested function/lambda bodies
+    (ast.walk has no pruning, so a bare `continue` on a FunctionDef
+    still yields its whole subtree — inner scopes would leak out)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue                       # own scope — roots included
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scoped_assigns(body: Sequence[ast.stmt]) -> Dict[str,
+                                                      List[ast.expr]]:
+    """name -> EVERY value it is simple-assigned in this scope. The
+    analysis is flow-insensitive, so consumers must treat a multiply-
+    assigned name as known only when all its values agree."""
+    out: Dict[str, List[ast.expr]] = {}
+    for sub in _walk_scope(body):
+        if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)):
+            out.setdefault(sub.targets[0].id, []).append(sub.value)
+    return out
+
+
+def _agreed_meshes(assigns: Dict[str, List[ast.expr]],
+                   ) -> Dict[str, List[str]]:
+    """Flow-insensitive mesh map: a name is a known mesh only when
+    EVERY assignment to it is a Mesh construction and they all agree on
+    axes — a rebound mesh with different axes is unknown, not whichever
+    assignment happened to be collected first."""
+    out: Dict[str, List[str]] = {}
+    for name, values in assigns.items():
+        axes_seen = [(_mesh_axes(v) if isinstance(v, ast.Call)
+                      and dotted(v.func) in _MESH_CTORS else None)
+                     for v in values]
+        if axes_seen and axes_seen[0] is not None \
+                and all(a == axes_seen[0] for a in axes_seen):
+            out[name] = axes_seen[0]
+    return out
+
+
+def _check_mesh_axes_in_scope(body: Sequence[ast.stmt], mod: ModuleInfo,
+                              inherited: Dict[str, List[str]],
+                              local_bound: Set[str],
+                              findings: List[Finding],
+                              assigns: Optional[Dict[str,
+                                                     List[ast.expr]]] = None,
+                              ) -> None:
+    if assigns is None:
+        assigns = _scoped_assigns(body)
+    meshes: Dict[str, List[str]] = dict(inherited)
+    # ANY local binding of an inherited mesh name (parameter, unpacking,
+    # non-Mesh rebind) makes it a different, unknown mesh in this scope
+    for name in (local_bound | set(assigns)):
+        meshes.pop(name, None)
+    meshes.update(_agreed_meshes(assigns))
+
+    def check_spec_against(mesh_expr: ast.expr, spec_exprs):
+        if not isinstance(mesh_expr, ast.Name):
+            return
+        axes = meshes.get(mesh_expr.id)
+        if axes is None:
+            return
+        for spec_expr in spec_exprs:
+            for axis, node in _spec_axes(spec_expr, assigns):
+                if axis not in axes:
+                    findings.append(_finding(
+                        "GL010", node,
+                        f"PartitionSpec axis '{axis}' is not an axis of "
+                        f"mesh `{mesh_expr.id}` (axes: "
+                        f"{', '.join(repr(a) for a in axes)}) — GSPMD "
+                        f"treats unknown axes as replicated or raises at "
+                        f"lowering, silently dropping the intended "
+                        f"sharding",
+                        mod))
+
+    for node in _walk_scope(body):
+        if not isinstance(node, ast.Call):
+            continue
+        f = dotted(node.func)
+        if f in _NAMED_SHARDING and node.args:
+            check_spec_against(node.args[0], node.args[1:])
+        elif f in _SHARD_MAP:
+            mesh_expr = node.args[1] if len(node.args) >= 2 else None
+            spec_exprs = list(node.args[2:])
+            for kw in node.keywords:
+                if kw.arg == "mesh":
+                    mesh_expr = kw.value
+                elif kw.arg in ("in_specs", "out_specs"):
+                    spec_exprs.append(kw.value)
+            if mesh_expr is not None:
+                check_spec_against(mesh_expr, spec_exprs)
+
+
+def check_spec_mesh_mismatch(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        toplevel = [s for s in mod.tree.body
+                    if not isinstance(s, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef))]
+        # module meshes inherit into functions under the same all-
+        # assignments-agree rule the scoped check applies
+        module_assigns = _scoped_assigns(toplevel)
+        module_meshes = _agreed_meshes(module_assigns)
+        _check_mesh_axes_in_scope(toplevel, mod, {}, set(), findings,
+                                  assigns=module_assigns)
+        for fn in mod.functions.values():
+            if fn.node is None:
+                continue
+            bound = set(fn.params)
+            for n in _walk_scope(fn.node.body):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    bound.add(n.id)
+            _check_mesh_axes_in_scope(fn.node.body, mod, module_meshes,
+                                      bound, findings)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# GL011 — annotated programs capturing unsharded module arrays
+# --------------------------------------------------------------------------
+
+
+def _annotated_functions(idx: ProjectIndex,
+                         mod: ModuleInfo) -> List[FunctionSummary]:
+    """Functions whose program carries sharding annotations: jitted with
+    in_/out_shardings, or handed to shard_map/pjit by name."""
+    out = {fn.name: fn for fn in mod.functions.values()
+           if fn.shard_annotated}
+    spmdish = _SHARD_MAP | {"pjit", "jax.experimental.pjit.pjit"}
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call) and dotted(node.func) in spmdish
+                and node.args and isinstance(node.args[0], ast.Name)):
+            fn = mod.functions.get(node.args[0].id)
+            if fn is not None:
+                out[fn.name] = fn
+    return list(out.values())
+
+
+def check_unsharded_global_capture(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        if not mod.unsharded_array_globals:
+            continue
+        for fn in _annotated_functions(idx, mod):
+            hits = sorted(fn.free_reads & mod.unsharded_array_globals)
+            if not hits or fn.node is None:
+                continue
+            for name in hits:
+                node = next((n for n in ast.walk(fn.node)
+                             if isinstance(n, ast.Name) and n.id == name
+                             and isinstance(n.ctx, ast.Load)), fn.node)
+                findings.append(_finding(
+                    "GL011", node,
+                    f"sharding-annotated `{fn.name}` captures module "
+                    f"array `{name}` which has no sharding of its own — "
+                    f"the constant is baked in fully replicated on every "
+                    f"device, outside the program's sharding contract; "
+                    f"pass it as an argument with an explicit spec or "
+                    f"device_put it with a NamedSharding",
+                    mod))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# GL012 — in_shardings / in_specs arity vs the wrapped function
+# --------------------------------------------------------------------------
+
+
+def _tuple_len(expr: Optional[ast.expr]) -> Optional[int]:
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return len(expr.elts)
+    return None
+
+
+def _statics_of(fn: ast.FunctionDef,
+                kwargs: Dict[str, ast.expr]) -> Set[str]:
+    """Params declared static at this jit site — excluded from the
+    in_shardings zip (JAX strips static args from the pytree match)."""
+    static = set(const_str_items(kwargs.get("static_argnames")))
+    params = [p.arg for p in (*fn.args.posonlyargs, *fn.args.args,
+                              *fn.args.kwonlyargs)]
+    for i in const_int_items(kwargs.get("static_argnums")):
+        if 0 <= i < len(params):
+            static.add(params[i])
+    return static
+
+
+def _positional_arity(fn: ast.FunctionDef,
+                      static: Set[str]) -> Optional[Tuple[int, int]]:
+    """(required, total) DYNAMIC positional params; None when *args
+    makes any arity legal."""
+    a = fn.args
+    if a.vararg is not None:
+        return None
+    params = [p.arg for p in (*a.posonlyargs, *a.args)]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    has_default = ([False] * (len(params) - len(a.defaults))
+                   + [True] * len(a.defaults))
+    dyn = [(p, d) for p, d in zip(params, has_default) if p not in static]
+    total = len(dyn)
+    required = sum(1 for _, d in dyn if not d)
+    return required, total
+
+
+def _return_tuple_arity(fn: ast.FunctionDef) -> Optional[int]:
+    """Common length of all literal-tuple returns, else None. Nested
+    defs are pruned — their returns are not this function's."""
+    lens: Set[int] = set()
+    for node in _walk_scope(fn.body):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Tuple):
+                lens.add(len(node.value.elts))
+            else:
+                return None
+    return lens.pop() if len(lens) == 1 else None
+
+
+def _check_arity(fn_node: ast.FunctionDef, site: ast.AST,
+                 kwargs: Dict[str, ast.expr], kind_in: str, kind_out: str,
+                 mod: ModuleInfo, findings: List[Finding]) -> None:
+    n_in = _tuple_len(kwargs.get(kind_in))
+    static = _statics_of(fn_node, kwargs) if kind_in == "in_shardings" \
+        else set()
+    arity = _positional_arity(fn_node, static)
+    if n_in is not None and arity is not None:
+        required, total = arity
+        if n_in > total or n_in < required:
+            findings.append(_finding(
+                "GL012", site,
+                f"{kind_in} has {n_in} entr{'y' if n_in == 1 else 'ies'} "
+                f"but `{fn_node.name}` takes "
+                f"{total if required == total else f'{required}-{total}'} "
+                f"dynamic positional argument(s) — the spec-to-argument "
+                f"zip is "
+                f"positional, so every spec after the mismatch silently "
+                f"lands on the wrong argument (or raises at call time)",
+                mod))
+    n_out = _tuple_len(kwargs.get(kind_out))
+    ret = _return_tuple_arity(fn_node)
+    if n_out is not None and ret is not None and n_out != ret:
+        findings.append(_finding(
+            "GL012", site,
+            f"{kind_out} has {n_out} entr{'y' if n_out == 1 else 'ies'} "
+            f"but `{fn_node.name}` returns a {ret}-tuple — output specs "
+            f"zip positionally against the returned pytree",
+            mod))
+
+
+def check_shardings_arity(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        for fn in mod.functions.values():
+            if fn.node is None:
+                continue
+            for dec in fn.node.decorator_list:
+                kw = jit_kwargs(dec)
+                if kw:
+                    _check_arity(fn.node, dec, kw, "in_shardings",
+                                 "out_shardings", mod, findings)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = dotted(node.func)
+            # jax.jit(f, in_shardings=...) / pjit(f, ...)
+            call = jit_wrap_call(node)
+            if call is not None and call.args:
+                first = call.args[0]
+                if dotted(first) in ("jax.jit", "jit", "pjit"):
+                    first = call.args[1] if len(call.args) > 1 else None
+                if isinstance(first, ast.Name) \
+                        and first.id in mod.functions:
+                    target = mod.functions[first.id]
+                    if target.node is not None:
+                        _check_arity(target.node, node,
+                                     {k.arg: k.value for k in node.keywords
+                                      if k.arg},
+                                     "in_shardings", "out_shardings", mod,
+                                     findings)
+            elif f in _SHARD_MAP and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                target = mod.functions.get(node.args[0].id)
+                if target is not None and target.node is not None:
+                    kw = {k.arg: k.value for k in node.keywords if k.arg}
+                    if len(node.args) >= 3:
+                        kw.setdefault("in_specs", node.args[2])
+                    if len(node.args) >= 4:
+                        kw.setdefault("out_specs", node.args[3])
+                    _check_arity(target.node, node, kw, "in_specs",
+                                 "out_specs", mod, findings)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# GL013 — per-iteration Python scalars into shape/static positions
+# --------------------------------------------------------------------------
+
+
+_MUTATORS = {"pop", "append", "extend", "insert", "remove", "clear",
+             "popitem", "update", "add", "discard"}
+
+
+def _mutated_names(fn: FunctionSummary) -> Set[str]:
+    """Names rebound or mutated in place INSIDE a loop of this function
+    — the set over which a ``len(...)`` can change per iteration. A
+    name bound once before the loop is loop-invariant and exempt."""
+    if fn.node is None:
+        return set()
+    out: Set[str] = set()
+    for loop in _walk_scope(fn.node.body):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for n in _walk_scope(loop.body):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                out.add(n.id)
+            elif (isinstance(n, ast.Call)
+                  and isinstance(n.func, ast.Attribute)
+                  and n.func.attr in _MUTATORS
+                  and isinstance(n.func.value, ast.Name)):
+                out.add(n.func.value.id)
+    return out
+
+
+def _varying_reason(arg: ast.expr, site: CallSite,
+                    mutated: Set[str]) -> Optional[str]:
+    """Why this argument takes a new Python value every iteration —
+    None when it is loop-invariant (e.g. len() of a never-mutated
+    container compiles exactly one program)."""
+    for n in ast.walk(arg):
+        if isinstance(n, ast.Name) and n.id in site.loop_vars:
+            return f"loop variable `{n.id}`"
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "len" and n.args):
+            operand = {x.id for x in ast.walk(n.args[0])
+                       if isinstance(x, ast.Name)}
+            if operand & (site.loop_vars | mutated):
+                return "`len(...)` of a mutated container, recomputed " \
+                       "per iteration"
+    return None
+
+
+def check_varying_shape_args(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        for fn in (*mod.functions.values(), mod.toplevel):
+            mutated = _mutated_names(fn)
+            for site in fn.calls:
+                if site.loop_depth <= 0:
+                    continue
+                callee = idx.resolve_call(mod, fn, site.func_expr)
+                if callee is None or not callee.jitted \
+                        or not callee.shape_params:
+                    continue
+                for param, arg in _map_args(site.node, callee).items():
+                    if param not in callee.shape_params:
+                        continue
+                    reason = _varying_reason(arg, site, mutated)
+                    if reason is None:
+                        continue
+                    findings.append(_finding(
+                        "GL013", site.node,
+                        f"{reason} flows into `{param}`, a shape/static "
+                        f"position of jitted `{callee.name}` — every "
+                        f"distinct value compiles a fresh program (the "
+                        f"classic recompile-per-length death spiral); pad "
+                        f"to a fixed bucket or make the size a traced "
+                        f"array dimension",
+                        mod))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# GL014 — donating a buffer the jitted body captures as a constant
+# --------------------------------------------------------------------------
+
+
+def check_donated_closure_capture(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        for fn in (*mod.functions.values(), mod.toplevel):
+            for site in fn.calls:
+                callee = idx.resolve_call(mod, fn, site.func_expr)
+                if callee is None or not callee.jitted \
+                        or not callee.donated_params:
+                    continue
+                callee_mod = idx.modules.get(callee.label)
+                if callee_mod is None:
+                    continue
+                for param, arg in _map_args(site.node, callee).items():
+                    if param not in callee.donated_params:
+                        continue
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    # the argument must BE the captured module global,
+                    # not a caller local/param that merely shares its
+                    # name (different binding, different buffer). Module
+                    # top-level "locals" ARE the module globals, so the
+                    # shadowing guard only applies inside functions.
+                    if fn.name != "<module>" and arg.id in fn.local_names:
+                        continue
+                    if mod.label == callee.label:
+                        global_name = arg.id
+                    else:
+                        b = mod.imports.get(arg.id)
+                        if b is None or b.symbol is None \
+                                or idx.module_for(b.module) is not callee_mod:
+                            continue
+                        global_name = b.symbol
+                    if global_name in callee.free_reads \
+                            and global_name in callee_mod.globals:
+                        findings.append(_finding(
+                            "GL014", site.node,
+                            f"`{arg.id}` is donated to jitted "
+                            f"`{callee.name}` (param `{param}`) but the "
+                            f"jitted body ALSO captures `{arg.id}` as a "
+                            f"closure constant — donation frees the very "
+                            f"buffer the compiled program holds baked in; "
+                            f"the next call reads freed memory or "
+                            f"silently stale values",
+                            mod))
+    return findings

@@ -22,27 +22,45 @@ _HEADER = """\
 `graftlint` is this package's JAX-hazard static analyzer: pure-AST
 checks for the failure modes that cost TPU time or corrupt results
 without crashing — silent recompiles, host stalls in hot loops, RNG
-reuse, `dynamic_update_slice` clamp corruption. Run it with:
+reuse, `dynamic_update_slice` clamp corruption, sharding specs that
+disagree with their mesh. Since v2 the analyzer is **interprocedural**:
+one pass builds a project-wide call graph with per-function summaries
+(callgraph.py), and the rules consult it through dataflow.py — GL004
+fires when the `.item()` hides two helper calls below the step loop,
+GL002 when the import-time device work sits behind a re-exported
+wrapper, GL005 when a donated buffer is read back through an alias.
+Run it with:
 
 ```
-python -m replicatinggpt_tpu lint                  # package vs baseline
+python -m replicatinggpt_tpu lint                  # whole project vs baseline
 python -m replicatinggpt_tpu lint path/to/file.py  # specific files
-python -m replicatinggpt_tpu lint --write-baseline # refresh the baseline
+python -m replicatinggpt_tpu lint --changed origin/main  # diff-aware
+python -m replicatinggpt_tpu lint --write-baseline # refresh (ratcheted)
 python -m replicatinggpt_tpu lint --format json    # machine-readable
+python -m replicatinggpt_tpu lint --format sarif   # SARIF 2.1.0 for CI
 ```
+
+Discovery covers the package plus `bench.py`, `tools/` and `tests/`;
+findings under `tests/` are *warnings* (reported, never gating — a test
+that syncs to assert on a value is the norm), tunable per directory
+with `--severity DIR=LEVEL`.
 
 Suppression, in precedence order:
 
 1. fix the hazard (preferred);
 2. `# graftlint: disable=GL004` on the flagged line (or
    `disable=GL004,GL006`, or `disable=all`) for a reviewed,
-   intentional exception — leave a comment saying why;
+   intentional exception — leave a comment saying why. A pragma at a
+   sync site also stops interprocedural propagation from that site;
 3. `# graftlint: disable-file=GL002` anywhere in a file;
 4. the committed `graftlint_baseline.json` absorbs pre-existing
    findings; `lint --baseline` (the tier-1 gate) fails only on NEW
    ones. The tier-1 test also asserts the baseline exactly matches a
    fresh run, so fixing a baselined finding requires
-   `--write-baseline`.
+   `--write-baseline` — which is a **ratchet**: it refuses to add
+   entries the committed baseline doesn't already have (override for a
+   reviewed expansion with `--allow-growth`), so the baseline can only
+   shrink over time.
 
 `GL000` (not listed below) reports files that fail to parse.
 

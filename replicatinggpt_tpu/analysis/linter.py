@@ -1,13 +1,25 @@
-"""graftlint driver: file discovery, pragma handling, rule dispatch.
+"""graftlint driver: discovery, project indexing, dispatch, pragmas.
 
-Pure host Python (no jax import): parse each file once, run every
-registered rule over the tree, then drop findings suppressed by
-pragmas. Two pragma forms:
+v2 pipeline — parse every target file ONCE, build the project-wide
+call-graph index (callgraph.py), then run two checker kinds per rule:
+the per-file syntactic pass and the project pass (dataflow.py) that
+sees across functions and files. Findings from both merge under one
+rule id and flow through the same pragma/severity/baseline machinery.
+
+Discovery (no paths given) covers the package **plus** ``bench.py``,
+``tools/*.py`` and ``tests/`` — nothing that executes JAX escapes the
+hazard rules anymore. Findings are tiered by directory: ``tests/``
+findings are *warnings* (reported, never fail the gate, never
+baselined) because a test deliberately syncing to assert on a value is
+the norm, not a hazard; everything else is an *error*. Fixture trees
+named ``fixtures`` are skipped during directory expansion (they are
+intentional bad code) but lint normally when named explicitly.
+
+Pragmas (unchanged from v1, shared with callgraph summaries so a
+suppressed sync site also stops interprocedural propagation):
 
 - line-level: ``x = risky()  # graftlint: disable=GL004`` (or
-  ``disable=GL004,GL006`` / ``disable=all``) — suppresses findings
-  REPORTED on that line (for a multi-line statement, the line where it
-  starts);
+  ``disable=GL004,GL006`` / ``disable=all``);
 - file-level: ``# graftlint: disable-file=GL002`` anywhere in the file.
 
 Suppressed findings are counted, not discarded silently — ``lint
@@ -17,88 +29,62 @@ Suppressed findings are counted, not discarded silently — ``lint
 from __future__ import annotations
 
 import ast
-import re
+import dataclasses
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Iterable, List, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
+from .callgraph import ProjectIndex, parse_pragmas
 from .rules import RULES, Finding
 
 #: repo root when running from a checkout (analysis/ -> package -> root)
 REPO_ROOT = Path(__file__).resolve().parents[2]
 DEFAULT_TARGET = Path(__file__).resolve().parents[1]
 
-_PRAGMA = re.compile(r"#\s*graftlint:\s*(disable(?:-file)?)\s*=\s*"
-                     r"([A-Za-z0-9_,\s]+)")
+#: default discovery set beyond the package (repo-root relative; only
+#: entries that exist are linted, so an installed package degrades to
+#: package-only linting)
+EXTRA_TARGETS = ("bench.py", "tools", "tests")
+
+#: per-directory severity: longest matching label prefix wins; paths
+#: with no match are errors. The CLI exposes this as --severity.
+DEFAULT_SEVERITY: Mapping[str, str] = {"tests/": "warning"}
+
+#: directory names pruned during directory expansion
+_PRUNE_DIRS = {"__pycache__", "fixtures"}
 
 
 @dataclass
 class LintResult:
-    findings: List[Finding] = field(default_factory=list)
+    findings: List[Finding] = field(default_factory=list)   # errors
+    warnings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
     files: int = 0
 
     def extend(self, other: "LintResult") -> None:
         self.findings.extend(other.findings)
+        self.warnings.extend(other.warnings)
         self.suppressed.extend(other.suppressed)
         self.files += other.files
 
 
-def _parse_pragmas(lines: Sequence[str]) -> Tuple[Dict[int, Set[str]],
-                                                  Set[str]]:
-    """(line -> disabled rule ids, file-wide disabled ids). 'all' means
-    every rule."""
-    per_line: Dict[int, Set[str]] = {}
-    per_file: Set[str] = set()
-    for i, line in enumerate(lines, start=1):
-        m = _PRAGMA.search(line)
-        if not m:
-            continue
-        ids = {tok.strip().upper() for tok in m.group(2).split(",")
-               if tok.strip()}
-        if "ALL" in ids:
-            ids = set(RULES) | {"ALL"}
-        if m.group(1) == "disable-file":
-            per_file |= ids
-        else:
-            per_line.setdefault(i, set()).update(ids)
-    return per_line, per_file
+@dataclass
+class _FileCtx:
+    label: str
+    lines: Sequence[str]
+    tree: Optional[ast.Module]          # None on syntax error
+    error: Optional[Finding] = None
 
 
-def lint_source(source: str, path: str,
-                rule_ids: Sequence[str] = ()) -> LintResult:
-    """Lint one file's source text. ``path`` is the label findings carry
-    (callers pass repo-relative paths so baselines are portable)."""
-    res = LintResult(files=1)
-    lines = source.splitlines()
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        res.findings.append(Finding(
-            path=path, rule="GL000", line=e.lineno or 1, col=e.offset or 0,
-            message=f"syntax error: {e.msg}",
-            text=(e.text or "").strip()))
-        return res
-    per_line, per_file = _parse_pragmas(lines)
-    active = [RULES[r] for r in (rule_ids or sorted(RULES))]
-    found: List[Finding] = []
-    for rule in active:
-        found.extend(rule.checker(tree, lines, path))
-    for f in sorted(found, key=lambda f: (f.line, f.col, f.rule)):
-        if f.rule in per_file or f.rule in per_line.get(f.line, set()):
-            res.suppressed.append(f)
-        else:
-            res.findings.append(f)
-    return res
-
-
-def iter_python_files(paths: Iterable[Path]) -> List[Path]:
+def iter_python_files(paths: Iterable[Path],
+                      prune: bool = False) -> List[Path]:
+    skip = _PRUNE_DIRS if prune else {"__pycache__"}
     out: List[Path] = []
     for p in paths:
         p = Path(p)
         if p.is_dir():
             out.extend(sorted(q for q in p.rglob("*.py")
-                              if "__pycache__" not in q.parts))
+                              if not set(q.parts) & skip))
         elif p.suffix == ".py":
             out.append(p)
     return out
@@ -114,11 +100,102 @@ def rel_label(path: Path) -> str:
         return p.as_posix()
 
 
-def lint_paths(paths: Sequence = (),
-               rule_ids: Sequence[str] = ()) -> LintResult:
-    """Lint files/directories (default: the replicatinggpt_tpu package)."""
-    targets = [Path(p) for p in paths] or [DEFAULT_TARGET]
-    res = LintResult()
-    for f in iter_python_files(targets):
-        res.extend(lint_source(f.read_text(), rel_label(f), rule_ids))
+def default_targets() -> List[Path]:
+    targets: List[Path] = [DEFAULT_TARGET]
+    for extra in EXTRA_TARGETS:
+        p = REPO_ROOT / extra
+        if p.exists():
+            targets.append(p)
+    return targets
+
+
+def severity_for(label: str, severity: Mapping[str, str]) -> str:
+    best = ""
+    level = "error"
+    for prefix, lvl in severity.items():
+        if label.startswith(prefix) and len(prefix) > len(best):
+            best, level = prefix, lvl
+    return level
+
+
+def _parse_file(source: str, label: str) -> _FileCtx:
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return _FileCtx(label=label, lines=lines, tree=None, error=Finding(
+            path=label, rule="GL000", line=e.lineno or 1, col=e.offset or 0,
+            message=f"syntax error: {e.msg}", text=(e.text or "").strip()))
+    return _FileCtx(label=label, lines=lines, tree=tree)
+
+
+def _lint_files(ctxs: Sequence[_FileCtx],
+                rule_ids: Sequence[str] = (),
+                severity: Optional[Mapping[str, str]] = None) -> LintResult:
+    """The v2 core: per-file syntactic passes + one project pass, then
+    pragma filtering and severity tiering."""
+    severity = DEFAULT_SEVERITY if severity is None else severity
+    active = [RULES[r] for r in (rule_ids or sorted(RULES))]
+    res = LintResult(files=len(ctxs))
+
+    parsed = [c for c in ctxs if c.tree is not None]
+    raw: Dict[str, List[Finding]] = {c.label: [] for c in ctxs}
+    for c in ctxs:
+        if c.error is not None:
+            raw[c.label].append(c.error)
+    for rule in active:
+        if rule.checker is not None:
+            for c in parsed:
+                for f in rule.checker(c.tree, c.lines, c.label):
+                    raw.setdefault(f.path, []).append(f)
+    if any(rule.project_checker is not None for rule in active):
+        index = ProjectIndex.build(
+            [(c.label, c.tree, c.lines) for c in parsed], sorted(RULES))
+        for rule in active:
+            if rule.project_checker is not None:
+                for f in rule.project_checker(index):
+                    raw.setdefault(f.path, []).append(f)
+
+    pragmas = {c.label: parse_pragmas(c.lines, sorted(RULES)) for c in ctxs}
+    for c in ctxs:
+        per_line, per_file = pragmas[c.label]
+        for f in sorted(raw.get(c.label, ()),
+                        key=lambda f: (f.line, f.col, f.rule)):
+            if f.rule in per_file or f.rule in per_line.get(f.line, set()):
+                res.suppressed.append(f)
+                continue
+            lvl = severity_for(f.path, severity)
+            if lvl != f.severity:
+                f = dataclasses.replace(f, severity=lvl)
+            (res.findings if lvl == "error" else res.warnings).append(f)
     return res
+
+
+def lint_source(source: str, path: str,
+                rule_ids: Sequence[str] = (),
+                severity: Optional[Mapping[str, str]] = None) -> LintResult:
+    """Lint one file's source text. ``path`` is the label findings carry
+    (callers pass repo-relative paths so baselines are portable). The
+    file is its own one-module project, so self-contained
+    interprocedural findings still fire."""
+    return _lint_files([_parse_file(source, path)], rule_ids, severity)
+
+
+def lint_paths(paths: Sequence = (),
+               rule_ids: Sequence[str] = (),
+               severity: Optional[Mapping[str, str]] = None) -> LintResult:
+    """Lint files/directories (default: the replicatinggpt_tpu package
+    plus bench.py, tools/ and tests/). All targets are indexed together,
+    so cross-file dataflow sees the whole target set."""
+    explicit = [Path(p) for p in paths]
+    files = (iter_python_files(explicit) if explicit
+             else iter_python_files(default_targets(), prune=True))
+    # overlapping targets (`lint pkg pkg/file.py`, a file listed twice)
+    # must lint once: dedupe on the label identity findings carry
+    ctxs, seen = [], set()
+    for f in files:
+        label = rel_label(f)
+        if label not in seen:
+            seen.add(label)
+            ctxs.append(_parse_file(f.read_text(), label))
+    return _lint_files(ctxs, rule_ids, severity)

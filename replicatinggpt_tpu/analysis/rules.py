@@ -40,7 +40,9 @@ from typing import Callable, Dict, List, Optional, Sequence
 class Finding:
     """One lint hit. ``text`` is the stripped source line — the baseline
     matches on (path, rule, text) rather than line numbers, so findings
-    survive unrelated edits that shift lines."""
+    survive unrelated edits that shift lines. ``severity`` is assigned
+    by the driver from the per-directory tier map (tests/ findings are
+    warnings); only errors gate CI or enter the baseline."""
 
     path: str
     rule: str
@@ -48,19 +50,30 @@ class Finding:
     col: int
     message: str
     text: str
+    severity: str = "error"
 
     def format(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        tag = "" if self.severity == "error" else f" {self.severity}:"
+        return (f"{self.path}:{self.line}:{self.col}:{tag} "
+                f"{self.rule} {self.message}")
 
 
 @dataclass(frozen=True)
 class Rule:
+    """``checker`` is the per-file syntactic pass; ``project_checker``
+    (v2) runs once per lint invocation over the whole-project
+    :class:`~.callgraph.ProjectIndex` and is how a rule sees across
+    function and file boundaries. A rule may have either or both — the
+    driver runs both and merges the findings under one rule id."""
+
     id: str
     name: str
     rationale: str
     bad: str
     good: str
-    checker: Callable[[ast.Module, Sequence[str], str], List[Finding]]
+    checker: Optional[Callable[[ast.Module, Sequence[str], str],
+                               List[Finding]]] = None
+    project_checker: Optional[Callable[..., List[Finding]]] = None
 
 
 RULES: Dict[str, Rule] = {}
@@ -68,8 +81,19 @@ RULES: Dict[str, Rule] = {}
 
 def _register(rule: Rule) -> Rule:
     assert rule.id not in RULES, f"duplicate rule id {rule.id}"
+    assert rule.checker or rule.project_checker, rule.id
     RULES[rule.id] = rule
     return rule
+
+
+def _project(check_name: str):
+    """Lazy dispatch into dataflow.py (rules.py is imported by it, so
+    the project checkers bind at call time, not import time)."""
+    def run(index):
+        from . import dataflow
+        return getattr(dataflow, check_name)(index)
+    run.__name__ = check_name
+    return run
 
 
 # ---------------------------------------------------------------------------
@@ -323,7 +347,8 @@ import numpy as np
 MASK = np.tril(np.ones((1024, 1024)))     # host constant; or build
                                           # inside the jitted function
 """,
-    checker=_check_module_scope_jnp))
+    checker=_check_module_scope_jnp,
+    project_checker=_project("check_device_call_at_import")))
 
 
 # ---------------------------------------------------------------------------
@@ -574,7 +599,8 @@ for _ in range(k):
     total = loss if total is None else total + loss
 mean = float(total) / k                        # ONE sync per split
 """,
-    checker=_check_host_sync_in_loop))
+    checker=_check_host_sync_in_loop,
+    project_checker=_project("check_sync_through_helpers")))
 
 
 # ---------------------------------------------------------------------------
@@ -642,7 +668,8 @@ def update(state, batch):        # old state buffers stay live
 def update(state, batch):        # old buffers reused for the new state
     return state.apply(batch)
 """,
-    checker=_check_missing_donation))
+    checker=_check_missing_donation,
+    project_checker=_project("check_use_after_donate")))
 
 
 # ---------------------------------------------------------------------------
@@ -1067,6 +1094,146 @@ def latest_rng_shape(mngr, step):
             f"checkpoint step {step} is corrupt: {e}") from e
 """,
     checker=_check_swallowed_io_except))
+
+
+# ---------------------------------------------------------------------------
+# GL010–GL014 — mesh/sharding hazard family (project-index passes; the
+# implementations live in dataflow.py, next to the call-graph plumbing
+# they share with the interprocedural upgrades above)
+# ---------------------------------------------------------------------------
+
+_register(Rule(
+    id="GL010", name="spec-axis-not-in-mesh",
+    rationale=(
+        "A PartitionSpec naming an axis the mesh doesn't have is the "
+        "silent version of a wrong layout: depending on context GSPMD "
+        "either raises at lowering or treats the unknown axis as "
+        "replicated — the array LOOKS sharded in the code and is not, "
+        "so the program runs, just with a full copy per device and "
+        "collectives that don't match the mental model. The pjit/TPUv4 "
+        "scaling story is sharding-annotation consistency; this rule "
+        "checks the half of it that is statically checkable (meshes "
+        "whose axis names are literal)."),
+    bad="""\
+mesh = Mesh(devices, ("data", "model"))
+s = NamedSharding(mesh, P("data", "seq"))   # 'seq' is not a mesh axis
+""",
+    good="""\
+mesh = Mesh(devices, ("data", "seq", "model"))
+s = NamedSharding(mesh, P("data", "seq"))   # every axis exists
+""",
+    project_checker=_project("check_spec_mesh_mismatch")))
+
+
+_register(Rule(
+    id="GL011", name="unsharded-global-in-annotated-program",
+    rationale=(
+        "A function whose program carries sharding annotations "
+        "(in_shardings/out_shardings, shard_map, pjit) that closes over "
+        "a module-level array built with plain jnp/np calls embeds that "
+        "array OUTSIDE the sharding contract: it is baked into the "
+        "program fully replicated on every device. For a lookup table "
+        "or mask at model scale that's a full per-device HBM copy no "
+        "spec accounts for — the exact waste the annotations were "
+        "supposed to rule out."),
+    bad="""\
+table = jnp.zeros((50_000, 512))              # module scope, no sharding
+
+@partial(jax.jit, in_shardings=(x_sharding,))
+def embed(ids):
+    return table[ids]                         # replicated capture
+""",
+    good="""\
+@partial(jax.jit, in_shardings=(x_sharding, table_sharding))
+def embed(ids, table):                        # explicit, spec'd argument
+    return table[ids]
+""",
+    project_checker=_project("check_unsharded_global_capture")))
+
+
+_register(Rule(
+    id="GL012", name="shardings-arity-mismatch",
+    rationale=(
+        "in_shardings / in_specs zip positionally against the wrapped "
+        "function's arguments (and out_shardings / out_specs against "
+        "its returns). A literal tuple of the wrong length either "
+        "raises at the first call — or worse, with optional trailing "
+        "arguments, quietly shifts every spec onto the wrong parameter "
+        "so the batch gets the weights' sharding and vice versa. The "
+        "arity is statically checkable whenever the spec tuple is a "
+        "literal; this rule checks exactly that and nothing more."),
+    bad="""\
+@partial(jax.jit, in_shardings=(x_shard, w_shard))
+def apply(x, w, b):                  # 3 args, 2 specs: b inherits w's?
+    return x @ w + b
+""",
+    good="""\
+@partial(jax.jit, in_shardings=(x_shard, w_shard, b_shard))
+def apply(x, w, b):                  # one spec per argument
+    return x @ w + b
+""",
+    project_checker=_project("check_shardings_arity")))
+
+
+_register(Rule(
+    id="GL013", name="varying-scalar-into-shape-arg",
+    rationale=(
+        "A Python scalar that changes per loop iteration (the loop "
+        "variable, a len() of a growing list) flowing into a parameter "
+        "a jitted function uses in a shape — or declared static — "
+        "compiles a fresh program per distinct value. This is the "
+        "recompile-per-length death spiral: the run works at toy sizes "
+        "and spends 90% of wall-clock in XLA at real ones. Pad to "
+        "fixed buckets (what the serving engine's static slot/window "
+        "shapes do) or keep the size a traced array dimension."),
+    bad="""\
+@partial(jax.jit, static_argnames=("n",))
+def window(x, n):
+    return x[:n] * jnp.ones((n,))
+
+for i in range(steps):
+    out = window(x, i)        # one fresh XLA program per i
+""",
+    good="""\
+@partial(jax.jit, static_argnames=("n",))
+def window(x, n):
+    return x[:n] * jnp.ones((n,))
+
+BUCKET = 128                  # pad sizes to a fixed bucket: one program
+for i in range(steps):
+    out = window(x, BUCKET)
+""",
+    project_checker=_project("check_varying_shape_args")))
+
+
+_register(Rule(
+    id="GL014", name="donated-closure-constant",
+    rationale=(
+        "Donating a buffer that the jitted body ALSO captures as a "
+        "closure constant frees the very memory the compiled program "
+        "holds a baked-in reference to: XLA reuses the donated pages "
+        "for the output while the constant still points at them. The "
+        "first call may even work; later calls read whatever the "
+        "output overwrote — silent corruption, not a crash. If the "
+        "buffer must be updated in place, pass it as the donated "
+        "argument everywhere and drop the capture."),
+    bad="""\
+state = jnp.zeros((1024,))
+
+@partial(jax.jit, donate_argnames=("s",))
+def step(s):
+    return s + state              # captures `state` as a constant
+
+out = step(state)                 # ...and donates the same buffer
+""",
+    good="""\
+@partial(jax.jit, donate_argnames=("s",))
+def step(s, delta):
+    return s + delta              # everything arrives as an argument
+
+state = step(state, delta)
+""",
+    project_checker=_project("check_donated_closure_capture")))
 
 
 def all_rule_ids() -> List[str]:

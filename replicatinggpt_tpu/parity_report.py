@@ -34,20 +34,27 @@ def _forward_and_grad_parity(report: io.StringIO) -> None:
     from .models.gpt import forward, init_params
     from .reference_torch import RefGPT, params_to_torch
 
+    from .reference_torch import torch_to_params
+
     report.write("## 1-2. Forward / gradient parity (same weights, same "
                  "inputs)\n\n")
     report.write("| flavor | max |logits diff| | loss diff | max rel grad "
                  "diff |\n|---|---|---|---|\n")
+    # inputs are flavor-independent: build them once, ONE host pull,
+    # before the comparison loop
+    x = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                      65), np.int64)
+    y = np.asarray(jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+                                      65), np.int64)
+    labels = []
+    deltas = []          # per-flavor (dl, dloss), accumulated ON DEVICE
+    grad_pairs = []      # per-flavor (jax grad tree, torch grad tree)
     for tied, act, label in ((False, "relu", "GPT-1 (untied, ReLU)"),
                              (True, "gelu", "GPT-2 (tied, GELU)")):
         cfg = ModelConfig(vocab_size=65, block_size=32, n_layer=2, n_head=2,
                           n_embd=32, dropout=0.0, attn_dropout=0.0,
                           tied_head=tied, activation=act, dtype="float32")
         params = init_params(jax.random.PRNGKey(0), cfg)
-        x = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
-                                          65), np.int64)
-        y = np.asarray(jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
-                                          65), np.int64)
 
         jlogits, jloss = forward(params, jnp.asarray(x, jnp.int32), cfg,
                                  targets=jnp.asarray(y, jnp.int32))
@@ -55,9 +62,10 @@ def _forward_and_grad_parity(report: io.StringIO) -> None:
         tm = params_to_torch(params, RefGPT(cfg))
         tlogits, tloss = tm(torch.from_numpy(x), torch.from_numpy(y))
 
-        dl = float(np.abs(np.asarray(jlogits)
-                          - tlogits.detach().numpy()).max())
-        dloss = abs(float(jloss) - float(tloss))
+        # torch -> numpy is a host-side detach, not a device sync; the
+        # deltas against it stay jax scalars until the fetch after the loop
+        dl = jnp.abs(jlogits - tlogits.detach().numpy()).max()
+        dloss = jnp.abs(jloss - tloss.detach().numpy())
 
         # gradients
         def jf(p):
@@ -67,8 +75,6 @@ def _forward_and_grad_parity(report: io.StringIO) -> None:
         jg = jax.grad(jf)(params)
         tm.zero_grad()
         tloss.backward()
-        from .reference_torch import torch_to_params
-        tg = {}
         # reuse the name mapping by reading grads through a weight-shaped
         # copy: swap .data with .grad, convert, swap back
         for p in tm.parameters():
@@ -77,12 +83,25 @@ def _forward_and_grad_parity(report: io.StringIO) -> None:
         for p in tm.parameters():
             p.data, p.grad = p.grad, p.data
 
-        rel = 0.0
-        for ja, ta in zip(jax.tree_util.tree_leaves(jg),
+        labels.append(label)
+        deltas.append(jnp.stack([dl, dloss]))
+        grad_pairs.append((jg, tg))
+    # TWO device boundary crossings for the whole report, both after the
+    # loop: the stacked logit/loss deltas and the gradient trees. The
+    # rel-grad reduction runs on host in float64 (it compares values
+    # near f32 epsilon — doing it in f32 would measure rounding noise).
+    vals = np.asarray(jnp.stack(deltas))
+    host_jgs = jax.device_get([jg for jg, _ in grad_pairs])
+    rels = []
+    for host_jg, (_, tg) in zip(host_jgs, grad_pairs):
+        rel = np.float64(0.0)
+        for ja, ta in zip(jax.tree_util.tree_leaves(host_jg),
                           jax.tree_util.tree_leaves(tg)):
-            ja, ta = np.asarray(ja, np.float64), np.asarray(ta, np.float64)
-            denom = np.maximum(np.abs(ta), 1e-6)
-            rel = max(rel, float((np.abs(ja - ta) / denom).max()))
+            ja64, ta64 = ja.astype(np.float64), ta.astype(np.float64)
+            denom = np.maximum(np.abs(ta64), 1e-6)
+            rel = np.maximum(rel, (np.abs(ja64 - ta64) / denom).max())
+        rels.append(rel)
+    for label, (dl, dloss), rel in zip(labels, vals, rels):
         report.write(f"| {label} | {dl:.2e} | {dloss:.2e} | {rel:.2e} |\n")
     report.write("\n")
 
@@ -108,9 +127,13 @@ def _training_curve_parity(report: io.StringIO, steps: int) -> None:
     tok = get_tokenizer("char", corpus_text=text)
     ds = TokenDataset.from_text(text, tok, tcfg.val_fraction)
 
-    # identical batch stream for both backends
+    # identical batch stream for both backends; the torch copy is
+    # converted to int64 up front (host numpy -> host numpy, no device
+    # involved) so the training loops below do zero per-step conversions
     stream = list(RandomBatcher(ds.train, 8, mcfg.block_size, seed=7)
                   .next_batch() for _ in range(steps))
+    stream64 = [(np.asarray(xb, np.int64), np.asarray(yb, np.int64))
+                for xb, yb in stream]
 
     # one init, transferred losslessly to torch — the curves start from
     # bit-identical weights
@@ -120,23 +143,25 @@ def _training_curve_parity(report: io.StringIO, steps: int) -> None:
                        opt_state=make_optimizer(tcfg).init(params0),
                        rng=jax.random.PRNGKey(1))
     step = make_train_step(mcfg, tcfg, donate=False)
-    jl = []
+    jdev = []
     for xb, yb in stream:
         state, metrics = step(state, (jnp.asarray(xb), jnp.asarray(yb)))
-        jl.append(float(metrics["loss"]))
+        jdev.append(metrics["loss"])          # stays on device
+    # the whole jax loss curve crosses the device boundary ONCE
+    jl = [float(v) for v in np.asarray(jnp.stack(jdev))]
 
     tm = params_to_torch(params0, RefGPT(mcfg))
     opt = torch.optim.AdamW(tm.parameters(), lr=tcfg.lr,
                             betas=tcfg.betas, eps=1e-8,
                             weight_decay=tcfg.weight_decay)
-    tl = []
-    for xb, yb in stream:
+    tdev = []
+    for xb, yb in stream64:
         opt.zero_grad(set_to_none=True)
-        _, loss = tm(torch.from_numpy(np.asarray(xb, np.int64)),
-                     torch.from_numpy(np.asarray(yb, np.int64)))
+        _, loss = tm(torch.from_numpy(xb), torch.from_numpy(yb))
         loss.backward()
         opt.step()
-        tl.append(float(loss))
+        tdev.append(loss.detach())            # torch host scalar
+    tl = [float(v) for v in tdev]
 
     diffs = [abs(a - b) for a, b in zip(jl, tl)]
     report.write(f"## 3. Training-curve parity ({steps} AdamW steps, "

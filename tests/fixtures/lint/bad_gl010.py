@@ -1,0 +1,17 @@
+"""GL010 bad: PartitionSpec names an axis the mesh doesn't have."""
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_batch(devices, batch):
+    mesh = Mesh(np.asarray(devices), ("data", "model"))
+    sharding = NamedSharding(mesh, P("data", "seq"))   # 'seq': no such axis
+    return jax.device_put(batch, sharding)
+
+
+def shard_mapped(devices, fn, xs):
+    from jax.experimental.shard_map import shard_map
+    mesh = Mesh(np.asarray(devices), ("data",))
+    return shard_map(fn, mesh, in_specs=P("model"),   # 'model': no such axis
+                     out_specs=P("data"))(xs)

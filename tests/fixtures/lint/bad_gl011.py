@@ -1,0 +1,14 @@
+"""GL011 bad: sharding-annotated program captures an unsharded module
+array."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+table = np.zeros((1024, 64), np.float32)    # module array, no sharding
+
+
+@partial(jax.jit, in_shardings=(None,))
+def embed(ids):
+    return jnp.take(table, ids, axis=0)     # baked in, fully replicated

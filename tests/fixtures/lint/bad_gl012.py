@@ -1,0 +1,17 @@
+"""GL012 bad: sharding-spec tuples whose arity disagrees with the
+wrapped function."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, in_shardings=(None, None))
+def apply3(x, w, b):                  # 3 args, 2 specs
+    return x @ w + b
+
+
+def pair(x):
+    return x, x
+
+
+paired = jax.jit(pair, out_shardings=(None, None, None))   # 2-tuple return

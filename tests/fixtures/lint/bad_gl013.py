@@ -1,0 +1,26 @@
+"""GL013 bad: per-iteration Python scalars flow into shape/static
+positions of a jitted function — one fresh XLA program per value."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n",))
+def window(x, n):
+    return x[:n] * jnp.ones((n,))
+
+
+def sweep(x, steps):
+    outs = []
+    for i in range(steps):
+        outs.append(window(x, i))        # recompiles per i
+    return outs
+
+
+def drain(x, items):
+    outs = []
+    while items:
+        items.pop()
+        outs.append(window(x, len(items)))   # recompiles per length
+    return outs

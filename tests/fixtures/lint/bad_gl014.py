@@ -1,0 +1,17 @@
+"""GL014 bad: the donated buffer is ALSO a closure constant of the
+jitted body — donation frees memory the program holds baked in."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+state = jnp.zeros((128,))     # graftlint: disable=GL002
+
+
+@partial(jax.jit, donate_argnames=("s",))
+def step(s):
+    return s + state                    # captures `state` as a constant
+
+
+def advance():
+    return step(state)                  # ...and donates the same buffer

@@ -1,0 +1,17 @@
+"""GL010 good: every PartitionSpec axis exists on the mesh it targets."""
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_batch(devices, batch):
+    mesh = Mesh(np.asarray(devices), ("data", "seq", "model"))
+    sharding = NamedSharding(mesh, P("data", "seq"))
+    return jax.device_put(batch, sharding)
+
+
+def shard_mapped(devices, fn, xs):
+    from jax.experimental.shard_map import shard_map
+    mesh = Mesh(np.asarray(devices), ("data", "model"))
+    return shard_map(fn, mesh, in_specs=P("model"),
+                     out_specs=P("data"))(xs)

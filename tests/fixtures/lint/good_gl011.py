@@ -1,0 +1,10 @@
+"""GL011 good: the table arrives as an argument with its own spec."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, in_shardings=(None, None))
+def embed(ids, table):
+    return jnp.take(table, ids, axis=0)

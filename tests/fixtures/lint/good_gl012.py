@@ -1,0 +1,16 @@
+"""GL012 good: one spec per argument / per returned element."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, in_shardings=(None, None, None))
+def apply3(x, w, b):
+    return x @ w + b
+
+
+def pair(x):
+    return x, x
+
+
+paired = jax.jit(pair, out_shardings=(None, None))

@@ -1,0 +1,19 @@
+"""GL013 good: sizes padded to a fixed bucket — one program total."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+BUCKET = 128
+
+
+@partial(jax.jit, static_argnames=("n",))
+def window(x, n):
+    return x[:n] * jnp.ones((n,))
+
+
+def sweep(x, steps):
+    outs = []
+    for _ in range(steps):
+        outs.append(window(x, BUCKET))   # constant static: one program
+    return outs

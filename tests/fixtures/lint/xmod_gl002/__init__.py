@@ -1,0 +1,2 @@
+"""Cross-module GL002 fixture package: import-time device work hidden
+behind a re-exported wrapper function."""

@@ -1,0 +1,5 @@
+"""Calls the wrapper at module scope: device alloc at import time, one
+re-export away — interprocedural GL002 must fire HERE."""
+from .maker import build_mask
+
+MASK = build_mask(1024)

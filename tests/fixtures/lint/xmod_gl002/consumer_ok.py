@@ -1,0 +1,6 @@
+"""Clean variant: the mask is built lazily, inside a function."""
+from .maker import build_mask
+
+
+def get_mask(n):
+    return build_mask(n)

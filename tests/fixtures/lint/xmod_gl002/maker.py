@@ -1,0 +1,7 @@
+"""The wrapper: device computation inside a function — clean on its own
+(nothing runs at maker.py import time)."""
+import jax.numpy as jnp
+
+
+def build_mask(n):
+    return jnp.tril(jnp.ones((n, n)))

@@ -1,0 +1,2 @@
+"""Cross-module GL004 fixture package: the host sync lives two call
+levels (and two files) below the step loop."""

@@ -1,0 +1,11 @@
+"""The step loop: calls a helper that (two levels down, in another
+file) syncs to host — interprocedural GL004 must fire HERE."""
+from .mid import log_metrics
+
+
+def train(step, state, batches):
+    losses = []
+    for b in batches:
+        state, metrics = step(state, b)
+        losses.append(log_metrics(metrics))    # sync hidden two calls deep
+    return state, losses

@@ -1,0 +1,20 @@
+"""Clean variants: cadence-guarded sync (the standard logging pattern)
+and device-side accumulation with one sync after the loop."""
+from .mid import log_metrics
+
+
+def train_logged(step, state, batches, log_every):
+    for i, b in enumerate(batches):
+        state, metrics = step(state, b)
+        if i % log_every == 0:          # intentional once-per-interval sync
+            log_metrics(metrics)
+    return state
+
+
+def train_accumulated(step, state, batches):
+    total = None
+    for b in batches:
+        state, metrics = step(state, b)
+        loss = metrics["loss"]          # stays on device
+        total = loss if total is None else total + loss
+    return state, log_metrics({"loss": total})   # ONE sync, after the loop

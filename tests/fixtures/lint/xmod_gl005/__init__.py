@@ -1,0 +1,2 @@
+"""Cross-module GL005 fixture package: donated buffer read after the
+jitted call, through a local alias."""

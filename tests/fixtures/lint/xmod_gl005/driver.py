@@ -1,0 +1,9 @@
+"""Reads the donated buffer after the call, through an alias —
+interprocedural GL005 must fire HERE."""
+from .steps import train_step
+
+
+def run(state, batch):
+    snapshot = state                      # alias of the soon-donated buffer
+    new_state = train_step(state, batch)
+    return new_state, snapshot.mean()     # read-after-donate via alias

@@ -1,0 +1,7 @@
+"""Clean variant: only the returned value is read after the call."""
+from .steps import train_step
+
+
+def run(state, batch):
+    new_state = train_step(state, batch)
+    return new_state, new_state.mean()

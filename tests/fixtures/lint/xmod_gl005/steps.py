@@ -1,0 +1,9 @@
+"""The jitted step: donates its state pytree (correct on its own)."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def train_step(state, batch):
+    return state

@@ -1,7 +1,9 @@
 """graftlint tests: every rule flags its bad fixture and passes its good
-one, both pragma forms suppress, the committed baseline exactly matches
-a fresh whole-package run (the tier-1 CI gate), and the generated rule
-docs cannot drift from the registry."""
+one, the interprocedural upgrades see across files (cross-module fixture
+packages), both pragma forms suppress, the committed baseline exactly
+matches a fresh whole-project run (the tier-1 CI gate), the baseline
+ratchet refuses growth, SARIF output has the 2.1.0 shape, and the
+generated rule docs cannot drift from the registry."""
 
 import json
 import subprocess
@@ -10,23 +12,32 @@ from pathlib import Path
 
 import pytest
 
-from replicatinggpt_tpu.analysis import (DEFAULT_BASELINE, RULES,
-                                         diff_against_baseline, lint_paths,
-                                         lint_source, load_baseline,
-                                         render_rule_docs)
+from replicatinggpt_tpu.analysis import (DEFAULT_BASELINE, DEFAULT_SEVERITY,
+                                         RULES, check_ratchet,
+                                         diff_against_baseline, finding_key,
+                                         lint_paths, lint_source,
+                                         load_baseline, render_rule_docs,
+                                         severity_for, write_baseline)
+from replicatinggpt_tpu.analysis.rules import Finding
 
 FIXTURES = Path(__file__).parent / "fixtures" / "lint"
 REPO = Path(__file__).resolve().parent.parent
 
 RULE_IDS = sorted(RULES)
 
+#: fixtures live under tests/, which the default severity map demotes to
+#: warnings — fixture assertions disable the tiering to stay meaningful
+NO_TIERS = {}
+
 
 def test_registry_shape():
-    assert len(RULES) >= 8                    # the tentpole's rule floor
+    assert len(RULES) >= 14                   # v1 rules + the mesh family
     for rid, rule in RULES.items():
         assert rid == rule.id and rid.startswith("GL") and len(rid) == 5
         assert rule.name and rule.rationale and rule.bad and rule.good
-        assert callable(rule.checker)
+        assert callable(rule.checker) or callable(rule.project_checker)
+    for rid in ("GL010", "GL011", "GL012", "GL013", "GL014"):
+        assert rid in RULES                   # the sharding/mesh family
 
 
 @pytest.mark.parametrize("rule_id", RULE_IDS)
@@ -34,7 +45,7 @@ def test_bad_fixture_flagged(rule_id):
     """Each rule must flag its known-bad snippet (run with only that
     rule active, so the assertion is about THIS rule's detector)."""
     path = FIXTURES / f"bad_{rule_id.lower()}.py"
-    res = lint_paths([path], [rule_id])
+    res = lint_paths([path], [rule_id], severity=NO_TIERS)
     assert res.findings, f"{rule_id} missed its bad fixture"
     assert {f.rule for f in res.findings} == {rule_id}
     for f in res.findings:
@@ -46,18 +57,372 @@ def test_good_fixture_clean(rule_id):
     """The matching clean snippet must pass ALL rules (fixtures are
     written to be globally clean, not just clean for their own rule)."""
     path = FIXTURES / f"good_{rule_id.lower()}.py"
-    res = lint_paths([path])
+    res = lint_paths([path], severity=NO_TIERS)
     assert res.findings == [], [f.format() for f in res.findings]
 
 
+# ---------------------------------------------------------------------------
+# interprocedural upgrades (the v2 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_interprocedural_gl004_two_levels_cross_file():
+    """Pinned acceptance fixture: a `.item()` two call levels (and two
+    files) below the step loop is caught AT the loop's call site; the
+    helper files themselves stay clean (the sync isn't in a loop
+    there), and cadence-guarded / accumulate-then-sync variants stay
+    silent."""
+    res = lint_paths([FIXTURES / "xmod_gl004"], severity=NO_TIERS)
+    assert [(f.path.rsplit("/", 1)[-1], f.rule) for f in res.findings] == \
+        [("loop.py", "GL004")]
+    (f,) = res.findings
+    assert "log_metrics" in f.message and "item()" in f.message
+    assert "leaf.py" in f.message               # the chain names the sink
+
+
+def test_interprocedural_gl002_reexport():
+    """Module-scope call into a wrapper whose body device-allocates:
+    flagged at the import-time call site, not in the wrapper."""
+    res = lint_paths([FIXTURES / "xmod_gl002"], severity=NO_TIERS)
+    assert [(f.path.rsplit("/", 1)[-1], f.rule) for f in res.findings] == \
+        [("consumer.py", "GL002")]
+    assert "build_mask" in res.findings[0].message
+
+
+def test_interprocedural_gl005_alias_read_after_donate():
+    """Reading the donated buffer after the jitted call through a local
+    alias is flagged; reading only the returned value is not."""
+    res = lint_paths([FIXTURES / "xmod_gl005"], severity=NO_TIERS)
+    assert [(f.path.rsplit("/", 1)[-1], f.rule) for f in res.findings] == \
+        [("driver.py", "GL005")]
+    assert "snapshot" in res.findings[0].message
+
+
+def test_single_file_is_its_own_project():
+    """lint_source runs the project pass over a one-file index, so a
+    self-contained interprocedural hazard still fires."""
+    src = ("def helper(m):\n"
+           "    return m.item()\n"
+           "def loop(step, s, bs):\n"
+           "    for b in bs:\n"
+           "        s, m = step(s, b)\n"
+           "        helper(m)\n"
+           "    return s\n")
+    res = lint_source(src, "t.py")
+    assert [f.rule for f in res.findings] == ["GL004"]
+    assert res.findings[0].line == 6            # the call site in the loop
+
+
+def test_loop_iterator_expression_is_not_loop_body():
+    """`for b in helper():` evaluates the iterator ONCE — a sync inside
+    helper is not a per-iteration stall. A call in an inner loop's
+    iterator IS per-outer-iteration, and is flagged exactly once (no
+    duplicate from the iterator being walked at two depths)."""
+    once = ("def helper(xs):\n"
+            "    return xs.item()\n"
+            "def f(step, s, xs):\n"
+            "    for b in helper(xs):\n"
+            "        s = step(s, b)\n"
+            "    return s\n")
+    assert lint_source(once, "t.py").findings == []
+    nested = ("def helper(a):\n"
+              "    return a.item()\n"
+              "def f(step, s, outer):\n"
+              "    for a in outer:\n"
+              "        for b in helper(a):\n"
+              "            s = step(s, b)\n"
+              "    return s\n")
+    res = lint_source(nested, "t.py")
+    assert [f.rule for f in res.findings] == ["GL004"]   # once, not twice
+
+
+def test_gl010_nested_def_scope_does_not_leak():
+    """A mesh built inside a nested def must not shadow (or be checked
+    against) the enclosing function's mesh."""
+    src = ("from jax.sharding import Mesh, NamedSharding, "
+           "PartitionSpec as P\n"
+           "def outer(devs, devs2):\n"
+           "    mesh = Mesh(devs, ('data',))\n"
+           "    def inner():\n"
+           "        mesh = Mesh(devs2, ('model',))\n"
+           "        return NamedSharding(mesh, P('model'))\n"
+           "    return NamedSharding(mesh, P('data')), inner\n")
+    assert lint_source(src, "t.py").findings == []
+
+
+def test_gl013_invariant_len_not_flagged():
+    """len() of a container that is never mutated inside a loop is
+    loop-invariant: one program, no recompile hazard — whether the
+    container is a parameter or a name bound once BEFORE the loop."""
+    src = ("from functools import partial\n"
+           "import jax\n"
+           "import jax.numpy as jnp\n"
+           "@partial(jax.jit, static_argnames=('n',))\n"
+           "def window(x, n):\n"
+           "    return x[:n] * jnp.ones((n,))\n"
+           "def f(x, vocab, steps):\n"
+           "    outs = []\n"
+           "    for _ in range(steps):\n"
+           "        outs.append(window(x, len(vocab)))\n"
+           "    return outs\n"
+           "def g(x, steps):\n"
+           "    vocab = sorted(set('abc'))\n"      # bound pre-loop: invariant
+           "    for _ in range(steps):\n"
+           "        x = window(x, len(vocab))\n"
+           "    return x\n")
+    assert lint_source(src, "t.py").findings == []
+
+
+def test_gl014_caller_local_sharing_global_name_not_flagged():
+    """A caller parameter that merely shares the captured global's name
+    is a different binding — donating it is fine."""
+    src = ("from functools import partial\n"
+           "import jax\n"
+           "import jax.numpy as jnp\n"
+           "state = jnp.zeros((8,))  # graftlint: disable=GL002\n"
+           "@partial(jax.jit, donate_argnames=('s',))\n"
+           "def step(s):\n"
+           "    return s + state\n"
+           "def caller(state):\n"
+           "    return step(state)\n")
+    res = lint_source(src, "t.py", ["GL014"])
+    assert res.findings == []
+    # ...while the real capture-and-donate still fires
+    bad = src.replace("def caller(state):\n    return step(state)",
+                      "def caller():\n    return step(state)")
+    res = lint_source(bad, "t.py", ["GL014"])
+    assert [f.rule for f in res.findings] == ["GL014"]
+
+
+def test_cli_write_baseline_rejects_changed_scope(tmp_path):
+    """--write-baseline from a --changed view would silently drop every
+    entry in unchanged files; the combination is refused."""
+    from replicatinggpt_tpu.cli import main
+    assert main(["lint", "--baseline", str(tmp_path / "b.json"),
+                 "--write-baseline", "--changed", "HEAD"]) == 2
+    assert not (tmp_path / "b.json").exists()
+
+
+def test_cli_write_committed_baseline_rejects_path_scope():
+    """Writing the COMMITTED baseline from a path-restricted lint would
+    drop every entry outside those paths (and pass the ratchet, since
+    the set only shrinks) — refused. A custom --baseline PATH may still
+    scope freely (exercised in test_cli_write_baseline_ratchets)."""
+    from replicatinggpt_tpu.analysis import DEFAULT_BASELINE
+    from replicatinggpt_tpu.cli import main
+    before = DEFAULT_BASELINE.read_text()
+    assert main(["lint", "--write-baseline",
+                 "replicatinggpt_tpu/analysis"]) == 2
+    assert DEFAULT_BASELINE.read_text() == before
+
+
+def test_gl010_local_mesh_shadowing_not_checked():
+    """A function parameter (or non-Mesh local rebind) sharing a module
+    mesh's name is a DIFFERENT, unknown mesh — its specs are exempt."""
+    src = ("import numpy as np\n"
+           "from jax.sharding import Mesh, NamedSharding, "
+           "PartitionSpec as P\n"
+           "DEVS = [0]\n"
+           "mesh = Mesh(np.asarray(DEVS), ('data',))\n"
+           "def from_param(mesh, batch):\n"
+           "    return NamedSharding(mesh, P('model'))\n"
+           "def from_rebind(cfg):\n"
+           "    mesh = cfg.build_mesh()\n"
+           "    return NamedSharding(mesh, P('model'))\n"
+           "def from_module():\n"
+           "    return NamedSharding(mesh, P('model'))\n")
+    res = lint_source(src, "t.py", ["GL010"])
+    # only from_module (the function actually using the module mesh)
+    # fires — its return is source line 11
+    assert [f.line for f in res.findings] == [11]
+
+
+def test_transitive_search_not_poisoned_by_depth_limit():
+    """A deep chain truncated at the depth limit must not cache
+    'no sync' for its tail — a later, shallower query through the same
+    tail still finds the real chain (results must not depend on the
+    order functions are analyzed)."""
+    chain = "def f0(m):\n    return f1(m)\n"
+    for i in range(1, 5):
+        chain += f"def f{i}(m):\n    return f{i + 1}(m)\n"
+    chain += "def f5(m):\n    return m.item()\n"
+    src = (chain
+           + "def long_loop(step, s, bs):\n"
+             "    for b in bs:\n"
+             "        s, m = step(s, b)\n"
+             "        f0(m)\n"                 # 6 hops: beyond the limit
+             "    return s\n"
+             "def short_loop(step, s, bs):\n"
+             "    for b in bs:\n"
+             "        s, m = step(s, b)\n"
+             "        f4(m)\n"                 # 2 hops: must still fire
+             "    return s\n")
+    res = lint_source(src, "t.py", ["GL004"])
+    # exactly one finding: the f4(m) call in short_loop (line 21); the
+    # 6-hop f0 chain is beyond the depth limit and must stay silent
+    # without poisoning f4's memo entry
+    assert [f.line for f in res.findings] == [21]
+    # and with the query order reversed the answer is identical
+    flipped = src.replace("long_loop", "zz_loop")
+    res2 = lint_source(flipped, "t.py", ["GL004"])
+    assert len(res2.findings) == 1
+
+
+def test_gl014_fires_at_module_scope():
+    """The rule's own documented bad example: module-scope donation of
+    the captured global must fire (module 'locals' ARE the globals)."""
+    src = ("from functools import partial\n"
+           "import jax\n"
+           "import jax.numpy as jnp\n"
+           "state = jnp.zeros((8,))  # graftlint: disable=GL002\n"
+           "@partial(jax.jit, donate_argnames=('s',))\n"
+           "def step(s):\n"
+           "    return s + state\n"
+           "out = step(state)\n")
+    res = lint_source(src, "t.py", ["GL014"])
+    assert [f.rule for f in res.findings] == ["GL014"]
+
+
+def test_conditional_sync_inside_helper_does_not_propagate():
+    """The conditional-sync exemption applies at the SYNC side too: a
+    cadence-guarded float() inside the helper is intentional, so an
+    unconditional call to that helper from a loop stays clean."""
+    src = ("def helper(x, step):\n"
+           "    if step % 100 == 0:\n"
+           "        print(float(x))\n"
+           "def loop(step_fn, s, bs):\n"
+           "    for i, b in enumerate(bs):\n"
+           "        s, m = step_fn(s, b)\n"
+           "        helper(m, i)\n"
+           "    return s\n")
+    assert lint_source(src, "t.py", ["GL004"]).findings == []
+
+
+def test_duplicate_targets_lint_once():
+    """Overlapping explicit targets (dir + file inside it, a file
+    twice) must not inflate finding counts."""
+    bad = FIXTURES / "bad_gl001.py"
+    once = lint_paths([bad], severity=NO_TIERS)
+    twice = lint_paths([bad, bad, FIXTURES], severity=NO_TIERS)
+    per_file = [f for f in twice.findings
+                if f.path.endswith("bad_gl001.py")]
+    assert len(per_file) == len(once.findings)
+
+
+def test_gl005_augassign_reads_donated_buffer():
+    """`state += 1` after donating state READS the freed buffer even
+    though the AST target carries Store ctx."""
+    src = ("from functools import partial\n"
+           "import jax\n"
+           "@partial(jax.jit, donate_argnames=('state',))\n"
+           "def step(state, batch):\n"
+           "    return state\n"
+           "def f(state, batch):\n"
+           "    out = step(state, batch)\n"
+           "    state += 1\n"
+           "    return out, state\n")
+    res = lint_source(src, "t.py", ["GL005"])
+    assert [f.line for f in res.findings] == [8]
+
+
+def test_gl005_terminal_else_branch_does_not_leak():
+    """A donation inside an else-branch that returns never reaches the
+    fall-through code — the read after the If is only on the
+    non-donating path."""
+    src = ("from functools import partial\n"
+           "import jax\n"
+           "@partial(jax.jit, donate_argnames=('state',))\n"
+           "def train_step(state, batch):\n"
+           "    return state\n"
+           "def f(state, batch, cond):\n"
+           "    if cond:\n"
+           "        out = batch\n"
+           "    else:\n"
+           "        return train_step(state, batch)\n"
+           "    return out, state.mean()\n")
+    assert lint_source(src, "t.py", ["GL005"]).findings == []
+
+
+def test_gl010_mesh_rebind_is_unknown():
+    """Rebinding a mesh name (flow-insensitive analysis) makes it
+    unknown — neither construction's axes may be checked against
+    either spec."""
+    src = ("import numpy as np\n"
+           "from jax.sharding import Mesh, NamedSharding, "
+           "PartitionSpec as P\n"
+           "def f(devs):\n"
+           "    mesh = Mesh(np.asarray(devs), ('data',))\n"
+           "    s1 = NamedSharding(mesh, P('data'))\n"
+           "    mesh = Mesh(np.asarray(devs), ('model',))\n"
+           "    s2 = NamedSharding(mesh, P('model'))\n"
+           "    return s1, s2\n")
+    assert lint_source(src, "t.py", ["GL010"]).findings == []
+    # consistent rebinding stays known: a genuine mismatch still fires
+    same = src.replace("('model',)", "('data',)").replace("P('model')",
+                                                          "P('bogus')")
+    assert [f.rule for f in lint_source(same, "t.py", ["GL010"]).findings] \
+        == ["GL010"]
+
+
+def test_lint_changed_wrapper_survives_symlink(tmp_path):
+    """Installed as a .git/hooks symlink, the wrapper must still cd to
+    the real repo root (dirname of the symlink is .git/hooks)."""
+    import subprocess
+    link = tmp_path / "pre-push"
+    link.symlink_to(REPO / "tools" / "lint_changed.sh")
+    proc = subprocess.run([str(link), "HEAD"], capture_output=True,
+                          text=True, timeout=120, cwd=tmp_path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # a mistyped single-argument ref fails loudly (matching the CLI's
+    # --changed behavior) instead of silently linting the default base
+    typo = subprocess.run([str(link), "orgin/main"], capture_output=True,
+                          text=True, timeout=120, cwd=tmp_path)
+    assert typo.returncode != 0 and "does not resolve" in typo.stderr
+
+
+def test_gl012_static_args_excluded_from_arity():
+    """in_shardings zips against DYNAMIC args only — a static param
+    doesn't count toward the expected spec arity."""
+    src = ("from functools import partial\n"
+           "import jax\n"
+           "@partial(jax.jit, static_argnames=('n',),\n"
+           "         in_shardings=(None,))\n"
+           "def f(x, n):\n"
+           "    return x[:n]\n")
+    assert lint_source(src, "t.py", ["GL012"]).findings == []
+    # ...but a genuinely short tuple still fires
+    bad = src.replace("def f(x, n):", "def f(x, y, n):")
+    assert [f.rule for f in lint_source(bad, "t.py", ["GL012"]).findings] \
+        == ["GL012"]
+
+
+def test_pragma_at_sync_site_stops_propagation():
+    """A reviewed pragma on the sync line also blesses every caller —
+    summaries drop pragma-suppressed sites before propagation."""
+    src = ("def helper(m):\n"
+           "    return m.item()  # graftlint: disable=GL004\n"
+           "def loop(step, s, bs):\n"
+           "    for b in bs:\n"
+           "        s, m = step(s, b)\n"
+           "        helper(m)\n"
+           "    return s\n")
+    res = lint_source(src, "t.py")
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas / severity tiers
+# ---------------------------------------------------------------------------
+
+
 def test_line_pragma_suppresses():
-    res = lint_paths([FIXTURES / "suppressed_line.py"])
+    res = lint_paths([FIXTURES / "suppressed_line.py"], severity=NO_TIERS)
     assert res.findings == []
     assert [f.rule for f in res.suppressed] == ["GL004"]
 
 
 def test_file_pragma_suppresses():
-    res = lint_paths([FIXTURES / "suppressed_file.py"])
+    res = lint_paths([FIXTURES / "suppressed_file.py"], severity=NO_TIERS)
     assert res.findings == []
     assert {f.rule for f in res.suppressed} == {"GL004"}
 
@@ -76,19 +441,127 @@ def test_syntax_error_reported_not_raised():
     assert [f.rule for f in res.findings] == ["GL000"]
 
 
-def test_baseline_matches_fresh_whole_package_run():
+def test_severity_tiers_demote_tests_to_warnings():
+    """The same hazard is an error in the package and a warning under
+    tests/ — reported, never gating, never baselined."""
+    src = ("import numpy as np\n"
+           "def f(xs):\n"
+           "    for x in xs:\n"
+           "        np.asarray(x)\n")
+    pkg = lint_source(src, "replicatinggpt_tpu/somewhere.py")
+    assert [f.rule for f in pkg.findings] == ["GL004"] and not pkg.warnings
+    tst = lint_source(src, "tests/test_somewhere.py")
+    assert not tst.findings
+    assert [f.rule for f in tst.warnings] == ["GL004"]
+    assert tst.warnings[0].severity == "warning"
+    # the knob: longest prefix wins, overridable per directory
+    assert severity_for("tests/x.py", DEFAULT_SEVERITY) == "warning"
+    assert severity_for("bench.py", DEFAULT_SEVERITY) == "error"
+    custom = {"tests/": "warning", "tests/perf/": "error"}
+    assert severity_for("tests/perf/x.py", custom) == "error"
+
+
+def test_default_discovery_covers_bench_tools_tests():
+    """bench.py, tools/ and tests/ no longer escape the rules (tests at
+    warning tier); intentional fixture trees are pruned from discovery."""
+    res = lint_paths([])
+    labels = {f.path for f in (*res.findings, *res.warnings)}
+    assert any(p.startswith("tests/") for p in labels)
+    assert not any("fixtures" in p for p in labels)
+    assert all(f.path.startswith("tests/") for f in res.warnings)
+    assert not any(f.path.startswith("tests/") for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# baseline: exact gate, set semantics, dedupe, ratchet
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_matches_fresh_whole_project_run():
     """The committed graftlint_baseline.json must EXACTLY equal a fresh
-    run over the package: a new finding fails CI, and a fixed finding
+    run over the project: a new finding fails CI, and a fixed finding
     must be removed from the baseline (no silent staleness in either
     direction). Refresh with `python -m replicatinggpt_tpu lint
     --write-baseline`."""
-    res = lint_paths([])                      # default: the package
+    res = lint_paths([])                      # default: the whole project
     diff = diff_against_baseline(res.findings,
                                  load_baseline(DEFAULT_BASELINE))
     assert diff.exact, {
         "new": [f.format() for f in diff.new],
         "stale": diff.stale,
     }
+
+
+def test_baseline_writer_dedupes_and_sorts(tmp_path):
+    """Two findings with one key become ONE entry (the v1 duplicate-entry
+    bug), and entries come out stably sorted so baseline diffs review as
+    plain add/remove lines."""
+    mk = lambda path, rule, line, text: Finding(  # noqa: E731
+        path=path, rule=rule, line=line, col=0, message="m", text=text)
+    findings = [mk("b.py", "GL004", 9, "x = f()"),
+                mk("a.py", "GL004", 5, "y = g()"),
+                mk("b.py", "GL004", 9, "x = f()"),     # duplicate key
+                mk("a.py", "GL003", 2, "k = h()")]
+    out = tmp_path / "base.json"
+    n = write_baseline(findings, out)
+    data = json.loads(out.read_text())
+    assert n == 3 and len(data["findings"]) == 3
+    keys = [(e["path"], e["line"], e["rule"]) for e in data["findings"]]
+    assert keys == sorted(keys)
+    # one deduped entry still absorbs BOTH same-key findings on re-lint
+    diff = diff_against_baseline(findings, load_baseline(out))
+    assert diff.exact and diff.matched == 4
+
+
+def test_baseline_ratchet_refuses_growth(tmp_path):
+    mk = lambda text: Finding(path="p.py", rule="GL004", line=1,  # noqa: E731
+                              col=0, message="m", text=text)
+    committed = tmp_path / "base.json"
+    write_baseline([mk("old")], committed)
+    assert check_ratchet([mk("old")], committed) == []           # hold
+    assert check_ratchet([], committed) == []                    # shrink
+    grown = check_ratchet([mk("old"), mk("NEW")], committed)     # grow
+    assert grown == [("p.py", "GL004", "NEW")]
+    assert check_ratchet([mk("x")], tmp_path / "absent.json") == []
+
+
+def test_cli_write_baseline_ratchets(tmp_path):
+    """`--write-baseline` exits non-zero (and leaves the file alone)
+    when the refresh would add an entry; --allow-growth overrides."""
+    from replicatinggpt_tpu.cli import main
+    base = tmp_path / "base.json"
+    bad = FIXTURES / "bad_gl006.py"
+    ok = FIXTURES / "good_gl006.py"
+    sev = ["--severity", "tests/=error"]
+    assert main(["lint", "--baseline", str(base), "--write-baseline",
+                 str(ok)] + sev) == 0          # bootstrap: empty baseline
+    before = base.read_text()
+    assert main(["lint", "--baseline", str(base), "--write-baseline",
+                 str(bad)] + sev) == 2         # would grow: refused
+    assert base.read_text() == before
+    assert main(["lint", "--baseline", str(base), "--write-baseline",
+                 "--allow-growth", str(bad)] + sev) == 0
+    assert json.loads(base.read_text())["findings"]
+
+
+def test_baseline_diff_mechanics():
+    """New / matched / stale bookkeeping on a synthetic baseline (set
+    semantics: one key absorbs all findings with that key)."""
+    res = lint_paths([FIXTURES / "bad_gl001.py"], severity=NO_TIERS)
+    base = {finding_key(f) for f in res.findings}
+    exact = diff_against_baseline(res.findings, base)
+    assert exact.exact and exact.matched == len(res.findings)
+    # drop one entry -> that finding is NEW; add a bogus one -> stale
+    k = finding_key(res.findings[0])
+    short = (base - {k}) | {("x.py", "GL001", "nope")}
+    diff = diff_against_baseline(res.findings, short)
+    assert len(diff.new) == 1 and not diff.exact
+    assert ("x.py", "GL001", "nope") in diff.stale
+
+
+# ---------------------------------------------------------------------------
+# CLI: gate, json, sarif, --changed
+# ---------------------------------------------------------------------------
 
 
 def test_cli_gate_in_process():
@@ -108,31 +581,82 @@ def test_cli_gate_subprocess():
 def test_cli_fails_on_new_finding():
     from replicatinggpt_tpu.cli import main
     bad = FIXTURES / "bad_gl004.py"
-    assert main(["lint", str(bad)]) == 1
+    sev = ["--severity", "tests/=error"]
+    assert main(["lint", str(bad)] + sev) == 1
     assert main(["lint", "--baseline", str(DEFAULT_BASELINE),
-                 str(bad)]) == 1              # fixtures aren't baselined
+                 str(bad)] + sev) == 1        # fixtures aren't baselined
 
 
 def test_cli_json_reflects_baseline_diff(capsys):
     """Under --baseline, the JSON payload must agree with the exit
     code: `findings` holds only NEW hazards (empty on a clean tree),
-    absorbed ones appear as a `baselined` count."""
+    absorbed ones appear as a `baselined` count, warnings ride along
+    without gating."""
     from replicatinggpt_tpu.cli import main
     rc = main(["lint", "--baseline", "--format", "json"])
     out = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert out["findings"] == []
     assert out["baselined"] > 0 and out["stale"] == []
+    assert all(w["path"].startswith("tests/") for w in out["warnings"])
 
 
 def test_cli_json_format(capsys):
     from replicatinggpt_tpu.cli import main
-    rc = main(["lint", "--format", "json", str(FIXTURES / "bad_gl006.py")])
+    rc = main(["lint", "--format", "json", "--severity", "tests/=error",
+               str(FIXTURES / "bad_gl006.py")])
     out = json.loads(capsys.readouterr().out)
     assert rc == 1
     assert out["files"] == 1
     assert all(f["rule"] == "GL006" for f in out["findings"])
     assert len(out["findings"]) >= 2          # both dus spellings
+
+
+def test_cli_sarif_shape(capsys):
+    """`--format sarif` emits the SARIF 2.1.0 shape: version, one run
+    with a tool.driver carrying the full rule table, and results whose
+    locations use physicalLocation/artifactLocation/region."""
+    from replicatinggpt_tpu.cli import main
+    rc = main(["lint", "--format", "sarif", "--severity", "tests/=error",
+               "--no-baseline", str(FIXTURES / "bad_gl004.py")])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0" and "sarif" in doc["$schema"]
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    assert {r["id"] for r in driver["rules"]} == set(RULE_IDS)
+    assert run["results"], "bad fixture must produce results"
+    for r in run["results"]:
+        assert r["ruleId"] in RULES and r["level"] in ("error", "warning")
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+        assert r["message"]["text"]
+        # ruleIndex must agree with the driver rule table
+        assert driver["rules"][r["ruleIndex"]]["id"] == r["ruleId"]
+
+
+def test_cli_sarif_clean_under_baseline(capsys):
+    from replicatinggpt_tpu.cli import main
+    rc = main(["lint", "--baseline", "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    errors = [r for r in doc["runs"][0]["results"]
+              if r["level"] == "error"]
+    assert errors == []                       # baselined: no new errors
+
+
+def test_cli_changed_mode(capsys):
+    """--changed HEAD on a clean tree reports nothing; with a bogus ref
+    it fails loudly rather than linting the wrong scope."""
+    from replicatinggpt_tpu.cli import main
+    rc = main(["lint", "--baseline", "--changed", "HEAD"])
+    assert rc == 0
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        main(["lint", "--changed", "definitely-not-a-ref-xyz"])
 
 
 def test_docs_generated_from_registry_in_sync():
@@ -143,20 +667,3 @@ def test_docs_generated_from_registry_in_sync():
         "docs/graftlint_rules.md`")
     for rid in RULE_IDS:                      # every rule documented
         assert f"## {rid}" in committed
-
-
-def test_baseline_diff_mechanics():
-    """New / matched / stale bookkeeping on a synthetic baseline."""
-    res = lint_paths([FIXTURES / "bad_gl001.py"])
-    from collections import Counter
-    from replicatinggpt_tpu.analysis import finding_key
-    base = Counter(finding_key(f) for f in res.findings)
-    exact = diff_against_baseline(res.findings, base)
-    assert exact.exact and exact.matched == len(res.findings)
-    # drop one entry -> that finding is NEW; add a bogus one -> stale
-    k = finding_key(res.findings[0])
-    short = base - Counter([k])
-    short[("x.py", "GL001", "nope")] += 1
-    diff = diff_against_baseline(res.findings, short)
-    assert len(diff.new) == 1 and not diff.exact
-    assert ("x.py", "GL001", "nope") in diff.stale

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Diff-aware graftlint: lint only the files that changed vs a ref
+# (default origin/main, falling back to main, then HEAD), with the
+# whole project still indexed so cross-file dataflow stays sound.
+# Intended as a pre-push hook:
+#   ln -s ../../tools/lint_changed.sh .git/hooks/pre-push
+set -euo pipefail
+# resolve symlinks first: installed as .git/hooks/pre-push, $0's dirname
+# would otherwise land us in .git/
+cd "$(dirname "$(readlink -f "$0")")/.."
+
+# As a pre-push hook git invokes us as `pre-push <remote-name> <url>` —
+# those are not refs; only honor $1 when invoked manually with a single
+# argument. A single argument that does NOT resolve to a commit is a
+# typo: fail loudly rather than silently linting against the default.
+ref=""
+if [ "$#" -eq 1 ]; then
+    if ! git rev-parse --verify --quiet "$1^{commit}" >/dev/null; then
+        echo "lint_changed.sh: '$1' does not resolve to a commit" >&2
+        exit 2
+    fi
+    ref="$1"
+fi
+if [ -z "$ref" ]; then
+    for cand in origin/main main HEAD; do
+        if git rev-parse --verify --quiet "$cand^{commit}" >/dev/null; then
+            ref="$cand"
+            break
+        fi
+    done
+fi
+
+exec python -m replicatinggpt_tpu lint --baseline --changed "$ref"
