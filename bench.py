@@ -391,7 +391,12 @@ def bench_serve(args) -> None:
                         n_pages=args.serve_n_pages)
     summary = run_replay(state.params, cfg.model, rcfg, ecfg,
                          draft_params=draft_params, draft_cfg=draft_cfg,
-                         resilience=DEFAULT_SERVE_RESILIENCE)
+                         resilience=DEFAULT_SERVE_RESILIENCE,
+                         trace_out=args.trace_out,
+                         metrics_timeline=args.metrics_timeline,
+                         metrics_out=args.metrics_out)
+    if "artifacts" in summary:
+        log(f"observability artifacts: {summary['artifacts']}")
     h = summary["histograms"]
     sp = summary.get("speculative") or {}
     pg = summary["pages"]
@@ -456,6 +461,11 @@ def bench_serve(args) -> None:
                                "spec_reprobes", "shed_requests")},
         **({"speculative": sp} if sp else {}),
         **({"prefix_ab": prefix_ab} if prefix_ab else {}),
+        # observability artifacts (utils.telemetry): paths + counts of
+        # the Perfetto trace / metrics timeline / Prometheus text this
+        # run emitted, so the dashboard can link the evidence
+        **({"artifacts": summary["artifacts"]}
+           if "artifacts" in summary else {}),
     })
 
 
@@ -826,6 +836,17 @@ def main() -> None:
     p.add_argument("--serve-n-pages", type=int, default=0,
                    help="--mode serve: physical KV pages (0 = "
                         "pool * pages-per-slot, the contiguous pool's HBM)")
+    p.add_argument("--trace-out", default=None,
+                   help="--mode serve: write a Perfetto-loadable Chrome "
+                        "trace of the replay (one span tree per request "
+                        "on per-slot tracks; docs/observability.md) — "
+                        "path lands in the artifact JSON")
+    p.add_argument("--metrics-timeline", default=None,
+                   help="--mode serve: write a JSONL time series of "
+                        "every engine counter/gauge/histogram")
+    p.add_argument("--metrics-out", default=None,
+                   help="--mode serve: write end-of-run metrics as "
+                        "Prometheus text exposition")
     p.add_argument("--spec", action="store_true",
                    help="--mode serve: speculative decoding over a "
                         "repetitive greedy trace (n-gram drafter unless "

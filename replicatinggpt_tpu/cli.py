@@ -74,6 +74,10 @@ def cmd_train(args) -> int:
             raise KeyboardInterrupt
         stop.set()
 
+    telemetry = None
+    if args.trace_out:
+        from .utils.telemetry import Telemetry
+        telemetry = Telemetry()
     prev_handlers = {}
     for sig in (signal.SIGTERM, signal.SIGINT):
         prev_handlers[sig] = signal.signal(sig, _on_signal)
@@ -81,10 +85,16 @@ def cmd_train(args) -> int:
         res = train(cfg, mesh=mesh, logger=logger, checkpoint_manager=ck,
                     resume=args.resume, profile_dir=args.profile_dir,
                     profile_start=args.profile_start,
-                    profile_steps=args.profile_steps, stop_event=stop)
+                    profile_steps=args.profile_steps, stop_event=stop,
+                    telemetry=telemetry)
     finally:
         for sig, h in prev_handlers.items():
             signal.signal(sig, h)
+        if telemetry is not None:
+            n = telemetry.export_chrome_trace(args.trace_out)
+            telemetry.close()
+            print(f"telemetry: {n} trace events -> {args.trace_out} "
+                  f"(open in Perfetto)", file=sys.stderr)
     if args.sample_after:
         _sample(res.state.params, cfg, res.tokenizer, args.sample_tokens,
                 mesh=mesh)
@@ -266,8 +276,18 @@ def cmd_serve_replay(args) -> int:
           f"{cfg.model.n_embd}C on {dev.platform} ({dev.device_kind})",
           file=sys.stderr)
     summary = run_replay(state.params, cfg.model, rcfg, ecfg,
-                         draft_params=draft_params, draft_cfg=draft_cfg)
+                         draft_params=draft_params, draft_cfg=draft_cfg,
+                         trace_out=args.trace_out,
+                         metrics_timeline=args.metrics_timeline,
+                         metrics_timeline_interval_s=(
+                             args.metrics_timeline_interval),
+                         metrics_out=args.metrics_out,
+                         profile_dir=args.profile_dir,
+                         profile_start=args.profile_start,
+                         profile_steps=args.profile_steps)
     print(format_summary(summary))
+    for k, v in summary.get("artifacts", {}).items():
+        print(f"artifact {k}: {v}", file=sys.stderr)
     if args.json:
         print(json.dumps(summary))
     return 0
@@ -331,6 +351,10 @@ def main(argv=None) -> int:
     pt.add_argument("--profile-steps", type=int, default=5)
     pt.add_argument("--profile-port", type=int, default=0,
                     help="start a live profiler server on this port")
+    pt.add_argument("--trace-out", default=None,
+                    help="write a Perfetto-loadable Chrome trace of the "
+                         "host timeline (dispatch/eval spans, checkpoint "
+                         "markers) here — the host half of --profile-dir")
     pt.add_argument("--rng-impl", default=None,
                     choices=["threefry2x32", "rbg"],
                     help="dropout PRNG; 'rbg' uses the TPU hardware "
@@ -429,6 +453,32 @@ def main(argv=None) -> int:
                          "prefix (the radix-prefix-cache traffic shape)")
     ps.add_argument("--json", action="store_true",
                     help="also print the summary as one JSON line")
+    ps.add_argument("--trace-out", default=None,
+                    help="write a Perfetto-loadable Chrome trace of the "
+                         "replay here: one span tree per request "
+                         "(submit -> queue -> admit -> prefill -> "
+                         "decode/verify -> finish) on per-slot tracks, "
+                         "with prefix-hit/COW/eviction/recovery markers "
+                         "(docs/observability.md)")
+    ps.add_argument("--metrics-timeline", default=None,
+                    help="write a JSONL time series of every engine "
+                         "counter/gauge/histogram here (one snapshot per "
+                         "--metrics-timeline-interval, plus first/last)")
+    ps.add_argument("--metrics-timeline-interval", type=float, default=0.5,
+                    help="seconds between metrics-timeline snapshots")
+    ps.add_argument("--metrics-out", default=None,
+                    help="write the end-of-run metrics as Prometheus "
+                         "text exposition here (the /metrics scrape "
+                         "format)")
+    ps.add_argument("--profile-dir", default=None,
+                    help="capture a jax.profiler device trace of a few "
+                         "engine steps here (same contract as the train "
+                         "subcommand; view in TensorBoard/Perfetto next "
+                         "to --trace-out)")
+    ps.add_argument("--profile-start", type=int, default=10,
+                    help="engine step the device capture opens at")
+    ps.add_argument("--profile-steps", type=int, default=5,
+                    help="engine steps the device capture covers")
     ps.set_defaults(fn=cmd_serve_replay)
 
     pe = sub.add_parser("eval", help="estimate train/val loss")

@@ -41,6 +41,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 from ..utils.logging import Metrics, StepLogger
+from ..utils.telemetry import NULL
 
 
 class NonFiniteLossError(FloatingPointError):
@@ -127,7 +128,7 @@ def supervised_train(cfg, *, checkpoint_manager, mesh=None,
                      supervision: SupervisionConfig = SupervisionConfig(),
                      max_rollbacks: int = 3, skip_window: int = 1,
                      metrics: Optional[Metrics] = None,
-                     resume: bool = False,
+                     resume: bool = False, telemetry=None,
                      **train_kwargs) -> SupervisedResult:
     """Run ``train()`` under loss supervision with automatic rollback.
 
@@ -135,13 +136,17 @@ def supervised_train(cfg, *, checkpoint_manager, mesh=None,
     offending window when the SAME step fails twice (a transient fault
     gets one clean replay first; only a repeat implicates the data).
     ``max_rollbacks`` bounds total recoveries before
-    :class:`SupervisionExhausted`. Extra ``train_kwargs`` pass through
+    :class:`SupervisionExhausted`. ``telemetry`` (utils.telemetry)
+    marks every rollback / data skip / exhaustion as an instant on the
+    same timeline the runner's dispatch spans land on, and is passed
+    through to ``train()``. Extra ``train_kwargs`` pass through
     to :func:`~replicatinggpt_tpu.train.runner.train`.
     """
     from ..train.runner import train      # lazy: runner imports faults
 
     logger = logger or StepLogger()
     metrics = metrics or Metrics()
+    tel = telemetry or NULL
     failures_at: Dict[int, int] = {}
     skip = 0
     for attempt in range(max_rollbacks + 1):
@@ -149,7 +154,8 @@ def supervised_train(cfg, *, checkpoint_manager, mesh=None,
             res = train(cfg, mesh=mesh, logger=logger,
                         checkpoint_manager=checkpoint_manager,
                         resume=resume, supervision=supervision,
-                        skip_data_steps=skip, **train_kwargs)
+                        skip_data_steps=skip, telemetry=tel,
+                        **train_kwargs)
             for k, v in checkpoint_manager.recovery.items():
                 if v:
                     metrics.inc(k, v)
@@ -159,6 +165,8 @@ def supervised_train(cfg, *, checkpoint_manager, mesh=None,
             metrics.inc("rollbacks")
             step = getattr(e, "step", -1)
             failures_at[step] = failures_at.get(step, 0) + 1
+            tel.instant("rollback", step=step, attempt=attempt + 1,
+                        error=type(e).__name__)
             logger.log(f"supervisor: {e} — rollback "
                        f"{attempt + 1}/{max_rollbacks} to last good "
                        f"checkpoint")
@@ -166,6 +174,8 @@ def supervised_train(cfg, *, checkpoint_manager, mesh=None,
                 for k, v in checkpoint_manager.recovery.items():
                     if v:
                         metrics.inc(k, v)
+                tel.instant("supervision_exhausted", step=step,
+                            rollbacks=max_rollbacks + 1)
                 raise SupervisionExhausted(
                     f"training failed {max_rollbacks + 1} times "
                     f"(last: {e}); no recovery path left") from e
@@ -184,6 +194,7 @@ def supervised_train(cfg, *, checkpoint_manager, mesh=None,
                 restored = checkpoint_manager.latest_step() or 0
                 skip = max(step - restored, 0) + skip_window
                 metrics.inc("data_skips")
+                tel.instant("data_skip", step=step, skip=skip)
                 logger.log(f"supervisor: step {step} failed again after "
                            f"rollback; advancing data cursor {skip} "
                            f"step(s) (from checkpoint {restored} past "

@@ -20,6 +20,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Optional, Tuple
 
+from ..utils.telemetry import NULL
+
 
 @dataclass(frozen=True)
 class ResilienceConfig:
@@ -76,12 +78,19 @@ DEFAULT_SERVE_RESILIENCE = ResilienceConfig(stall_factor=4.0,
 
 
 class StepWatchdog:
-    """p99-budget stall detector over step wall times (bounded window)."""
+    """p99-budget stall detector over step wall times (bounded window).
 
-    def __init__(self, cfg: ResilienceConfig, window: int = 512):
+    ``telemetry`` (utils.telemetry) marks every detected stall as an
+    instant on the engine timeline — recovery events sit next to the
+    step spans they interrupted, instead of only incrementing a
+    counter someone reads after the run."""
+
+    def __init__(self, cfg: ResilienceConfig, window: int = 512,
+                 telemetry=None):
         self.cfg = cfg
         self.laps: Deque[float] = deque(maxlen=window)
         self._skip = cfg.stall_skip_steps
+        self.tel = telemetry or NULL
 
     def observe(self, dur_s: float) -> bool:
         """Record one step's wall time; True when it was a stall."""
@@ -99,6 +108,9 @@ class StepWatchdog:
             budget = max(self.cfg.stall_factor * p99,
                          self.cfg.stall_floor_s)
             stall = dur_s > budget
+            if stall:
+                self.tel.instant("watchdog_stall", dur_ms=dur_s * 1e3,
+                                 budget_ms=budget * 1e3)
         # the stalled lap still enters the window (a persistently slow
         # engine raises its own budget rather than alarming forever)
         self.laps.append(dur_s)
@@ -116,11 +128,12 @@ class SpecHealth:
     the only thing at stake is throughput, so the policy optimizes
     purely for that."""
 
-    def __init__(self, cfg: ResilienceConfig):
+    def __init__(self, cfg: ResilienceConfig, telemetry=None):
         self.cfg = cfg
         self.window: Deque[Tuple[int, int]] = deque(maxlen=cfg.spec_window)
         self.cooldown = 0
         self._next_cooldown = cfg.spec_reprobe_after
+        self.tel = telemetry or NULL
 
     def observe(self, drafted: int, accepted: int) -> bool:
         self.window.append((drafted, accepted))
@@ -133,6 +146,7 @@ class SpecHealth:
         return rate < self.cfg.spec_disable_threshold
 
     def on_disable(self) -> None:
+        self.tel.instant("spec_disable", cooldown=self._next_cooldown)
         self.window.clear()
         self.cooldown = self._next_cooldown
         self._next_cooldown = min(
@@ -142,21 +156,26 @@ class SpecHealth:
     def on_reenable(self) -> None:
         """A probe survived a full window: the drafter is healthy again —
         reset the backoff."""
+        self.tel.instant("spec_probe_healthy")
         self._next_cooldown = self.cfg.spec_reprobe_after
 
     def tick_disabled(self) -> bool:
         """One disabled step; True when it is time to re-probe."""
         self.cooldown -= 1
-        return self.cooldown <= 0
+        if self.cooldown <= 0:
+            self.tel.instant("spec_reprobe")
+            return True
+        return False
 
 
 class LoadShedder:
     """Sustained-overload detector: queue depth over the watermark for
     ``shed_patience`` consecutive steps -> shed down to the watermark."""
 
-    def __init__(self, cfg: ResilienceConfig):
+    def __init__(self, cfg: ResilienceConfig, telemetry=None):
         self.cfg = cfg
         self.streak = 0
+        self.tel = telemetry or NULL
 
     def observe(self, depth: int, max_queue: int) -> int:
         """Returns how many queued requests to shed this step (0 almost
@@ -169,4 +188,5 @@ class LoadShedder:
             return 0
         if self.streak < self.cfg.shed_patience:
             return 0
+        self.tel.instant("load_shed", n=depth - watermark, depth=depth)
         return depth - watermark

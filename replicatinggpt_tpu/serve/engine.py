@@ -65,6 +65,7 @@ from ..sample.generate import sample_tokens_batched
 from ..utils.logging import Metrics
 from ..utils.profiling import StepTimer, annotate
 from ..utils.sanitize import CompileGuard, check_in_bounds, sanitize_enabled
+from ..utils.telemetry import ENGINE_TRACK, NULL, SLOT_TRACK_BASE
 from .pages import PagedCachePool
 from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_LENGTH_CAP,
                        FINISH_MAX_TOKENS, FINISH_SHED, REJECT_BAD_REQUEST,
@@ -220,18 +221,29 @@ class Engine:
                  clock: Callable[[], float] = time.monotonic,
                  drafter: Optional[Drafter] = None,
                  rcfg: Optional[ResilienceConfig] = None,
-                 journal=None):
+                 journal=None, telemetry=None):
         """``rcfg`` (faults.watchdog.ResilienceConfig) opts into the
         self-healing policies — stall watchdog, speculative auto-disable
         with re-probe, load shedding; None/all-zero changes nothing.
         ``journal`` (serve.journal.RequestJournal) records accepted and
-        finished requests for restart recovery."""
+        finished requests for restart recovery. ``telemetry`` (a
+        utils.telemetry.Telemetry, ideally sharing this engine's
+        ``clock`` so request envelopes and step spans land on one
+        timeline) opts into request-lifecycle tracing: one span tree
+        per request on per-slot tracks plus step/draft spans and
+        prefix-hit/COW/eviction/recovery instants; None means the
+        zero-cost NULL recorder and changes nothing."""
         cfg.validate()
         self.params = params
         self.cfg = cfg
         self.ecfg = ecfg
         self.clock = clock
         self.drafter = drafter
+        self.tel = telemetry or NULL
+        if self.tel.enabled:
+            self.tel.name_track(ENGINE_TRACK, "engine")
+            for s in range(ecfg.pool_size):
+                self.tel.name_track(SLOT_TRACK_BASE + s, f"slot {s}")
         if drafter is not None:
             dcfg = getattr(drafter, "cfg", None)
             if dcfg is not None:       # model drafter: pools must line up
@@ -244,7 +256,7 @@ class Engine:
         self.pool = PagedCachePool(
             cfg, ecfg.pool_size, page_size=ecfg.page_size,
             max_pages=ecfg.max_pages, n_pages=ecfg.n_pages,
-            prefix_cache=ecfg.prefix_cache)
+            prefix_cache=ecfg.prefix_cache, telemetry=self.tel)
         self.scheduler = Scheduler(ecfg.max_queue, cfg.block_size,
                                    clock=clock)
         self.metrics = Metrics()
@@ -303,12 +315,12 @@ class Engine:
         self.rcfg = rcfg or ResilienceConfig()
         self.journal = journal
         self._spec_active = drafter is not None
-        self._watchdog = (StepWatchdog(self.rcfg)
+        self._watchdog = (StepWatchdog(self.rcfg, telemetry=self.tel)
                           if self.rcfg.watchdog_on else None)
-        self._spec_health = (SpecHealth(self.rcfg)
+        self._spec_health = (SpecHealth(self.rcfg, telemetry=self.tel)
                              if (self.rcfg.spec_guard_on
                                  and drafter is not None) else None)
-        self._shedder = (LoadShedder(self.rcfg)
+        self._shedder = (LoadShedder(self.rcfg, telemetry=self.tel)
                          if self.rcfg.shed_on else None)
         self._probe_pending = False
         self._spec_pinned = False     # operator pin (set_spec_active)
@@ -369,6 +381,7 @@ class Engine:
         self._pending = []
         now = self.clock()
         t_wall = time.perf_counter()
+        t_step_us = self.tel.now_us() if self.tel.enabled else 0.0
 
         for req, t_submit, reason in self.scheduler.drain_expired(now):
             finished.append(self._finish_unstarted(req, t_submit, reason,
@@ -445,6 +458,13 @@ class Engine:
                     self._event(f"step {self.n_steps}: stall — "
                                        f"{dur * 1e3:.1f} ms step against "
                                        f"a p99-derived budget")
+        if self.tel.enabled:
+            self.tel.complete("engine_step", ENGINE_TRACK, t_step_us,
+                              self.tel.now_us() - t_step_us,
+                              step=self.n_steps,
+                              queue_depth=self.scheduler.depth,
+                              n_active=int(self._active.sum()),
+                              n_finished=len(finished))
         return finished
 
     def set_spec_active(self, active: bool) -> None:
@@ -547,6 +567,7 @@ class Engine:
     def _admit(self, req: Request, t_submit: float, now: float) -> None:
         P = int(req.prompt.size)
         cap = self._cap(req)
+        t_admit_us = self.tel.now_us() if self.tel.enabled else 0.0
         # acquire claims the longest radix-cached prefix, reserves the
         # remaining pages, and sets pool.positions[slot] = P - 1 (which
         # self._pos aliases — the first decode rewrites the last prompt
@@ -554,10 +575,23 @@ class Engine:
         adm = self.pool.acquire(req.id, req.prompt, cap)
         assert adm is not None, "scheduler admitted past pool capacity"
         slot = adm.slot
+        tid = SLOT_TRACK_BASE + slot
+        if self.tel.enabled:
+            # the request's span tree opens BACKDATED to its submit
+            # time (viewers sort by ts, so out-of-order emission is
+            # fine); the queue phase closes it out to this admission
+            ts_sub = self.tel.ts_us(t_submit)
+            self.tel.begin("request", tid, ts_us=ts_sub, request=req.id,
+                           prompt_tokens=P, max_new_tokens=cap)
+            self.tel.complete("queue", tid, ts_sub,
+                              self.tel.ts_us(now) - ts_sub,
+                              request=req.id)
         for src, dst in adm.cow:
             # copy-on-write split of a fully-cached prompt's frontier
             # page; program warmed at construction (budget 1)
             check_in_bounds(dst, 1, self.pool.n_pages, what="COW page")
+            self.tel.instant("cow_split", tid, src=src, dst=dst,
+                             request=req.id)
             self.pool.cache = self._copy_guard(self.pool.cache,
                                                jnp.int32(src),
                                                jnp.int32(dst))
@@ -579,12 +613,22 @@ class Engine:
             cache = self.pool.cache
             with annotate("serve/prefill"):
                 for c in range(n_chunks):
+                    tc_us = (self.tel.now_us() if self.tel.enabled
+                             else 0.0)
                     cache = self._prefill_guard(
                         self.params,
                         jnp.asarray(padded[None,
                                            c * chunk:(c + 1) * chunk]),
                         jnp.int32(claimed + c * chunk), jnp.int32(P),
                         table_row, cache, self.cfg)
+                    if self.tel.enabled:
+                        # host dispatch time (the device runs async);
+                        # a jax.profiler capture of the same run shows
+                        # the device-side cost under serve/prefill
+                        self.tel.complete(
+                            "prefill_chunk", tid, tc_us,
+                            self.tel.now_us() - tc_us, chunk=c,
+                            n_chunks=n_chunks, request=req.id)
             self.pool.cache = cache
         # registration AFTER the prefill wrote the pages: a same-step
         # neighbor may claim them the moment they hit the radix
@@ -604,12 +648,18 @@ class Engine:
         self._slots[slot] = _Active(req=req, t_submit=t_submit, t_admit=now,
                                     cap=cap,
                                     capped=cap < req.max_new_tokens)
+        if self.tel.enabled:
+            self.tel.complete("admit", tid, t_admit_us,
+                              self.tel.now_us() - t_admit_us,
+                              request=req.id, cached_tokens=claimed,
+                              prefill_tokens=P - claimed)
         self.metrics.inc("requests_admitted")
         self.metrics.inc("prefill_tokens", P - claimed)
         self.metrics.inc("prefix_hit_tokens", claimed)
         self.metrics.observe("queue_wait_s", now - t_submit)
 
     def _decode_once(self) -> List[RequestResult]:
+        t0_us = self.tel.now_us() if self.tel.enabled else 0.0
         with annotate("serve/decode"):
             self.step_timer.start()
             nxt, cache, rngs = self._decode_guard(
@@ -640,11 +690,23 @@ class Engine:
                              n_active / self.ecfg.pool_size)
         self.metrics.inc("decode_steps")
         self.metrics.inc("decode_tokens", n_active)
+        tel_on = self.tel.enabled
+        if tel_on:
+            # end the span at ts_us(now) — the same clock reading the
+            # finish path stamps on a request's E event, so a slot's
+            # last decode span never spills past its request envelope
+            dur_us = self.tel.ts_us(now) - t0_us
+            self.tel.complete("decode_step", ENGINE_TRACK, t0_us, dur_us,
+                              step=self.n_steps, n_active=n_active)
         finished: List[RequestResult] = []
         for slot in list(self._slots):
             if not self._active[slot]:
                 continue
             st = self._slots[slot]
+            if tel_on:
+                self.tel.complete("decode", SLOT_TRACK_BASE + slot,
+                                  t0_us, dur_us, step=self.n_steps,
+                                  request=st.req.id)
             st.tokens.append(int(toks[slot]))
             if len(st.tokens) == 1:
                 st.t_first_token = now
@@ -687,8 +749,10 @@ class Engine:
             histories=(self._histories() if self.drafter.needs_history
                        else None))
         draft_toks, draft_len, dt = timed_draft(self.drafter, ctx,
-                                                self.cfg.vocab_size)
+                                                self.cfg.vocab_size,
+                                                tel=self.tel)
         self.metrics.observe("draft_overhead_s", dt)
+        t0_us = self.tel.now_us() if self.tel.enabled else 0.0
         m = np.zeros((P,), np.int32)
         for slot, st in self._slots.items():
             if not self._active[slot]:
@@ -745,6 +809,12 @@ class Engine:
         if drafted:
             self.metrics.observe("accept_rate", accepted / drafted)
         self.metrics.observe("tokens_per_slot_step", emitted / n_active)
+        tel_on = self.tel.enabled
+        if tel_on:
+            dur_us = self.tel.ts_us(now) - t0_us
+            self.tel.complete("verify_step", ENGINE_TRACK, t0_us, dur_us,
+                              step=self.n_steps, n_active=n_active,
+                              drafted=drafted, accepted=accepted)
         if self._spec_health is not None:
             if self._spec_health.observe(drafted, accepted):
                 # the drafter is a pure tax at this accept rate: fall
@@ -771,6 +841,11 @@ class Engine:
                 continue
             st = self._slots[slot]
             n_emit = int(n_acc_h[slot]) + 1
+            if tel_on:
+                self.tel.complete("verify", SLOT_TRACK_BASE + slot,
+                                  t0_us, dur_us, step=self.n_steps,
+                                  request=st.req.id, drafted=int(m[slot]),
+                                  committed=n_emit)
             first = not st.tokens
             st.tokens.extend(int(t) for t in out_h[slot, :n_emit])
             if first:
@@ -789,6 +864,10 @@ class Engine:
                      now: float) -> RequestResult:
         st = self._slots.pop(slot)
         self._active[slot] = False
+        if self.tel.enabled:
+            self.tel.end("request", SLOT_TRACK_BASE + slot,
+                         ts_us=self.tel.ts_us(now), request=st.req.id,
+                         reason=reason, n_tokens=len(st.tokens))
         self.pool.release(slot)
         if self.drafter is not None:
             self.drafter.on_release(slot)
@@ -809,6 +888,12 @@ class Engine:
 
     def _finish_unstarted(self, req: Request, t_submit: float, reason: str,
                           now: float) -> RequestResult:
+        # never admitted -> no slot track and no open envelope; one
+        # instant marks the terminal outcome on the engine timeline
+        self.tel.instant("request_unstarted", ENGINE_TRACK,
+                         ts_us=(self.tel.ts_us(now) if self.tel.enabled
+                                else None),
+                         request=req.id, reason=reason)
         self.metrics.inc(f"finished_{reason}")
         self._journal_finish(req.id, reason)
         return RequestResult(id=req.id, tokens=[], finish_reason=reason,

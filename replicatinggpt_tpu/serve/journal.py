@@ -67,10 +67,13 @@ class RequestJournal:
             self._f = None
 
     @staticmethod
-    def unfinished(path: str) -> List[Request]:
+    def unfinished(path: str, telemetry=None) -> List[Request]:
         """Replay a journal (possibly from a dead engine) and rebuild the
         accepted-but-unfinished requests, in admission order. Tolerates a
-        torn final line (the crash may have landed mid-write)."""
+        torn final line (the crash may have landed mid-write).
+        ``telemetry`` (utils.telemetry) marks the replay as an instant
+        on the recovered engine's timeline — restart recovery shows up
+        next to the requeued requests' span trees."""
         if not os.path.exists(path):
             return []
         submits: Dict[str, Request] = {}
@@ -101,4 +104,7 @@ class RequestJournal:
                         rng_seed=rec["rng_seed"])
                 elif rec.get("ev") == "finish":
                     submits.pop(rec["id"], None)
-        return [submits[rid] for rid in order if rid in submits]
+        out = [submits[rid] for rid in order if rid in submits]
+        if telemetry is not None and telemetry.enabled:
+            telemetry.instant("journal_replay", requeued=len(out))
+        return out

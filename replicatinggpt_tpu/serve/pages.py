@@ -45,6 +45,7 @@ import numpy as np
 
 from ..config import ModelConfig
 from ..models.gpt import init_paged_kv_pool
+from ..utils.telemetry import NULL
 from .cache_pool import commit_default
 
 
@@ -167,11 +168,14 @@ class PageAllocator:
     """
 
     def __init__(self, n_pages: int, page_size: int,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, telemetry=None):
         assert n_pages >= 1 and page_size >= 1
         self.n_pages = n_pages
         self.page_size = page_size
         self.prefix_cache = prefix_cache
+        # prefix-hit / eviction instants on the request timeline
+        # (utils.telemetry); NULL by default — zero cost, zero state
+        self.tel = telemetry or NULL
         self._free: List[int] = list(range(n_pages - 1, -1, -1))
         self.ref = np.zeros((n_pages,), np.int32)
         self.radix = RadixIndex()
@@ -223,6 +227,7 @@ class PageAllocator:
         del self.page_node[page]
         self._free.append(page)
         self.evictions += 1
+        self.tel.instant("page_evict", page=page)
         return page
 
     # ----------------------------------------------------------- acquire
@@ -280,6 +285,8 @@ class PageAllocator:
         claimed_tokens = len(chain) * self.page_size
         if chain:
             self.prefix_hits += 1
+            self.tel.instant("prefix_hit", pages=len(chain),
+                             tokens=claimed_tokens)
         self.prefix_hit_tokens += claimed_tokens
         return PageClaim(pages=pages, claimed_tokens=claimed_tokens,
                          chain=[n.id for n in chain], cow=cow,
@@ -344,7 +351,7 @@ class PagedCachePool:
 
     def __init__(self, cfg: ModelConfig, n_slots: int, *,
                  page_size: int = 0, max_pages: int = 0, n_pages: int = 0,
-                 prefix_cache: bool = True, dtype=None):
+                 prefix_cache: bool = True, dtype=None, telemetry=None):
         assert n_slots >= 1, n_slots
         self.cfg = cfg
         self.n_slots = n_slots
@@ -359,7 +366,8 @@ class PagedCachePool:
         assert self.n_pages >= self.max_pages, (
             "pool smaller than one slot's worst case")
         self.alloc = PageAllocator(self.n_pages, self.page_size,
-                                   prefix_cache=prefix_cache)
+                                   prefix_cache=prefix_cache,
+                                   telemetry=telemetry)
         self.cache: Dict = commit_default(init_paged_kv_pool(
             cfg, self.n_pages, self.page_size, dtype=dtype))
         # host-mirrored, device-fed each step (fixed shape: the paged

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..config import ModelConfig
 from ..utils.sanitize import sanitized
+from ..utils.telemetry import MetricsTimeline, Telemetry, prometheus_text
 from .engine import Engine, EngineConfig, compile_counts
 from .requests import Request, RequestResult, SamplingParams
 from .speculative import make_drafter
@@ -101,7 +102,14 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
                ecfg: EngineConfig, warmup: bool = True,
                draft_params=None,
                draft_cfg: Optional[ModelConfig] = None,
-               resilience=None, journal=None) -> dict:
+               resilience=None, journal=None,
+               trace_out: Optional[str] = None,
+               metrics_timeline: Optional[str] = None,
+               metrics_timeline_interval_s: float = 0.5,
+               metrics_out: Optional[str] = None,
+               profile_dir: Optional[str] = None,
+               profile_start: int = 10,
+               profile_steps: int = 5) -> dict:
     """Replay the trace in wall-clock time; returns the summary dict.
 
     ``warmup`` first pushes one tiny request through a throwaway engine
@@ -119,6 +127,20 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
     stateful, so each engine gets its own. ``journal`` (a
     serve.journal.RequestJournal) is handed to the replay engine for
     restart-recovery coverage.
+
+    Observability outputs (utils.telemetry; all off by default):
+    ``trace_out`` writes a Perfetto-loadable Chrome trace of the whole
+    replay (one span tree per request on per-slot tracks, recovery /
+    prefix-hit / COW / eviction instants); ``metrics_timeline`` writes
+    a JSONL time series of the engine's Metrics every
+    ``metrics_timeline_interval_s`` (plus one snapshot at attach and a
+    forced final one — >= 2 points always); ``metrics_out`` writes the
+    end-of-run Prometheus text exposition. ``profile_dir`` captures a
+    ``jax.profiler`` device trace of engine steps [profile_start,
+    profile_start + profile_steps) — the device-side half of the
+    timeline, with host spans linked by ``annotate`` region names.
+    Paths of everything written land in the summary's ``artifacts``
+    block (bench.py attaches it to the artifact JSON).
     """
     def drafter():
         return make_drafter(rcfg.spec, rcfg.spec_k, rcfg.spec_ngram,
@@ -141,32 +163,57 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
             w.drain()
     warm = compile_counts()
 
+    tel = Telemetry() if trace_out else None
     engine = Engine(params, mcfg, ecfg, drafter=drafter(),
-                    rcfg=resilience, journal=journal)
+                    rcfg=resilience, journal=journal, telemetry=tel)
+    timeline = None
+    if metrics_timeline:
+        timeline = MetricsTimeline(engine.metrics, metrics_timeline,
+                                   interval_s=metrics_timeline_interval_s)
+        timeline.snapshot(step=0)          # the t=0 anchor point
+    from ..utils.profiling import trace_window
+    profiler = trace_window(profile_dir, start=profile_start,
+                            n_steps=profile_steps)
     trace = make_trace(mcfg, rcfg)
     results: List[RequestResult] = []
     i = 0
+    n_trace_events = 0
     t0 = time.monotonic()
     # GRAFT_SANITIZE=1 runs the whole replay under jax's tracer-leak +
-    # NaN checks (no-op context otherwise)
-    with sanitized():
-        while len(results) < len(trace):
-            now = time.monotonic() - t0
-            while i < len(trace) and trace[i][0] <= now:
-                arr_t, req = trace[i]
-                if rcfg.deadline_s > 0:
-                    req.deadline = time.monotonic() + rcfg.deadline_s
-                rej = engine.submit(req)
-                if rej is not None:
-                    results.append(rej)
-                i += 1
-            if engine.idle:
-                if i >= len(trace):
-                    break
-                # nothing in flight: sleep to the next arrival
-                time.sleep(min(max(trace[i][0] - now, 0.0), 0.05))
-                continue
-            results.extend(engine.step())
+    # NaN checks (no-op context otherwise). Cleanup rides a finally: a
+    # replay that dies mid-run (injected fault, sanitize trip, Ctrl-C)
+    # must still stop the jax profiler (a started trace poisons the
+    # next start_trace in this process) and flush the trace/timeline
+    # artifacts — the crash window is exactly when they matter.
+    try:
+        with sanitized():
+            while len(results) < len(trace):
+                now = time.monotonic() - t0
+                while i < len(trace) and trace[i][0] <= now:
+                    arr_t, req = trace[i]
+                    if rcfg.deadline_s > 0:
+                        req.deadline = time.monotonic() + rcfg.deadline_s
+                    rej = engine.submit(req)
+                    if rej is not None:
+                        results.append(rej)
+                    i += 1
+                if engine.idle:
+                    if i >= len(trace):
+                        break
+                    # nothing in flight: sleep to the next arrival
+                    time.sleep(min(max(trace[i][0] - now, 0.0), 0.05))
+                    continue
+                profiler.step(engine.n_steps)
+                results.extend(engine.step())
+                if timeline is not None:
+                    timeline.maybe_snapshot(step=engine.n_steps)
+    finally:
+        profiler.close()
+        if tel is not None:
+            n_trace_events = tel.export_chrome_trace(trace_out)
+            tel.close()
+        if timeline is not None:
+            timeline.close(step=engine.n_steps)  # forced end-of-run point
     wall_s = time.monotonic() - t0
 
     done = compile_counts()
@@ -184,6 +231,27 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
         if wall_s > 0 else 0.0,
         "recompiles_after_warmup": sum(done.values()) - sum(warm.values()),
     })
+    artifacts = {}
+    if tel is not None:
+        artifacts["trace_out"] = trace_out
+        artifacts["trace_events"] = n_trace_events
+    if timeline is not None:
+        artifacts["metrics_timeline"] = metrics_timeline
+        artifacts["metrics_timeline_snapshots"] = timeline.n_snapshots
+    if metrics_out:
+        pages = summary.get("pages", {})
+        with open(metrics_out, "w") as f:
+            f.write(prometheus_text(
+                engine.metrics,
+                extra_gauges={k: pages[k] for k in
+                              ("pages_in_use", "page_utilization",
+                               "prefix_hit_rate", "radix_pages")
+                              if k in pages}))
+        artifacts["metrics_out"] = metrics_out
+    if profile_dir:
+        artifacts["profile_dir"] = profile_dir
+    if artifacts:
+        summary["artifacts"] = artifacts
     return summary
 
 

@@ -56,6 +56,7 @@ from ..models.gpt import (decode_step_multi, init_kv_cache, param_count,
 from ..ops.attention import NEG_INF
 from ..sample.generate import filter_logits_batched
 from ..utils.sanitize import CompileGuard, check_in_bounds
+from ..utils.telemetry import ENGINE_TRACK, NULL
 from .cache_pool import commit_default, prefill_chunk_size
 
 
@@ -377,11 +378,14 @@ def make_drafter(mode: str, k: int, ngram: int, pool_size: int,
 
 
 def timed_draft(drafter: Drafter, ctx: DraftContext,
-                vocab_size: int = 0
+                vocab_size: int = 0, tel=NULL
                 ) -> Tuple[np.ndarray, np.ndarray, float]:
     """``drafter.draft`` + wall-clock overhead (seconds) — the engine
     records it per step so the drafter's cost is visible next to the
-    verify step it amortizes.
+    verify step it amortizes. ``tel`` (utils.telemetry) additionally
+    records the draft phase as a span on the engine track, so the
+    drafter's host cost sits on the same timeline as the verify step
+    it feeds.
 
     Chaos seam ``spec/draft`` (kind ``collapse``): shifts every proposed
     token by one (mod the vocab), turning the drafter's proposals into
@@ -389,9 +393,14 @@ def timed_draft(drafter: Drafter, ctx: DraftContext,
     every token stays a valid vocab id, which is exactly the failure the
     engine's speculative auto-disable must catch. No-op without an
     installed FaultPlan."""
+    t0_us = tel.now_us() if tel.enabled else 0.0
     t0 = time.perf_counter()
     toks, lens = drafter.draft(ctx)
     f = fault_fire("spec/draft")
     if f is not None and f.kind == "collapse" and vocab_size > 1:
         toks = (toks + 1) % vocab_size
-    return toks, lens, time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    if tel.enabled:
+        tel.complete("draft", ENGINE_TRACK, t0_us, dt * 1e6,
+                     drafter=drafter.name, k=drafter.k)
+    return toks, lens, dt

@@ -27,6 +27,7 @@ from ..tokenizers import get_tokenizer
 from ..utils.logging import StepLogger
 from ..utils.sanitize import (CompileGuard, check_finite, sanitize_enabled,
                               sanitized)
+from ..utils.telemetry import ENGINE_TRACK, NULL
 from .state import TrainState, create_train_state
 from .steps import estimate_loss, make_eval_step, make_train_step
 
@@ -72,7 +73,7 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
           profile_start: int = 10, profile_steps: int = 5,
           stop_event=None,
           supervision: Optional[SupervisionConfig] = None,
-          skip_data_steps: int = 0) -> TrainResult:
+          skip_data_steps: int = 0, telemetry=None) -> TrainResult:
     """``stop_event`` (a ``threading.Event``-like object) requests a
     graceful stop: the loop finishes the in-flight dispatch, saves a
     checkpoint (when a manager is present), and returns normally — the
@@ -87,8 +88,17 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
     the last verified checkpoint — each check is one host sync, the
     price of detection latency. ``skip_data_steps`` (supervisor-driven)
     advances the data cursor that many optimizer steps after restore,
-    stepping past a data window that keeps blowing the loss up."""
+    stepping past a data window that keeps blowing the loss up.
+
+    ``telemetry`` (utils.telemetry.Telemetry) records the training
+    timeline: one span per dispatch (host dispatch time — the device
+    runs async; pair with ``profile_dir`` for the device-side view),
+    spans around eval passes, and instants at checkpoint saves — the
+    host half of a step-time attribution, exportable to Perfetto next
+    to the ``jax.profiler`` capture. None means the zero-cost NULL
+    recorder."""
     logger = logger or StepLogger()
+    tel = telemetry or NULL
     text = load_corpus(cfg.dataset)
     tokenizer = get_tokenizer(cfg.tokenizer, corpus_text=text,
                               cache_dir=os.path.dirname(cfg.dataset) or ".")
@@ -436,10 +446,12 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                     checkpoint_manager.save(state, cursor)
                 break
             if (tcfg.eval_interval and it % tcfg.eval_interval == 0):
-                losses = estimate_loss(state.params, eval_batchers, eval_step,
-                                       tcfg.eval_iters, device_put=dput,
-                                       eval_scan=eval_scan,
-                                       superbatch_put=superbatch_put)
+                with tel.span("train/eval", step=it):
+                    losses = estimate_loss(state.params, eval_batchers,
+                                           eval_step, tcfg.eval_iters,
+                                           device_put=dput,
+                                           eval_scan=eval_scan,
+                                           superbatch_put=superbatch_put)
                 logger.log_eval(it, losses["train"], losses["val"])
                 history.append((it, losses["train"], losses["val"]))
                 logger.reset_timer()
@@ -449,10 +461,18 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
             # cadences behave exactly as in the single-step loop; the feed
             # producer assembled this dispatch's batch to the same schedule
             chunk = chunk_at(it)
+            t_disp_us = tel.now_us() if tel.enabled else 0.0
             if chunk > 1:
                 state, metrics = train_scan(state, next(batches))
             else:
                 state, metrics = train_step(state, next(batches))
+            if tel.enabled:
+                # host dispatch time only: the device runs this chunk
+                # asynchronously (profile_dir's XLA capture carries the
+                # device-side cost; annotate-linked via span names)
+                tel.complete("train/dispatch", ENGINE_TRACK, t_disp_us,
+                             tel.now_us() - t_disp_us, step=it,
+                             chunk=chunk)
             prev_it, it = it, it + chunk
             tokens_seen += tokens_per_batch * chunk
             tokens_since_log += tokens_per_batch * chunk
@@ -493,6 +513,7 @@ def train(cfg: Config, *, mesh=None, logger: Optional[StepLogger] = None,
                     tokens_since_log = 0
             if (checkpoint_manager is not None and tcfg.checkpoint_every
                     and it % tcfg.checkpoint_every == 0):
+                tel.instant("train/checkpoint", step=it)
                 checkpoint_manager.save(state, cursor)
     finally:
         profiler.close()

@@ -105,12 +105,17 @@ class Metrics:
         i = min(int(q * (len(h) - 1) + 0.5), len(h) - 1)
         return h[i]
 
+    #: pinned hist_summary key schema (tests/test_telemetry.py) — the
+    #: telemetry exporters (Prometheus summaries, the metrics timeline)
+    #: index these keys directly, so a silent rename breaks a scrape
+    HIST_KEYS = ("n", "mean", "min", "p50", "p90", "p99", "max")
+
     def hist_summary(self, name: str) -> Dict[str, float]:
         h = self.hists.get(name, [])
         if not h:
-            return {"n": 0, "mean": 0.0, "p50": 0.0, "p90": 0.0,
-                    "p99": 0.0, "max": 0.0}
-        return {"n": len(h), "mean": sum(h) / len(h),
+            return {"n": 0, "mean": 0.0, "min": 0.0, "p50": 0.0,
+                    "p90": 0.0, "p99": 0.0, "max": 0.0}
+        return {"n": len(h), "mean": sum(h) / len(h), "min": min(h),
                 "p50": self.percentile(name, 0.50),
                 "p90": self.percentile(name, 0.90),
                 "p99": self.percentile(name, 0.99), "max": max(h)}

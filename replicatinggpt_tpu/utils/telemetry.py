@@ -1,0 +1,410 @@
+"""Request-lifecycle tracing + unified telemetry export.
+
+The serving engine's only evidence of where time goes used to be one
+end-of-run ``metrics_summary()`` dict and a raw ``jax.profiler`` trace
+with no request context. This module is the measurement substrate the
+scaling roadmap items lean on (per-phase timelines are how the pjit
+TPUv4 and Gemma-on-TPU serving playbooks attribute cost): a
+zero-cost-when-disabled event/span recorder plus three exporters.
+
+- :class:`Telemetry` — monotonic-clock span/instant recorder over a
+  bounded ring buffer (a soak run must not grow host memory without
+  bound — the ``Metrics`` reservoir rationale), with an optional
+  append-only JSONL sink whose reader tolerates a torn tail (the crash
+  window lands mid-write, exactly like ``serve.journal``). Spans taken
+  through :meth:`Telemetry.span` also enter ``profiling.annotate``, so
+  the same host region shows up on the XLA device timeline a
+  ``jax.profiler`` capture of the run produces — the two traces line
+  up by region name.
+- Chrome trace-event JSON (:meth:`Telemetry.export_chrome_trace` /
+  :func:`chrome_trace_from_jsonl`) — load the file straight into
+  Perfetto (ui.perfetto.dev) or ``chrome://tracing``. The serving
+  engine lays requests out as one span tree per request on per-slot
+  tracks: request B/E envelope, queue/admit/prefill/decode/verify
+  complete-events nested inside, prefix-hit/COW/eviction/recovery
+  instants on the same timeline.
+- Metrics snapshot timeline (:class:`MetricsTimeline`) — a periodic
+  JSONL time series of every counter/gauge/histogram in a
+  ``utils.logging.Metrics``, for soak runs where one end-of-run
+  summary hides the interesting transient.
+- Prometheus text exposition (:func:`prometheus_text`) — the scrape
+  format an HTTP front door serves from ``/metrics``.
+
+Zero-cost-when-disabled is load-bearing: the :data:`NULL` recorder is
+what every instrumented subsystem holds by default, its methods are
+no-ops, its ``span()`` returns one shared reusable null context (no
+per-call allocation), and nothing in this module performs a
+device->host sync — graftlint GL004-clean with zero pragmas (pinned in
+tests/test_telemetry.py, along with the no-buffer-growth property).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import re
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, TextIO
+
+#: engine-level track (steps, drafts, recovery markers); per-slot
+#: request trees live on SLOT_TRACK_BASE + slot
+ENGINE_TRACK = 0
+SLOT_TRACK_BASE = 1
+
+
+class _NullSpan:
+    """Reusable, reentrant no-op context manager (shared instance)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTelemetry:
+    """The disabled recorder: every method is a no-op, ``span`` hands
+    back one shared context manager, and no state ever accumulates.
+    Instrumented hot loops additionally guard whole blocks with
+    ``if tel.enabled:`` so the disabled step path pays one attribute
+    read, not N method calls."""
+
+    enabled = False
+    events: tuple = ()
+
+    def span(self, name: str, track: int = ENGINE_TRACK, **args):
+        return _NULL_SPAN
+
+    def begin(self, name, track=ENGINE_TRACK, ts_us=None, **args) -> None:
+        pass
+
+    def end(self, name, track=ENGINE_TRACK, ts_us=None, **args) -> None:
+        pass
+
+    def complete(self, name, track, ts_us, dur_us, **args) -> None:
+        pass
+
+    def instant(self, name, track=ENGINE_TRACK, ts_us=None, **args) -> None:
+        pass
+
+    def name_track(self, track: int, name: str) -> None:
+        pass
+
+    def now_us(self) -> float:
+        return 0.0
+
+    def ts_us(self, t: float) -> float:
+        return 0.0
+
+    def close(self) -> None:
+        pass
+
+
+#: the module-wide disabled recorder — hold this, not None, so call
+#: sites never branch on presence
+NULL = NullTelemetry()
+
+
+class Telemetry:
+    """Enabled span/instant recorder.
+
+    Events are Chrome trace-event dicts (``ph`` B/E/X/i) over a
+    monotonic clock, appended to a bounded ring buffer and (optionally)
+    streamed to a JSONL sink as they happen — a crash preserves the
+    prefix, and the tolerant readers below skip the torn final line.
+    ``clock`` is injectable for deterministic tests and so the serving
+    engine's fake-clock tests keep request timestamps coherent with
+    span timestamps.
+    """
+
+    enabled = True
+
+    def __init__(self, capacity: int = 1 << 16,
+                 jsonl_path: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 process_name: str = "replicatinggpt_tpu"):
+        self._clock = clock
+        self._t0 = clock()
+        self.events: deque = deque(maxlen=capacity)
+        # 'w', not 'a': each recorder is one run's artifact — appending
+        # a rerun onto a reused path would duplicate request envelopes
+        # (which trace_check rightly rejects). The journal keeps append
+        # semantics; this sink does not want them.
+        self._sink: Optional[TextIO] = (open(jsonl_path, "w")
+                                        if jsonl_path else None)
+        self._track_names: Dict[int, str] = {}
+        self.process_name = process_name
+
+    # ------------------------------------------------------------- clock
+
+    def ts_us(self, t: float) -> float:
+        """A ``clock()`` reading -> trace microseconds (relative to
+        recorder construction, so timestamps stay small and the trace
+        starts near 0)."""
+        return (t - self._t0) * 1e6
+
+    def now_us(self) -> float:
+        return self.ts_us(self._clock())
+
+    # ------------------------------------------------------------ record
+
+    def _emit(self, ev: dict) -> None:
+        self.events.append(ev)
+        if self._sink is not None:
+            # flushed per event: the sink's whole point is surviving a
+            # crash mid-run (torn-tail-tolerant readers handle the rest)
+            self._sink.write(json.dumps(ev) + "\n")
+            self._sink.flush()
+
+    def name_track(self, track: int, name: str) -> None:
+        """Register a human-readable track (thread) name once."""
+        if self._track_names.get(track) == name:
+            return
+        self._track_names[track] = name
+
+    def begin(self, name: str, track: int = ENGINE_TRACK,
+              ts_us: Optional[float] = None, **args) -> None:
+        """Open a span (phase B). ``ts_us`` lets the caller backdate —
+        the engine opens a request's envelope at its *submit* time once
+        the request is admitted (viewers sort by ts, so out-of-order
+        emission is fine)."""
+        ev = {"ph": "B", "name": name, "tid": track,
+              "ts": self.now_us() if ts_us is None else ts_us}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def end(self, name: str, track: int = ENGINE_TRACK,
+            ts_us: Optional[float] = None, **args) -> None:
+        ev = {"ph": "E", "name": name, "tid": track,
+              "ts": self.now_us() if ts_us is None else ts_us}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def complete(self, name: str, track: int, ts_us: float,
+                 dur_us: float, **args) -> None:
+        """One closed span (phase X) with explicit start + duration."""
+        ev = {"ph": "X", "name": name, "tid": track, "ts": ts_us,
+              "dur": max(dur_us, 0.0)}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def instant(self, name: str, track: int = ENGINE_TRACK,
+                ts_us: Optional[float] = None, **args) -> None:
+        """A point marker (phase i) — recovery events, COW splits,
+        evictions, prefix hits land on the timeline as these."""
+        ev = {"ph": "i", "name": name, "tid": track, "s": "t",
+              "ts": self.now_us() if ts_us is None else ts_us}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, track: int = ENGINE_TRACK,
+             **args) -> Iterator[None]:
+        """Timed region recorded as one X event on exit, wrapped in
+        ``profiling.annotate`` so the same region appears on the XLA
+        device timeline of a concurrent ``jax.profiler`` capture."""
+        from .profiling import annotate    # lazy: keep module import
+        t0 = self.now_us()                 # jax-free for the exporters
+        try:
+            with annotate(name):
+                yield
+        finally:
+            self.complete(name, track, t0, self.now_us() - t0, **args)
+
+    # ------------------------------------------------------------ export
+
+    def chrome_events(self) -> List[dict]:
+        """Trace-event list: metadata (process/thread names) + the ring
+        buffer's events, normalized with pid and track sort order."""
+        meta: List[dict] = [{
+            "ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+            "args": {"name": self.process_name}}]
+        for tid, name in sorted(self._track_names.items()):
+            meta.append({"ph": "M", "name": "thread_name", "pid": 0,
+                         "tid": tid, "args": {"name": name}})
+            meta.append({"ph": "M", "name": "thread_sort_index", "pid": 0,
+                         "tid": tid, "args": {"sort_index": tid}})
+        return meta + [{**ev, "pid": 0} for ev in self.events]
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the Perfetto-loadable JSON; returns the event count
+        (metadata included). The ring buffer bounds memory, so a very
+        long soak exports its most recent window — the JSONL sink is
+        the full-history option."""
+        events = self.chrome_events()
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return len(events)
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+
+
+# ---------------------------------------------------------------------------
+# torn-tail-tolerant JSONL readers + offline Chrome-trace assembly
+# ---------------------------------------------------------------------------
+
+def load_jsonl(path: str) -> List[dict]:
+    """Read a JSONL file written by the sink above (or by
+    :class:`MetricsTimeline`), skipping blank and torn lines — the
+    crash that makes the file interesting is the one that tears its
+    tail (same contract as ``serve.journal``)."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue              # torn tail record
+    return out
+
+
+def chrome_trace_from_jsonl(jsonl_path: str, out_path: str,
+                            process_name: str = "replicatinggpt_tpu"
+                            ) -> int:
+    """Assemble a Perfetto-loadable trace from a (possibly torn) event
+    sink — the offline path for a crashed run whose in-memory recorder
+    died with it."""
+    events = [{**ev, "pid": 0} for ev in load_jsonl(jsonl_path)
+              if "ph" in ev]
+    meta = [{"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+             "args": {"name": process_name}}]
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": meta + events,
+                   "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+# ---------------------------------------------------------------------------
+# Metrics snapshot timeline (JSONL time series)
+# ---------------------------------------------------------------------------
+
+class MetricsTimeline:
+    """Periodic JSONL snapshots of a ``utils.logging.Metrics``.
+
+    One line per snapshot: wall offset, a caller-supplied step counter,
+    every counter and gauge, and the histogram summaries. The replay
+    driver snapshots on attach, every ``interval_s`` while running, and
+    force-snapshots at the end — so even a sub-interval run yields the
+    >= 2 points a timeline needs to show direction.
+    """
+
+    def __init__(self, metrics, path: str, interval_s: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic):
+        self.metrics = metrics
+        self.path = path
+        self.interval_s = interval_s
+        self._clock = clock
+        self._t0 = clock()
+        self._last: Optional[float] = None
+        # 'w': one run per timeline file — a reused path must not mix
+        # two runs' series (t_s/counters would reset mid-stream)
+        self._f: Optional[TextIO] = open(path, "w")
+        self.n_snapshots = 0
+
+    def maybe_snapshot(self, step: Optional[int] = None) -> bool:
+        now = self._clock()
+        if self._last is not None and now - self._last < self.interval_s:
+            return False
+        self.snapshot(step=step, _now=now)
+        return True
+
+    def snapshot(self, step: Optional[int] = None,
+                 _now: Optional[float] = None, **extra) -> None:
+        assert self._f is not None, "timeline is closed"
+        now = self._clock() if _now is None else _now
+        self._last = now
+        s = self.metrics.summary()
+        rec = {"t_s": round(now - self._t0, 6), "step": step,
+               "counters": s["counters"], "gauges": s["gauges"],
+               "histograms": s["histograms"], **extra}
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        self.n_snapshots += 1
+
+    def close(self, step: Optional[int] = None) -> None:
+        """Force a final snapshot (the end-of-run point) and close."""
+        if self._f is None:
+            return
+        self.snapshot(step=step)
+        self._f.close()
+        self._f = None
+
+    @staticmethod
+    def load(path: str) -> List[dict]:
+        return load_jsonl(path)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    n = _PROM_BAD.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return f"{prefix}_{n}" if prefix else n
+
+
+def _prom_value(v) -> str:
+    """Full-precision sample value: json.dumps is the shortest string
+    that round-trips the number exactly — '%g' would silently collapse
+    a 1,234,567-token counter to 1.23457e+06, corrupting every
+    rate/delta computed from the scrape."""
+    if isinstance(v, bool):
+        v = 1 if v else 0
+    return json.dumps(v)
+
+
+def prometheus_text(metrics, prefix: str = "tpu_gpt",
+                    extra_gauges: Optional[Dict[str, Any]] = None) -> str:
+    """Render a ``Metrics`` in the Prometheus text exposition format
+    (v0.0.4 — what a ``/metrics`` scrape endpoint serves): counters as
+    ``counter``, gauges as ``gauge``, histograms as ``summary`` with
+    p50/p90/p99 quantiles plus ``_sum``/``_count``/``_min``/``_max``
+    companions derived from the reservoir summary. ``extra_gauges``
+    lets the caller fold in derived values (pages_in_use, spec accept
+    rate, ...) without teaching Metrics about them."""
+    lines: List[str] = []
+    for name in sorted(metrics.counters):
+        pn = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_prom_value(metrics.counters[name])}")
+    gauges = dict(metrics.gauges)
+    if extra_gauges:
+        gauges.update(extra_gauges)
+    for name in sorted(gauges):
+        pn = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_prom_value(gauges[name])}")
+    for name in sorted(metrics.hists):
+        pn = _prom_name(name, prefix)
+        h = metrics.hist_summary(name)
+        lines.append(f"# TYPE {pn} summary")
+        for q in ("0.5", "0.9", "0.99"):
+            key = {"0.5": "p50", "0.9": "p90", "0.99": "p99"}[q]
+            lines.append(f'{pn}{{quantile="{q}"}} {_prom_value(h[key])}')
+        lines.append(f"{pn}_sum {_prom_value(h['mean'] * h['n'])}")
+        lines.append(f"{pn}_count {_prom_value(h['n'])}")
+        lines.append(f"{pn}_min {_prom_value(h['min'])}")
+        lines.append(f"{pn}_max {_prom_value(h['max'])}")
+    return "\n".join(lines) + "\n"

@@ -494,3 +494,9 @@ def test_metrics_summary_key_schema(params):
         "prefix_hit_rate", "evictions", "cow_copies"}
     for guard in s["compile_guards"].values():
         assert set(guard) == {"calls", "compiles", "budget"}
+    # every histogram summary carries the pinned hist_summary schema
+    # (incl. min) — the telemetry exporters index these keys directly
+    from replicatinggpt_tpu.utils.logging import Metrics
+    assert s["histograms"], "expected at least one histogram"
+    for name, h in s["histograms"].items():
+        assert set(h) == set(Metrics.HIST_KEYS), name

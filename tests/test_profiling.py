@@ -84,6 +84,26 @@ def test_runner_profile_dir(tmp_path):
                      recursive=True)
 
 
+def test_trace_window_close_mid_window(tmp_path):
+    """A loop that ends while the window is still open must still get a
+    trace from close(): the profiler stops, marks itself done, and a
+    late step() can never reopen it (double-start would raise inside
+    jax.profiler)."""
+    logdir = str(tmp_path / "midwin")
+    win = trace_window(logdir, start=0, n_steps=100)
+    f = jax.jit(lambda x: x + 1)
+    win.step(0)
+    assert win._active
+    jax.block_until_ready(f(jnp.zeros(8)))
+    win.close()                     # loop ended at step 1 of 100
+    assert not win._active and win._done
+    win.step(1)                     # a straggler call must not reopen
+    assert not win._active
+    win.close()                     # idempotent
+    assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                     recursive=True)
+
+
 def test_trace_window_with_strided_steps(tmp_path):
     # multi-step dispatch loops advance it by K; a window jumped over must
     # still open (and close on the next call), producing a trace

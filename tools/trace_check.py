@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Validate a Chrome trace-event file emitted by utils.telemetry.
+
+The trace is an artifact other tooling (Perfetto, the bench dashboard)
+consumes silently — a malformed file renders as an empty timeline, not
+an error, so CI validates structure explicitly:
+
+- the file is well-formed JSON with a ``traceEvents`` list;
+- duration (B/E) events balance per track with LIFO name matching —
+  an unclosed or crossed span renders as garbage nesting;
+- complete (X) events carry a non-negative ``dur``;
+- every request envelope (a B/E pair named ``request``) opens exactly
+  once and closes exactly once per request id, end at-or-after start;
+- every span/instant tagged with a request id nests inside that
+  request's envelope on the same track (``request_unstarted`` markers
+  excepted — a shed/expired request never got a slot or an envelope).
+
+Exits 0 on a valid trace, 1 with one line per violation otherwise.
+Used by tests/test_telemetry.py on a tiny replay's output (tier-1) and
+by hand on soak artifacts. Stdlib-only on purpose: the validator must
+run anywhere the artifact lands, including hosts without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+#: slack for float round-trips at span boundaries (microseconds)
+EPS_US = 1.0
+
+#: terminal markers for requests that never got a slot (no envelope)
+UNSTARTED = {"request_unstarted"}
+
+
+def check_trace(path: str, min_requests: int = 0) -> List[str]:
+    """Validate one trace file; returns a list of violation strings
+    (empty = valid)."""
+    errors: List[str] = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable trace JSON: {e}"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["no traceEvents list"]
+
+    stacks: Dict[Tuple[int, int], List[dict]] = {}
+    # request id -> (tid, ts_begin, ts_end or None, n_begin, n_end)
+    envelopes: Dict[str, dict] = {}
+    tagged: List[dict] = []
+
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "M":
+            continue
+        key = (ev.get("pid", 0), ev.get("tid", 0))
+        name = ev.get("name", "")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            errors.append(f"{ph} event {name!r} has no numeric ts")
+            continue
+        rid = (ev.get("args") or {}).get("request")
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev)
+            if name == "request":
+                env = envelopes.setdefault(
+                    rid, {"tid": key, "b": ts, "e": None,
+                          "n_b": 0, "n_e": 0})
+                env["n_b"] += 1
+                env["b"] = ts
+                env["tid"] = key
+        elif ph == "E":
+            stack = stacks.get(key, [])
+            if not stack:
+                errors.append(f"E {name!r} on track {key} with no open B")
+            else:
+                top = stack.pop()
+                if top.get("name") != name:
+                    errors.append(
+                        f"E {name!r} closes B {top.get('name')!r} on "
+                        f"track {key} (crossed spans)")
+            if name == "request":
+                env = envelopes.setdefault(
+                    rid, {"tid": key, "b": None, "e": ts,
+                          "n_b": 0, "n_e": 0})
+                env["n_e"] += 1
+                env["e"] = ts
+        elif ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"X {name!r} has bad dur {dur!r}")
+            elif rid is not None:
+                tagged.append(ev)
+        elif ph == "i":
+            if rid is not None and name not in UNSTARTED:
+                tagged.append(ev)
+
+    for key, stack in stacks.items():
+        for ev in stack:
+            errors.append(f"B {ev.get('name')!r} on track {key} never "
+                          f"closed")
+
+    n_complete = 0
+    for rid, env in sorted(envelopes.items(), key=lambda kv: str(kv[0])):
+        if env["n_b"] != 1 or env["n_e"] != 1:
+            errors.append(f"request {rid!r}: {env['n_b']} B / "
+                          f"{env['n_e']} E envelope events (want 1/1)")
+            continue
+        if env["e"] < env["b"] - EPS_US:
+            errors.append(f"request {rid!r}: envelope ends before it "
+                          f"begins ({env['e']} < {env['b']})")
+            continue
+        n_complete += 1
+
+    for ev in tagged:
+        rid = ev["args"]["request"]
+        env = envelopes.get(rid)
+        name = ev.get("name")
+        if env is None or env["b"] is None or env["e"] is None:
+            errors.append(f"{ev['ph']} {name!r} tagged request {rid!r} "
+                          f"which has no complete envelope")
+            continue
+        key = (ev.get("pid", 0), ev.get("tid", 0))
+        if key != env["tid"]:
+            errors.append(f"{ev['ph']} {name!r} for request {rid!r} on "
+                          f"track {key}, envelope on {env['tid']}")
+            continue
+        lo = ev["ts"]
+        hi = lo + ev.get("dur", 0.0)
+        if lo < env["b"] - EPS_US or hi > env["e"] + EPS_US:
+            errors.append(
+                f"{ev['ph']} {name!r} for request {rid!r} "
+                f"[{lo:.1f}, {hi:.1f}] outside its envelope "
+                f"[{env['b']:.1f}, {env['e']:.1f}]")
+
+    if n_complete < min_requests:
+        errors.append(f"only {n_complete} complete request envelope(s); "
+                      f"expected >= {min_requests}")
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="validate a utils.telemetry Chrome trace file")
+    p.add_argument("trace", help="path to the trace JSON")
+    p.add_argument("--min-requests", type=int, default=0,
+                   help="fail unless at least this many complete "
+                        "request span trees are present")
+    args = p.parse_args(argv)
+    errors = check_trace(args.trace, min_requests=args.min_requests)
+    for e in errors:
+        print(f"trace_check: {e}", file=sys.stderr)
+    if not errors:
+        print(f"trace_check: {args.trace} OK")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
