@@ -469,6 +469,128 @@ def bench_serve(args) -> None:
     })
 
 
+def bench_fleet(args) -> None:
+    """Fleet serving replay (serve/router.py + serve/loadgen.py):
+    multi-turn session traffic through N engine replicas behind the
+    prefix-affinity router, in wall-clock time. The artifact is the
+    fleet's aggregate decode throughput plus the blocks the fleet
+    acceptance criteria key on: per-replica occupancy and pages,
+    requeue/re-route counters, the fleet TTFT distribution, and the
+    aggregate prefix-hit rate (affinity keeps it near a single
+    replica's on the same workload).
+
+    ``--fleet-kill-at N`` injects a deterministic ``replica_kill`` of
+    replica 0 at router step N mid-run (faults/fleet.py): the artifact
+    then also demonstrates the requeue path — every in-flight request
+    finishes via the crash journal, and the run is tagged
+    ``chaos: replica_kill``."""
+    import jax
+
+    from replicatinggpt_tpu.config import get_config
+    from replicatinggpt_tpu.faults import Fault, FaultPlan, installed
+    from replicatinggpt_tpu.faults.fleet import (FLEET_STEP,
+                                                 KIND_REPLICA_KILL)
+    from replicatinggpt_tpu.serve import (EngineConfig, RouterConfig,
+                                          SessionLoadConfig,
+                                          run_fleet_replay)
+    from replicatinggpt_tpu.train.state import create_train_state
+
+    cfg = get_config(args.preset)
+    dev = jax.devices()[0]
+    block = cfg.model.block_size
+    # size turns to the model's context: prefix + turns*(user+gen) must
+    # fit block_size with headroom
+    prefix_len = min(args.fleet_prefix_len, block // 4)
+    max_new = min(args.serve_max_new_tokens,
+                  max((block - prefix_len) // (2 * args.fleet_turns), 1))
+    user_len = max(min(max_new // 2, 8), 1)
+    lcfg = SessionLoadConfig(
+        n_sessions=args.fleet_sessions, turns=args.fleet_turns,
+        n_prefix_groups=args.fleet_prefix_groups, prefix_len=prefix_len,
+        user_len_min=1, user_len_max=user_len, max_new_tokens=max_new,
+        rate=args.serve_rate, greedy=True, seed=0)
+    rcfg = RouterConfig(n_replicas=args.fleet_replicas,
+                        journal_dir=args.fleet_journal_dir or None)
+    # default the page size so the shared prefix spans >= 2 full pages
+    # (radix sharing works on whole pages; a prefix shorter than one
+    # page would make the artifact's hit-rate block structurally zero)
+    page_size = args.serve_page_size or max(2, min(16, prefix_len // 2))
+    ecfg = EngineConfig(pool_size=args.serve_pool,
+                        max_queue=4 * args.fleet_sessions,
+                        page_size=page_size,
+                        n_pages=args.serve_n_pages)
+    log(f"fleet replay: {lcfg.n_sessions} sessions x {lcfg.turns} turns "
+        f"@ {lcfg.rate}/s over {rcfg.n_replicas} replicas "
+        f"(pool {ecfg.pool_size} each), prefix {prefix_len} tok x "
+        f"{lcfg.n_prefix_groups} groups, model {cfg.model.n_layer}L/"
+        f"{cfg.model.n_head}H/{cfg.model.n_embd}C on {dev.device_kind}")
+    state = create_train_state(jax.random.PRNGKey(0), cfg.model,
+                               cfg.train)
+    import contextlib
+    import tempfile
+    plan_ctx = contextlib.nullcontext()
+    if args.fleet_kill_at >= 0:
+        plan_ctx = installed(FaultPlan(Fault(
+            site=FLEET_STEP, kind=KIND_REPLICA_KILL,
+            at=args.fleet_kill_at, arg=0)))
+    with tempfile.TemporaryDirectory() as td:
+        if rcfg.journal_dir is None:
+            # requeue-after-kill needs journals; default them to a temp
+            # dir so the chaos arm always has the recovery path
+            import dataclasses
+            rcfg = dataclasses.replace(rcfg, journal_dir=td)
+        with plan_ctx:
+            summary = run_fleet_replay(
+                state.params, cfg.model, lcfg, rcfg, ecfg,
+                trace_out=args.trace_out,
+                metrics_timeline=args.metrics_timeline,
+                metrics_out=args.metrics_out)
+    ttft = summary["fleet_ttft_s"]
+    agg = (summary["generated_tokens"] / summary["wall_s"]
+           if summary["wall_s"] > 0 else 0.0)
+    log(f"fleet: {summary['n_completed']}/{summary['n_requests']} "
+        f"turns completed, {round(agg, 1)} tok/s aggregate, fleet TTFT "
+        f"p50 {ttft.get('p50', 0) * 1e3:.1f} ms, prefix hit rate "
+        f"{summary['aggregate_prefix_hit_rate']}, requeued "
+        f"{summary['router'].get('fleet_requeued_requests', 0)}, "
+        f"{summary['recompiles_after_warmup']} recompiles after warmup")
+    emit({
+        "metric": "fleet_replay_aggregate_tokens_per_sec",
+        "value": round(agg, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,      # reference has no serving path at all
+        "n_replicas": summary["n_replicas"],
+        "n_alive": summary["n_alive"],
+        "n_sessions": summary["n_sessions"],
+        "turns_per_session": summary["turns_per_session"],
+        "n_requests": summary["n_requests"],
+        "n_completed": summary["n_completed"],
+        "fleet_ttft_p50_ms": round(ttft.get("p50", 0) * 1e3, 2),
+        "fleet_ttft_p99_ms": round(ttft.get("p99", 0) * 1e3, 2),
+        "aggregate_prefix_hit_rate":
+            summary["aggregate_prefix_hit_rate"],
+        "recompiles_after_warmup": summary["recompiles_after_warmup"],
+        "device_kind": dev.device_kind,
+        # the fleet acceptance blocks: per-replica occupancy + pages,
+        # and the router's requeue/health counters
+        "router": summary["router"],
+        "replicas": [{
+            "replica": r["health"]["replica"],
+            "alive": r["health"]["alive"],
+            "occupancy_mean": r["occupancy_mean"],
+            "n_steps": r["n_steps"],
+            "pages_in_use": r["pages"]["pages_in_use"],
+            "page_utilization": r["pages"]["page_utilization"],
+            "prefix_hit_rate": r["pages"]["prefix_hit_rate"],
+            "finished": r["finished"],
+        } for r in summary["replicas"]],
+        **({"chaos": "replica_kill", "kill_at": args.fleet_kill_at}
+           if args.fleet_kill_at >= 0 else {}),
+        **({"artifacts": summary["artifacts"]}
+           if "artifacts" in summary else {}),
+    })
+
+
 def bench_generate(args) -> None:
     import jax
 
@@ -816,7 +938,30 @@ def main() -> None:
     p.add_argument("--preset", default="char-gpt")
     p.add_argument("--mode", default="train",
                    choices=["train", "generate", "longctx", "kernel",
-                            "decode", "serve"])
+                            "decode", "serve", "fleet"])
+    p.add_argument("--fleet-replicas", type=int, default=2,
+                   help="--mode fleet: engine replicas behind the "
+                        "prefix-affinity router")
+    p.add_argument("--fleet-sessions", type=int, default=24,
+                   help="--mode fleet: multi-turn sessions in the "
+                        "load-generator trace")
+    p.add_argument("--fleet-turns", type=int, default=3,
+                   help="--mode fleet: turns per session (each turn "
+                        "re-enters with the whole history — the "
+                        "prefix-cache / affinity traffic shape)")
+    p.add_argument("--fleet-prefix-groups", type=int, default=3,
+                   help="--mode fleet: distinct shared system prefixes")
+    p.add_argument("--fleet-prefix-len", type=int, default=32,
+                   help="--mode fleet: shared-prefix length in tokens "
+                        "(clamped to block_size // 4)")
+    p.add_argument("--fleet-kill-at", type=int, default=-1,
+                   help="--mode fleet: inject replica_kill of replica 0 "
+                        "at this router step (-1 = no chaos); the "
+                        "journal-requeue path then runs inside the "
+                        "measured replay")
+    p.add_argument("--fleet-journal-dir", default="",
+                   help="--mode fleet: per-replica crash journals "
+                        "(default: a temp dir)")
     p.add_argument("--serve-requests", type=int, default=64,
                    help="--mode serve: trace length")
     p.add_argument("--serve-rate", type=float, default=200.0,
@@ -919,8 +1064,10 @@ def main() -> None:
               "kernel": "flash_kernel_fwdbwd_median_ms",
               "decode": "generate_batched_aggregate_tokens_per_sec_p50",
               "serve": "serve_replay_aggregate_tokens_per_sec",
+              "fleet": "fleet_replay_aggregate_tokens_per_sec",
               "train": "char_gpt_train_tokens_per_sec_per_chip"}[args.mode]
-    unit = ("tokens/sec" if args.mode in ("generate", "decode", "serve")
+    unit = ("tokens/sec" if args.mode in ("generate", "decode", "serve",
+                                          "fleet")
             else "ms" if args.mode == "kernel" else "tokens/sec/chip")
     try:
         # probe first, watchdog after: the probe phase is already
@@ -970,6 +1117,8 @@ def main() -> None:
                 bench_decode_sweep(args)
             elif args.mode == "serve":
                 bench_serve(args)
+            elif args.mode == "fleet":
+                bench_fleet(args)
             else:
                 bench_train(args)
     except BaseException as e:  # noqa: BLE001 — artifact must still emit

@@ -293,6 +293,74 @@ def cmd_serve_replay(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """The fleet front door: N engine replicas behind the prefix-
+    affinity router (serve/router.py), exposed over HTTP/SSE
+    (serve/http.py) — submit/stream/cancel/healthz/metrics. Binds
+    loopback by default (the zero-egress image takes no outside
+    traffic; this is the ingress path's real implementation, exercised
+    by tests and local clients). Ctrl-C shuts down cleanly, closing
+    the per-replica crash journals."""
+    _apply_rng_impl(args)
+    import asyncio
+
+    import jax
+
+    from .config import config_from_args
+    from .serve import EngineConfig, Router, RouterConfig
+    from .serve.http import ServeApp
+    from .train.state import create_train_state
+    cfg = config_from_args(args)
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
+                               cfg.model, cfg.train)
+    if args.checkpoint_dir:
+        from .train.checkpoint import CheckpointManager
+        restored = CheckpointManager(args.checkpoint_dir).restore_latest(state)
+        if restored is None:
+            print("no checkpoint found; serving random init",
+                  file=sys.stderr)
+        else:
+            state = restored
+    telemetry = None
+    if args.trace_out or args.trace_jsonl:
+        from .utils.telemetry import Telemetry
+        telemetry = Telemetry(jsonl_path=args.trace_jsonl)
+    router = Router(
+        state.params, cfg.model,
+        RouterConfig(n_replicas=args.replicas,
+                     journal_dir=args.journal_dir,
+                     affinity=not args.no_affinity,
+                     wedge_budget_s=args.wedge_budget_s,
+                     wedge_patience=args.wedge_patience),
+        EngineConfig(pool_size=args.pool_size, max_queue=args.max_queue,
+                     prefill_chunk=args.prefill_chunk,
+                     page_size=args.page_size, n_pages=args.n_pages,
+                     prefix_cache=not args.no_prefix_cache),
+        telemetry=telemetry)
+    app = ServeApp(router)
+    rc = 0
+    try:
+        asyncio.run(app.serve_forever(args.host, args.port))
+    except KeyboardInterrupt:
+        print("shutting down", file=sys.stderr)
+    except asyncio.CancelledError:
+        # the driver task died (ServeApp._on_driver_done printed the
+        # traceback and closed the server out from under serve_forever)
+        rc = 1
+    finally:
+        router.close()
+        if telemetry is not None:
+            if args.trace_out:
+                n = telemetry.export_chrome_trace(args.trace_out)
+                print(f"telemetry: {n} trace events -> {args.trace_out}",
+                      file=sys.stderr)
+            telemetry.close()
+            if args.trace_jsonl:
+                print(f"telemetry: event sink -> {args.trace_jsonl}",
+                      file=sys.stderr)
+    return rc
+
+
 def cmd_eval(args) -> int:
     _apply_rng_impl(args)
     import jax
@@ -480,6 +548,47 @@ def main(argv=None) -> int:
     ps.add_argument("--profile-steps", type=int, default=5,
                     help="engine steps the device capture covers")
     ps.set_defaults(fn=cmd_serve_replay)
+
+    pv = sub.add_parser("serve",
+                        help="run the HTTP/SSE serving fleet: N engine "
+                             "replicas behind the prefix-affinity "
+                             "router, with submit/stream/cancel/"
+                             "healthz/metrics endpoints")
+    add_config_flags(pv)
+    pv.add_argument("--rng-impl", default=None,
+                    choices=["threefry2x32", "rbg"])
+    pv.add_argument("--checkpoint-dir", default=None)
+    pv.add_argument("--host", default="127.0.0.1")
+    pv.add_argument("--port", type=int, default=8000)
+    pv.add_argument("--replicas", type=int, default=1,
+                    help="engine replicas behind the router")
+    pv.add_argument("--journal-dir", default=None,
+                    help="per-replica crash journals live here; "
+                         "required for cross-replica requeue after a "
+                         "replica death (docs/robustness.md)")
+    pv.add_argument("--no-affinity", action="store_true",
+                    help="disable radix-prefix-affinity routing "
+                         "(pure least-loaded)")
+    pv.add_argument("--wedge-budget-s", type=float, default=0.0,
+                    help="per-replica step budget for the router's "
+                         "wedge probe (0 = detection off); a replica "
+                         "over budget --wedge-patience times in a row "
+                         "is quarantined and its in-flight work "
+                         "re-routed")
+    pv.add_argument("--wedge-patience", type=int, default=2)
+    pv.add_argument("--pool-size", type=int, default=8)
+    pv.add_argument("--max-queue", type=int, default=64)
+    pv.add_argument("--prefill-chunk", type=int, default=0)
+    pv.add_argument("--page-size", type=int, default=0)
+    pv.add_argument("--n-pages", type=int, default=0)
+    pv.add_argument("--no-prefix-cache", action="store_true")
+    pv.add_argument("--trace-out", default=None,
+                    help="write a Perfetto trace (router + per-replica "
+                         "tracks) at shutdown")
+    pv.add_argument("--trace-jsonl", default=None,
+                    help="stream trace events to this JSONL sink as "
+                         "they happen (crash-tolerant)")
+    pv.set_defaults(fn=cmd_serve)
 
     pe = sub.add_parser("eval", help="estimate train/val loss")
     add_config_flags(pe)

@@ -5,12 +5,18 @@
 - ``supervise``: train self-healing — loss-spike/NaN rollback to the
   last *verified* checkpoint, data-cursor advance, bounded retries;
 - ``watchdog``: serve self-healing policies — step-stall watchdog,
-  speculative auto-disable with re-probe, load shedding.
+  speculative auto-disable with re-probe, load shedding;
+- ``fleet``: fleet-level fault kinds (replica kill / wedge-partition /
+  hot-key skew) behind the same plan machinery, consulted by
+  serve/router.py and serve/loadgen.py.
 
 The ops story (fault matrix -> detection -> automatic recovery ->
 operator action) lives in docs/robustness.md.
 """
 
+from .fleet import (FLEET_SESSION, FLEET_STEP, KIND_HOT_KEY_SKEW,
+                    KIND_REPLICA_KILL, KIND_REPLICA_WEDGE,
+                    fleet_step_fault, session_skew)
 from .inject import Fault, FaultPlan, active, clear, fire, install, installed
 from .supervise import (LossSpikeError, NonFiniteLossError,
                         SupervisedResult, SupervisionConfig,
@@ -24,4 +30,7 @@ __all__ = [
     "SupervisionConfig", "SupervisionExhausted", "supervised_train",
     "DEFAULT_SERVE_RESILIENCE", "LoadShedder", "ResilienceConfig",
     "SpecHealth", "StepWatchdog",
+    "FLEET_SESSION", "FLEET_STEP", "KIND_HOT_KEY_SKEW",
+    "KIND_REPLICA_KILL", "KIND_REPLICA_WEDGE", "fleet_step_fault",
+    "session_skew",
 ]

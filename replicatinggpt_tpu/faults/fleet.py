@@ -1,0 +1,61 @@
+"""Fleet-level fault kinds: deterministic chaos for the serving fleet.
+
+PR 4's faults are process-local (a slow step, a corrupt checkpoint, a
+collapsing drafter). The fleet tier (serve/router.py over N engine
+replicas) adds the failure modes a single process cannot have: a whole
+replica dying with requests in flight, a replica wedging/partitioning
+(alive but not making progress), and traffic skew that concentrates
+load on one cached prefix. Same :class:`~.inject.FaultPlan` machinery —
+explicit step/session indices, one-shot counting, no-op by default —
+so a fleet chaos soak is exactly as replayable as a process-level one.
+
+Sites (consulted once per router step / per generated session):
+
+- ``fleet/step`` with kind ``replica_kill``: the router abandons
+  replica ``int(arg)`` at router step ``at`` — stops stepping it,
+  closes its journal, and requeues its accepted-but-unfinished
+  requests from that journal onto surviving replicas (the crash-journal
+  path, now cross-replica).
+- ``fleet/step`` with kind ``replica_wedge``: replica ``int(arg2)``'s
+  next ``times`` steps each stall ``arg`` seconds (injected INSIDE the
+  router's per-replica step timing, so the health probe sees exactly
+  what a wedged device or a network partition to that replica looks
+  like: the replica stops completing steps on budget).
+- ``fleet/session`` with kind ``hot_key_skew``: the load generator
+  collapses each eligible session onto prefix group 0 with probability
+  ``arg`` (seeded — deterministic per loadgen seed), turning a uniform
+  session mix into hot-key traffic that hammers one radix subtree and
+  one affinity target.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .inject import Fault, fire
+
+#: router step seam — fired once per Router.step with the router's step
+#: counter as the index
+FLEET_STEP = "fleet/step"
+#: loadgen session-creation seam — fired once per session with the
+#: session index
+FLEET_SESSION = "fleet/session"
+
+KIND_REPLICA_KILL = "replica_kill"
+KIND_REPLICA_WEDGE = "replica_wedge"
+KIND_HOT_KEY_SKEW = "hot_key_skew"
+
+
+def fleet_step_fault(step: int) -> Optional[Fault]:
+    """The router's per-step seam: at most one fleet fault per step
+    (None almost always — the no-plan fast path is one global read)."""
+    return fire(FLEET_STEP, index=step)
+
+
+def session_skew(session_index: int) -> float:
+    """The loadgen's per-session seam: the hot-key collapse probability
+    for this session (0.0 = no skew fault active)."""
+    f = fire(FLEET_SESSION, index=session_index)
+    if f is not None and f.kind == KIND_HOT_KEY_SKEW:
+        return float(f.arg)
+    return 0.0

@@ -45,7 +45,17 @@ site                      kind        effect at the seam
 ``train/loss``            ``spike``   observed loss scaled by ``arg``
 ``serve/step``            ``delay``   ``time.sleep(arg)`` before the dispatch
 ``spec/draft``            ``collapse``  shift every drafted token by one
+``fleet/step``            ``replica_kill``  router abandons replica ``arg``
+``fleet/step``            ``replica_wedge`` replica ``arg2`` steps stall
+                                      ``arg`` seconds (partition stand-in)
+``fleet/session``         ``hot_key_skew``  loadgen collapses sessions onto
+                                      one prefix group w.p. ``arg``
 ========================  ==========  =======================================
+
+The ``fleet/*`` sites live behind :mod:`faults.fleet` (the router and
+load generator consult them); they reuse this module's machinery
+unchanged — same determinism, same one-shot counting, same no-op
+default.
 """
 
 from __future__ import annotations
@@ -79,6 +89,7 @@ class Fault:
     at: int = 0
     times: int = 1
     arg: float = 0.0
+    arg2: float = 0.0      # second payload (fleet faults: replica index)
     after_s: float = 0.0
 
 

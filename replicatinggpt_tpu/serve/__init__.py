@@ -19,18 +19,32 @@
   recovery requeues accepted-but-unfinished requests into a fresh
   engine (docs/robustness.md);
 - ``replay``: synthetic Poisson trace driver (`serve-replay` CLI,
-  `bench.py --mode serve`).
+  `bench.py --mode serve`);
+- ``router``: the fleet tier — N in-process engine replicas behind one
+  submit/cancel/step API with radix-prefix affinity routing, health
+  probes, crash-journal requeue across replica death, and hedged
+  re-route off wedged replicas (docs/serving.md);
+- ``loadgen``: multi-turn session load generator + fleet replay driver
+  (`bench.py --mode fleet`, the fleet chaos soak);
+- ``http``: the asyncio HTTP/SSE front door (`serve` CLI) —
+  submit/stream/cancel/healthz/metrics over the router.
 
 Self-healing (step watchdog, speculative auto-disable, load shedding)
-is opt-in via ``faults.watchdog.ResilienceConfig`` on the Engine.
+is opt-in via ``faults.watchdog.ResilienceConfig`` on the Engine;
+fleet-level faults (replica kill/wedge, hot-key skew) live behind
+``faults.fleet``.
 """
 
 from .cache_pool import CachePool
 from .engine import Engine, EngineConfig, compile_counts
 from .journal import RequestJournal
+from .loadgen import (SessionLoadConfig, StepClock, make_sessions,
+                      run_fleet_replay, session_request)
 from .pages import PageAllocator, PagedCachePool, RadixIndex
 from .replay import ReplayConfig, format_summary, make_trace, run_replay
 from .requests import Request, RequestResult, SamplingParams
+from .router import (REJECT_FLEET_CAPACITY, Replica, Router,
+                     RouterConfig)
 from .scheduler import Scheduler
 from .speculative import (Drafter, ModelDrafter, NGramDrafter,
                           draft_config_from_preset, make_drafter)
@@ -41,4 +55,7 @@ __all__ = ["CachePool", "Engine", "EngineConfig", "compile_counts",
            "ReplayConfig", "format_summary", "make_trace", "run_replay",
            "Request", "RequestResult", "SamplingParams", "Scheduler",
            "Drafter", "ModelDrafter", "NGramDrafter",
-           "draft_config_from_preset", "make_drafter"]
+           "draft_config_from_preset", "make_drafter",
+           "REJECT_FLEET_CAPACITY", "Replica", "Router", "RouterConfig",
+           "SessionLoadConfig", "StepClock", "make_sessions",
+           "run_fleet_replay", "session_request"]

@@ -221,7 +221,8 @@ class Engine:
                  clock: Callable[[], float] = time.monotonic,
                  drafter: Optional[Drafter] = None,
                  rcfg: Optional[ResilienceConfig] = None,
-                 journal=None, telemetry=None):
+                 journal=None, telemetry=None, track_base: int = 0,
+                 track_label: str = ""):
         """``rcfg`` (faults.watchdog.ResilienceConfig) opts into the
         self-healing policies — stall watchdog, speculative auto-disable
         with re-probe, load shedding; None/all-zero changes nothing.
@@ -232,7 +233,11 @@ class Engine:
         timeline) opts into request-lifecycle tracing: one span tree
         per request on per-slot tracks plus step/draft spans and
         prefix-hit/COW/eviction/recovery instants; None means the
-        zero-cost NULL recorder and changes nothing."""
+        zero-cost NULL recorder and changes nothing. ``track_base``
+        offsets every track id this engine emits on — the fleet router
+        gives replica ``i`` base ``i * REPLICA_TRACK_STRIDE`` so N
+        replicas share one recorder without colliding tracks
+        (``track_label`` prefixes the human-readable track names)."""
         cfg.validate()
         self.params = params
         self.cfg = cfg
@@ -240,10 +245,13 @@ class Engine:
         self.clock = clock
         self.drafter = drafter
         self.tel = telemetry or NULL
+        self._tb = track_base
         if self.tel.enabled:
-            self.tel.name_track(ENGINE_TRACK, "engine")
+            self.tel.name_track(self._tb + ENGINE_TRACK,
+                                f"{track_label}engine")
             for s in range(ecfg.pool_size):
-                self.tel.name_track(SLOT_TRACK_BASE + s, f"slot {s}")
+                self.tel.name_track(self._tb + SLOT_TRACK_BASE + s,
+                                    f"{track_label}slot {s}")
         if drafter is not None:
             dcfg = getattr(drafter, "cfg", None)
             if dcfg is not None:       # model drafter: pools must line up
@@ -351,10 +359,19 @@ class Engine:
             self.journal.record_submit(req)
         return None
 
-    def cancel(self, request_id: str) -> bool:
+    def cancel(self, request_id: str, migrated: bool = False) -> bool:
         """Cancel a queued or running request. The terminal
         ``RequestResult`` (with any tokens already produced) surfaces
-        from the next ``step()``; True iff the request was found."""
+        from the next ``step()``; True iff the request was found. An
+        active request's slot and its reserved KV pages are released
+        IMMEDIATELY (not at the next step) — a cancelled mid-stream
+        request must not hold capacity while its terminal result waits
+        to surface. ``migrated=True`` is the fleet router's re-route
+        path: the request is not ending, it is moving to another
+        replica — the telemetry envelope closes tagged ``migrated`` (a
+        non-terminal segment, see tools/trace_check.py) and the journal
+        still records a finish so THIS replica's journal replay never
+        resurrects it."""
         now = self.clock()
         if self.scheduler.cancel(request_id):
             self.metrics.inc("finished_" + FINISH_CANCELLED)
@@ -365,8 +382,34 @@ class Engine:
         slot = self.pool.slot_of(request_id)
         if slot is None:
             return False
-        self._pending.append(self._finish_slot(slot, FINISH_CANCELLED, now))
+        self._pending.append(self._finish_slot(slot, FINISH_CANCELLED, now,
+                                               migrated=migrated))
         return True
+
+    def partial_tokens(self, request_id: str) -> Optional[List[int]]:
+        """Tokens committed so far for an ACTIVE request (host list
+        copy; None when the request holds no slot — still queued, or
+        already finished). The streaming front door (serve/http.py) and
+        the fleet router's delivery dedupe poll this between steps."""
+        slot = self.pool.slot_of(request_id)
+        if slot is None or slot not in self._slots:
+            return None
+        return list(self._slots[slot].tokens)
+
+    def in_flight_ids(self) -> List[str]:
+        """Every accepted-but-unfinished request id: queued first (in
+        arrival order), then active slots. The router's re-route path
+        reads this for a wedged replica (for a DEAD one it replays the
+        journal instead — host memory died with the replica)."""
+        queued = self.scheduler.ids()
+        active = [self._slots[s].req.id for s in sorted(self._slots)]
+        return queued + active
+
+    def slot_track(self, slot: int) -> int:
+        """Telemetry track id of a slot (``track_base``-offset) — the
+        router closes a killed replica's open request envelopes on the
+        right tracks."""
+        return self._tb + SLOT_TRACK_BASE + slot
 
     @property
     def idle(self) -> bool:
@@ -459,7 +502,8 @@ class Engine:
                                        f"{dur * 1e3:.1f} ms step against "
                                        f"a p99-derived budget")
         if self.tel.enabled:
-            self.tel.complete("engine_step", ENGINE_TRACK, t_step_us,
+            self.tel.complete("engine_step", self._tb + ENGINE_TRACK,
+                              t_step_us,
                               self.tel.now_us() - t_step_us,
                               step=self.n_steps,
                               queue_depth=self.scheduler.depth,
@@ -575,7 +619,7 @@ class Engine:
         adm = self.pool.acquire(req.id, req.prompt, cap)
         assert adm is not None, "scheduler admitted past pool capacity"
         slot = adm.slot
-        tid = SLOT_TRACK_BASE + slot
+        tid = self._tb + SLOT_TRACK_BASE + slot
         if self.tel.enabled:
             # the request's span tree opens BACKDATED to its submit
             # time (viewers sort by ts, so out-of-order emission is
@@ -696,7 +740,8 @@ class Engine:
             # finish path stamps on a request's E event, so a slot's
             # last decode span never spills past its request envelope
             dur_us = self.tel.ts_us(now) - t0_us
-            self.tel.complete("decode_step", ENGINE_TRACK, t0_us, dur_us,
+            self.tel.complete("decode_step", self._tb + ENGINE_TRACK,
+                              t0_us, dur_us,
                               step=self.n_steps, n_active=n_active)
         finished: List[RequestResult] = []
         for slot in list(self._slots):
@@ -704,7 +749,8 @@ class Engine:
                 continue
             st = self._slots[slot]
             if tel_on:
-                self.tel.complete("decode", SLOT_TRACK_BASE + slot,
+                self.tel.complete("decode",
+                                  self._tb + SLOT_TRACK_BASE + slot,
                                   t0_us, dur_us, step=self.n_steps,
                                   request=st.req.id)
             st.tokens.append(int(toks[slot]))
@@ -748,9 +794,9 @@ class Engine:
             tok=self._tok, pos=self._pos, active=self._active,
             histories=(self._histories() if self.drafter.needs_history
                        else None))
-        draft_toks, draft_len, dt = timed_draft(self.drafter, ctx,
-                                                self.cfg.vocab_size,
-                                                tel=self.tel)
+        draft_toks, draft_len, dt = timed_draft(
+            self.drafter, ctx, self.cfg.vocab_size, tel=self.tel,
+            track=self._tb + ENGINE_TRACK)
         self.metrics.observe("draft_overhead_s", dt)
         t0_us = self.tel.now_us() if self.tel.enabled else 0.0
         m = np.zeros((P,), np.int32)
@@ -812,7 +858,8 @@ class Engine:
         tel_on = self.tel.enabled
         if tel_on:
             dur_us = self.tel.ts_us(now) - t0_us
-            self.tel.complete("verify_step", ENGINE_TRACK, t0_us, dur_us,
+            self.tel.complete("verify_step", self._tb + ENGINE_TRACK,
+                              t0_us, dur_us,
                               step=self.n_steps, n_active=n_active,
                               drafted=drafted, accepted=accepted)
         if self._spec_health is not None:
@@ -842,7 +889,8 @@ class Engine:
             st = self._slots[slot]
             n_emit = int(n_acc_h[slot]) + 1
             if tel_on:
-                self.tel.complete("verify", SLOT_TRACK_BASE + slot,
+                self.tel.complete("verify",
+                                  self._tb + SLOT_TRACK_BASE + slot,
                                   t0_us, dur_us, step=self.n_steps,
                                   request=st.req.id, drafted=int(m[slot]),
                                   committed=n_emit)
@@ -860,14 +908,15 @@ class Engine:
                 finished.append(self._finish_slot(slot, reason, now))
         return finished
 
-    def _finish_slot(self, slot: int, reason: str,
-                     now: float) -> RequestResult:
+    def _finish_slot(self, slot: int, reason: str, now: float,
+                     migrated: bool = False) -> RequestResult:
         st = self._slots.pop(slot)
         self._active[slot] = False
         if self.tel.enabled:
-            self.tel.end("request", SLOT_TRACK_BASE + slot,
+            extra = {"migrated": True} if migrated else {}
+            self.tel.end("request", self._tb + SLOT_TRACK_BASE + slot,
                          ts_us=self.tel.ts_us(now), request=st.req.id,
-                         reason=reason, n_tokens=len(st.tokens))
+                         reason=reason, n_tokens=len(st.tokens), **extra)
         self.pool.release(slot)
         if self.drafter is not None:
             self.drafter.on_release(slot)
@@ -890,7 +939,7 @@ class Engine:
                           now: float) -> RequestResult:
         # never admitted -> no slot track and no open envelope; one
         # instant marks the terminal outcome on the engine timeline
-        self.tel.instant("request_unstarted", ENGINE_TRACK,
+        self.tel.instant("request_unstarted", self._tb + ENGINE_TRACK,
                          ts_us=(self.tel.ts_us(now) if self.tel.enabled
                                 else None),
                          request=req.id, reason=reason)

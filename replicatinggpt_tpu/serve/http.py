@@ -1,0 +1,412 @@
+"""HTTP/SSE front door: the fleet's network edge, stdlib-asyncio only.
+
+Everything used to enter the engine through replay traces; this module
+is the real ingress path — a thin asyncio HTTP server that maps
+directly onto the router/engine host API, adding **no new scheduling
+semantics**: backpressure is the Scheduler's bounded queue surfacing as
+429s, deadlines are request fields, cancellation (explicit or by client
+disconnect mid-stream) is ``Router.cancel`` — which releases the
+request's slot and KV pages immediately — and crash recovery is the
+per-replica journals behind the router. The zero-egress image cannot
+take outside traffic, so the server binds loopback and is exercised by
+tier-1 tests speaking real HTTP over real sockets.
+
+Endpoints (all JSON unless noted):
+
+- ``POST /v1/submit`` — body ``{"prompt": [ids], "id"?, "max_new_tokens"?,
+  "temperature"?, "top_k"?, "top_p"?, "greedy"?, "rng_seed"?,
+  "deadline_s"?}``; 200 ``{"id", "status": "accepted"}`` or an error
+  status from the rejection reason (429 backpressure, 400 validation,
+  413 prompt too long, 504 dead-on-arrival deadline).
+- ``GET /v1/stream/{id}`` — ``text/event-stream``: one ``data:
+  {"token": t, "i": n}`` event per token as steps commit them, then
+  ``event: done`` with the terminal summary. Exactly-once across a
+  replica kill mid-stream (the router's delivery ledger). One consumer
+  per request id — the ledger is the dedupe state.
+- ``POST /v1/generate`` — submit + stream in one round trip.
+- ``POST /v1/cancel/{id}`` — ``{"cancelled": bool}``.
+- ``GET /v1/result/{id}`` — non-streaming terminal result (202 while
+  running; popping it frees the id).
+- ``GET /healthz`` — router health (200, or 503 with no routable
+  replica): per-replica alive/wedged/queue/slots/pages.
+- ``GET /metrics`` — Prometheus text exposition of the router metrics
+  (fleet counters + per-replica gauges; utils.telemetry).
+
+The server is single-threaded asyncio on purpose: the engine/router
+host API is single-threaded by design, and one driver task calling
+``router.step()`` between socket reads is exactly the replay loop with
+sockets for arrivals. A step blocks the loop for one dispatch — the
+same latency floor every request already pays.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.telemetry import prometheus_text
+from .requests import (FINISH_DEADLINE, REJECT_BAD_REQUEST,
+                       REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL, Request,
+                       SamplingParams)
+from .router import REJECT_FLEET_CAPACITY, Router
+
+#: rejection reason -> HTTP status for the submit path
+REASON_STATUS = {
+    REJECT_QUEUE_FULL: 429,
+    REJECT_FLEET_CAPACITY: 429,
+    REJECT_BAD_REQUEST: 400,
+    REJECT_PROMPT_TOO_LONG: 413,
+    FINISH_DEADLINE: 504,
+}
+
+_STATUS_TEXT = {200: "OK", 202: "Accepted", 400: "Bad Request",
+                404: "Not Found", 405: "Method Not Allowed",
+                413: "Payload Too Large", 429: "Too Many Requests",
+                500: "Internal Server Error", 503: "Service Unavailable",
+                504: "Gateway Timeout"}
+
+
+def request_from_json(body: dict, default_id: str, clock,
+                      vocab: int = 0) -> Tuple[Optional[Request],
+                                               Optional[str]]:
+    """Build a :class:`Request` from a submit body; (None, error) on a
+    malformed one. Validation beyond shape (empty prompt, too-long
+    prompt) is the Scheduler's job — the front door only refuses what
+    it cannot even construct. ``vocab`` bounds the token ids (0 skips
+    the check): this is the first untrusted boundary, and an
+    out-of-range id would otherwise be silently clamped by the
+    embedding gather into a 200 with garbage output."""
+    prompt = body.get("prompt")
+    if (not isinstance(prompt, list) or
+            not all(isinstance(t, int) and not isinstance(t, bool)
+                    and 0 <= t and (not vocab or t < vocab)
+                    for t in prompt)):
+        return None, ("prompt must be a list of token ids in "
+                      f"[0, {vocab})" if vocab else
+                      "prompt must be a list of non-negative token ids")
+    rid = body.get("id", default_id)
+    if not isinstance(rid, str) or not rid:
+        return None, "id must be a non-empty string"
+    try:
+        deadline = None
+        if body.get("deadline_s"):
+            deadline = clock() + float(body["deadline_s"])
+        req = Request(
+            id=rid, prompt=np.asarray(prompt, np.int32),
+            max_new_tokens=int(body.get("max_new_tokens", 16)),
+            sampling=SamplingParams(
+                temperature=float(body.get("temperature", 1.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 0.0)),
+                greedy=bool(body.get("greedy", False))),
+            deadline=deadline,
+            rng_seed=int(body.get("rng_seed", 0)))
+    except (TypeError, ValueError) as e:
+        return None, f"bad request field: {e}"
+    return req, None
+
+
+class ServeApp:
+    """The front door: one router, one asyncio server, one driver task.
+
+    ``step_wait_s`` bounds how long an SSE handler waits for the next
+    step wakeup before re-checking terminal state (a safety net around
+    missed wakeups, not a poll interval); ``idle_sleep_s`` is the
+    driver's sleep when the fleet is idle.
+    """
+
+    def __init__(self, router: Router, idle_sleep_s: float = 0.002,
+                 step_wait_s: float = 0.5):
+        self.router = router
+        self.idle_sleep_s = idle_sleep_s
+        self.step_wait_s = step_wait_s
+        self._ids = itertools.count()
+        self._running = False
+        self._step_fut: Optional[asyncio.Future] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._driver: Optional[asyncio.Future] = None
+        #: ids whose client disconnected mid-stream: nobody will ever
+        #: pop their terminal result, so the driver pops it the moment
+        #: it surfaces (pop_result's no-unbounded-growth invariant)
+        self._abandoned: set = set()
+
+    # ------------------------------------------------------------- driver
+
+    async def _drive(self) -> None:
+        """Step the router whenever it has work; wake SSE streams after
+        every step (they read the delivery ledger, not engine state)."""
+        loop = asyncio.get_running_loop()
+        self._step_fut = loop.create_future()
+        while self._running:
+            if self.router.idle:
+                await asyncio.sleep(self.idle_sleep_s)
+                continue
+            self.router.step()
+            for rid in [r for r in self._abandoned
+                        if not self.router.knows(r)
+                        or self.router.result(r) is not None]:
+                self.router.pop_result(rid)
+                self._abandoned.discard(rid)
+            fut, self._step_fut = self._step_fut, loop.create_future()
+            fut.set_result(None)
+            await asyncio.sleep(0)         # let handlers consume
+
+    async def _next_step(self) -> None:
+        fut = self._step_fut
+        if fut is None:
+            await asyncio.sleep(self.idle_sleep_s)
+            return
+        try:
+            await asyncio.wait_for(asyncio.shield(fut),
+                                   timeout=self.step_wait_s)
+        except asyncio.TimeoutError:
+            pass
+
+    # ------------------------------------------------------------- server
+
+    async def start(self, host: str = "127.0.0.1",
+                    port: int = 0) -> Tuple[str, int]:
+        """Bind + start serving; returns the bound (host, port)
+        (port 0 = ephemeral, for tests)."""
+        self._running = True
+        self._server = await asyncio.start_server(self._handle, host,
+                                                  port)
+        self._driver = asyncio.ensure_future(self._drive())
+        self._driver.add_done_callback(self._on_driver_done)
+        sock = self._server.sockets[0].getsockname()
+        return sock[0], sock[1]
+
+    def _on_driver_done(self, fut: asyncio.Future) -> None:
+        """A dead driver is a dead server: without this callback an
+        exception from ``router.step()`` sits in the never-awaited
+        future while the server keeps accepting connections that can
+        never complete. Surface it loudly and fail every waiter."""
+        if fut.cancelled():
+            return
+        exc = fut.exception()
+        if exc is None:
+            return
+        import sys
+        import traceback
+        self._running = False
+        print("serve driver task died; shutting down:", file=sys.stderr)
+        traceback.print_exception(type(exc), exc, exc.__traceback__,
+                                  file=sys.stderr)
+        # wake every SSE handler blocked on the next step with the
+        # failure (they fail their connection instead of spinning on
+        # the step_wait_s timeout forever)
+        if self._step_fut is not None and not self._step_fut.done():
+            self._step_fut.set_exception(exc)
+        if self._server is not None:
+            self._server.close()
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        try:
+            if self._driver is not None:
+                self._driver.cancel()
+                try:
+                    await self._driver
+                except asyncio.CancelledError:
+                    pass
+        finally:
+            # a driver that died re-raises above — the journals still
+            # close
+            self.router.close()
+
+    async def serve_forever(self, host: str, port: int) -> None:
+        h, p = await self.start(host, port)
+        import sys
+        print(f"serving on http://{h}:{p} "
+              f"({self.router.rcfg.n_replicas} replica(s))",
+              file=sys.stderr)
+        async with self._server:
+            await self._server.serve_forever()
+
+    # ----------------------------------------------------------- handlers
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            try:
+                line = await reader.readline()
+                parts = line.decode("latin-1").split()
+                if len(parts) < 2:
+                    return
+                method, path = parts[0].upper(), parts[1]
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode("latin-1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                n = int(headers.get("content-length", "0") or 0)
+            except ValueError:
+                # a request/header line over the StreamReader limit
+                # (readline raises ValueError) or a non-numeric
+                # Content-Length — answer 400, don't drop the socket
+                await self._json(writer, 400,
+                                 {"error": "malformed request"})
+                return
+            body = b""
+            if n:
+                body = await reader.readexactly(n)
+            await self._dispatch(method, path.split("?", 1)[0], body,
+                                 writer)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _dispatch(self, method: str, path: str, body: bytes,
+                        writer: asyncio.StreamWriter) -> None:
+        if path == "/healthz" and method == "GET":
+            h = self.router.healthz()
+            await self._json(writer, 200 if h["ok"] else 503, h)
+        elif path in ("/metrics", "/v1/metrics") and method == "GET":
+            text = prometheus_text(self.router.metrics,
+                                   prefix="tpu_gpt_fleet")
+            await self._raw(writer, 200, text.encode(),
+                            "text/plain; version=0.0.4")
+        elif path == "/v1/submit" and method == "POST":
+            rid, err = self._submit(body)
+            if err is not None:
+                await self._json(writer, err[0], {"error": err[1]})
+            else:
+                await self._json(writer, 200,
+                                 {"id": rid, "status": "accepted"})
+        elif path == "/v1/generate" and method == "POST":
+            rid, err = self._submit(body)
+            if err is not None:
+                await self._json(writer, err[0], {"error": err[1]})
+            else:
+                await self._stream(rid, writer)
+        elif path.startswith("/v1/stream/") and method == "GET":
+            rid = path[len("/v1/stream/"):]
+            if (not self.router.knows(rid)):
+                await self._json(writer, 404, {"error": "unknown id"})
+            else:
+                await self._stream(rid, writer)
+        elif path.startswith("/v1/cancel/") and method == "POST":
+            rid = path[len("/v1/cancel/"):]
+            await self._json(writer, 200,
+                             {"id": rid,
+                              "cancelled": self.router.cancel(rid)})
+        elif path.startswith("/v1/result/") and method == "GET":
+            rid = path[len("/v1/result/"):]
+            res = self.router.result(rid)
+            if res is not None:
+                self.router.pop_result(rid)
+                await self._json(writer, 200,
+                                 {**res.to_dict(), "tokens": res.tokens})
+            elif self.router.knows(rid):
+                await self._json(writer, 202, {"id": rid,
+                                               "status": "running"})
+            else:
+                await self._json(writer, 404, {"error": "unknown id"})
+        else:
+            await self._json(writer, 404 if method in ("GET", "POST")
+                             else 405, {"error": f"no route {method} "
+                                                 f"{path}"})
+
+    def _submit(self, body: bytes):
+        """Parse + route one submit; returns (id, None) or
+        (None, (status, message))."""
+        try:
+            doc = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            return None, (400, "body is not valid JSON")
+        if not isinstance(doc, dict):
+            return None, (400, "body must be a JSON object")
+        req, perr = request_from_json(
+            doc, f"h{next(self._ids):06d}", self.router.clock,
+            vocab=self.router.replicas[0].engine.cfg.vocab_size)
+        if req is None:
+            return None, (400, perr)
+        rej = self.router.submit(req)
+        if rej is not None:
+            status = REASON_STATUS.get(rej.finish_reason, 400)
+            return None, (status, rej.finish_reason)
+        return req.id, None
+
+    def _emit_new_tokens(self, rid: str,
+                         writer: asyncio.StreamWriter, i: int) -> int:
+        """Drain the delivery ledger into SSE events; returns the next
+        event index."""
+        for t in self.router.take_new_tokens(rid):
+            writer.write(f"data: {json.dumps({'token': t, 'i': i})}"
+                         f"\n\n".encode())
+            i += 1
+        return i
+
+    async def _stream(self, rid: str,
+                      writer: asyncio.StreamWriter) -> None:
+        """SSE token stream through the router's exactly-once delivery
+        ledger; a client disconnect mid-stream cancels the request —
+        its slot and KV pages free immediately, not at completion."""
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        i = 0
+        try:
+            await writer.drain()
+            while True:
+                i = self._emit_new_tokens(rid, writer, i)
+                await writer.drain()
+                res = self.router.result(rid)
+                if res is not None:
+                    # final ledger drain: the request may have finished
+                    # (with more tokens) while we were suspended in
+                    # drain() above — those must go out before `done`
+                    i = self._emit_new_tokens(rid, writer, i)
+                    done = {"finish_reason": res.finish_reason,
+                            "n_tokens": len(res.tokens),
+                            "ttft_s": round(res.ttft_s, 6),
+                            "total_s": round(res.total_s, 6)}
+                    writer.write(f"event: done\ndata: "
+                                 f"{json.dumps(done)}\n\n".encode())
+                    await writer.drain()
+                    self.router.pop_result(rid)
+                    return
+                if not self.router.knows(rid):
+                    writer.write(b"event: error\ndata: "
+                                 b"{\"error\": \"request lost\"}\n\n")
+                    await writer.drain()
+                    return
+                await self._next_step()
+        except (ConnectionError, OSError):
+            # client went away mid-stream: release the slot/pages NOW,
+            # and hand the id to the driver's abandoned sweep — the
+            # cancelled (or already-terminal) result must still be
+            # popped or the results/ledger maps grow per disconnect
+            if self.router.pop_result(rid) is None:
+                self.router.cancel(rid)
+                self._abandoned.add(rid)
+
+    async def _json(self, writer, status: int, obj: dict) -> None:
+        await self._raw(writer, status,
+                        (json.dumps(obj) + "\n").encode(),
+                        "application/json")
+
+    async def _raw(self, writer, status: int, payload: bytes,
+                   ctype: str) -> None:
+        writer.write(
+            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, '')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            f"Connection: close\r\n\r\n".encode())
+        writer.write(payload)
+        await writer.drain()

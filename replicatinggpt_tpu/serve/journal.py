@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, TextIO
 
 import numpy as np
 
+from ..utils.jsonl import load_jsonl_if_exists
 from .requests import Request, SamplingParams
 
 
@@ -74,37 +75,37 @@ class RequestJournal:
         ``telemetry`` (utils.telemetry) marks the replay as an instant
         on the recovered engine's timeline — restart recovery shows up
         next to the requeued requests' span trees."""
-        if not os.path.exists(path):
-            return []
         submits: Dict[str, Request] = {}
         order: List[str] = []
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue          # torn tail record from the crash
-                if rec.get("ev") == "submit":
-                    rid = rec["id"]
-                    if rid not in submits:
-                        order.append(rid)
-                    submits[rid] = Request(
-                        id=rid,
-                        # host JSON list -> host array; no device involved
-                        prompt=np.asarray(rec["prompt"],  # graftlint: disable=GL004
-                                          np.int32),
-                        max_new_tokens=rec["max_new_tokens"],
-                        sampling=SamplingParams(
-                            temperature=rec["temperature"],
-                            top_k=rec["top_k"], top_p=rec["top_p"],
-                            greedy=rec["greedy"]),
-                        rng_seed=rec["rng_seed"])
-                elif rec.get("ev") == "finish":
-                    submits.pop(rec["id"], None)
-        out = [submits[rid] for rid in order if rid in submits]
+        # torn-tail tolerance lives in utils.jsonl (shared with the
+        # telemetry sink readers and the fleet router's journal replay)
+        for rec in load_jsonl_if_exists(path):
+            if rec.get("ev") == "submit":
+                rid = rec["id"]
+                if rid not in submits:
+                    order.append(rid)
+                submits[rid] = Request(
+                    id=rid,
+                    # host JSON list -> host array; no device involved
+                    prompt=np.asarray(rec["prompt"],  # graftlint: disable=GL004
+                                      np.int32),
+                    max_new_tokens=rec["max_new_tokens"],
+                    sampling=SamplingParams(
+                        temperature=rec["temperature"],
+                        top_k=rec["top_k"], top_p=rec["top_p"],
+                        greedy=rec["greedy"]),
+                    rng_seed=rec["rng_seed"])
+            elif rec.get("ev") == "finish":
+                submits.pop(rec["id"], None)
+        # an id can appear in `order` twice (finished, then a fresh
+        # request reused the id and was journaled again) — emit each
+        # unfinished id exactly ONCE or the caller would requeue and
+        # decode it twice
+        out, seen = [], set()
+        for rid in order:
+            if rid in submits and rid not in seen:
+                seen.add(rid)
+                out.append(submits[rid])
         if telemetry is not None and telemetry.enabled:
             telemetry.instant("journal_replay", requeued=len(out))
         return out

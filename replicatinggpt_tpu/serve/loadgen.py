@@ -1,0 +1,313 @@
+"""Multi-turn session load generator + fleet replay driver.
+
+The single-engine replay (serve/replay.py) proves the engine against a
+Poisson trace of independent one-shot prompts. Real front-door traffic
+is *sessions*: a user opens a conversation under one of a few system
+prompts, and each turn re-enters the engine with the whole history as
+its prompt — exactly the shape the radix prefix cache and the router's
+prefix affinity exist for. This module generates that traffic and
+drives it through a :class:`~.router.Router`:
+
+- ``n_prefix_groups`` shared system prefixes (the "system prompt"
+  population); each session draws one and opens with it;
+- turn ``k``'s prompt = the full prior context (previous prompt +
+  generated tokens) + fresh user tokens — submitted only after turn
+  ``k-1`` finished (closed-loop per session, open-loop Poisson across
+  session starts);
+- the ``fleet/session`` chaos seam (faults/fleet.py,
+  ``hot_key_skew``) collapses sessions onto group 0 with the planned
+  probability, turning the mix into hot-key traffic;
+- the driver consumes tokens through the router's delivery ledger
+  (``take_new_tokens``) every step, so a soak with replica kills
+  asserts the exactly-once stream property end to end.
+
+Deterministic by construction: all randomness is seeded, and with
+``virtual_dt`` set the driver runs on a virtual clock (arrivals and
+deadlines in virtual seconds, one tick per router step) so a chaos
+test's admission order cannot wobble with host load. Wall-clock mode
+(``virtual_dt=0``) is what ``bench.py --mode fleet`` measures.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config import ModelConfig
+from ..faults.fleet import session_skew
+from ..utils.telemetry import MetricsTimeline, Telemetry, prometheus_text
+from .engine import Engine, EngineConfig, compile_counts
+from .requests import Request, RequestResult, SamplingParams
+from .router import Router, RouterConfig
+
+
+@dataclass(frozen=True)
+class SessionLoadConfig:
+    """Session-traffic shape. Sizing must fit the model's block_size:
+    ``prefix_len + turns * (user_len_max + max_new_tokens)`` is the
+    worst-case final context (validated in :func:`make_sessions`)."""
+
+    n_sessions: int = 8
+    turns: int = 3
+    n_prefix_groups: int = 2
+    prefix_len: int = 12
+    user_len_min: int = 2
+    user_len_max: int = 4
+    max_new_tokens: int = 6
+    rate: float = 100.0            # session-start arrivals/sec (Poisson)
+    think_time_s: float = 0.0      # finish -> next-turn gap
+    greedy: bool = True
+    seed: int = 0
+
+
+@dataclass
+class _Session:
+    sid: int
+    group: int
+    context: np.ndarray            # tokens so far (prompt + generated)
+    user_turns: List[np.ndarray]   # pre-drawn user tokens per turn
+    next_turn: int = 0
+    due_t: float = 0.0             # when the next turn submits
+    waiting_on: Optional[str] = None
+
+
+class StepClock:
+    """Injectable virtual clock for deterministic fleet replays: the
+    driver advances it one ``dt`` per router step, so arrival order,
+    TTFT buckets and deadline math are identical run to run."""
+
+    def __init__(self, t0: float = 0.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_sessions(mcfg: ModelConfig, lcfg: SessionLoadConfig
+                  ) -> List[_Session]:
+    """Seeded session population: group prefixes, per-session start
+    times (Poisson), per-turn user token draws. The ``hot_key_skew``
+    chaos seam is consulted per session — with a plan installed, a
+    session collapses onto group 0 with the planned probability."""
+    worst = (lcfg.prefix_len
+             + lcfg.turns * (lcfg.user_len_max + lcfg.max_new_tokens))
+    assert worst <= mcfg.block_size, (
+        f"session worst-case context {worst} exceeds block_size "
+        f"{mcfg.block_size}: shrink turns/user_len/max_new_tokens")
+    rng = np.random.default_rng(lcfg.seed)
+    prefixes = [rng.integers(0, mcfg.vocab_size, (lcfg.prefix_len,),
+                             dtype=np.int64).astype(np.int32)
+                for _ in range(lcfg.n_prefix_groups)]
+    # all scalar randomness drawn vectorized up front (host numpy, but
+    # keeps the per-session loop free of float()/asarray per GL004)
+    starts = np.cumsum(rng.exponential(1.0 / max(lcfg.rate, 1e-9),
+                                       lcfg.n_sessions))
+    groups = rng.integers(0, lcfg.n_prefix_groups, lcfg.n_sessions)
+    skew_draws = rng.random(lcfg.n_sessions)
+    out: List[_Session] = []
+    for sid in range(lcfg.n_sessions):
+        group = int(groups[sid])
+        skew = session_skew(sid)
+        if skew > 0 and skew_draws[sid] < skew:
+            group = 0              # the hot key
+        turns = []
+        for _ in range(lcfg.turns):
+            n = int(rng.integers(lcfg.user_len_min,
+                                 lcfg.user_len_max + 1))
+            turns.append(rng.integers(0, mcfg.vocab_size, (n,),
+                                      dtype=np.int64).astype(np.int32))
+        out.append(_Session(sid=sid, group=group,
+                            context=prefixes[group].copy(),
+                            user_turns=turns, due_t=starts[sid]))
+    return out
+
+
+def session_request(s: _Session, lcfg: SessionLoadConfig) -> Request:
+    """Build turn ``s.next_turn``'s request: full context + this turn's
+    user tokens, with a per-(session, turn) rng seed so regeneration
+    after a requeue is exact."""
+    prompt = np.concatenate([s.context, s.user_turns[s.next_turn]])
+    return Request(
+        id=f"s{s.sid:03d}t{s.next_turn}", prompt=prompt,
+        max_new_tokens=lcfg.max_new_tokens,
+        sampling=SamplingParams(greedy=lcfg.greedy),
+        rng_seed=lcfg.seed * 1_000_003 + s.sid * 101 + s.next_turn)
+
+
+def run_fleet_replay(params, mcfg: ModelConfig,
+                     lcfg: SessionLoadConfig,
+                     rcfg: RouterConfig = RouterConfig(),
+                     ecfg: EngineConfig = EngineConfig(),
+                     warmup: bool = True,
+                     virtual_dt: float = 0.0,
+                     collect_streams: bool = False,
+                     trace_out: Optional[str] = None,
+                     metrics_timeline: Optional[str] = None,
+                     metrics_timeline_interval_s: float = 0.5,
+                     metrics_out: Optional[str] = None,
+                     max_steps: int = 1_000_000) -> dict:
+    """Drive the session workload through a router fleet; returns the
+    fleet summary (per-replica occupancy + pages, requeue counters,
+    fleet TTFT distribution, aggregate prefix-hit rate,
+    recompiles-after-warmup) plus per-session completion stats.
+
+    ``virtual_dt > 0`` runs the whole replay on a :class:`StepClock`
+    (deterministic chaos tests); 0 replays in wall-clock time (bench).
+    ``collect_streams`` returns every request's router-delivered token
+    stream under ``"streams"`` — the exactly-once-across-migration
+    evidence the fleet chaos tests assert on. Observability artifacts
+    (``trace_out`` Perfetto trace with router + per-replica tracks,
+    ``metrics_timeline`` JSONL series of the ROUTER's metrics,
+    ``metrics_out`` Prometheus text with per-replica gauges) mirror
+    serve/replay.py's contract; paths land in ``summary["artifacts"]``.
+    """
+    if warmup:
+        w = Engine(params, mcfg, ecfg)
+        w.submit(Request(id="warmup", prompt=np.zeros((1,), np.int32),
+                         max_new_tokens=1,
+                         sampling=SamplingParams(greedy=True)))
+        w.drain()
+    warm = compile_counts()
+
+    clock = StepClock() if virtual_dt > 0 else time.monotonic
+    tel = Telemetry(clock=clock) if trace_out else None
+    router = Router(params, mcfg, rcfg, ecfg, clock=clock, telemetry=tel)
+    timeline = None
+    if metrics_timeline:
+        timeline = MetricsTimeline(router.metrics, metrics_timeline,
+                                   interval_s=metrics_timeline_interval_s,
+                                   clock=clock)
+        timeline.snapshot(step=0)
+    sessions = make_sessions(mcfg, lcfg)
+    streams: Dict[str, List[int]] = {}
+    inflight_ids: List[str] = []
+    results: Dict[str, RequestResult] = {}
+    turns_done = 0
+    t0 = clock()
+    steps = 0
+    try:
+        while True:
+            # the runaway guard counts EVERY loop iteration, idle
+            # branch included — a stall where the router reports idle
+            # but sessions still wait must raise, not spin forever
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"fleet replay did not finish in {max_steps} steps")
+            now = clock()
+            for s in sessions:
+                if (s.waiting_on is None and s.next_turn < lcfg.turns
+                        and s.due_t <= now - t0):
+                    req = session_request(s, lcfg)
+                    s.waiting_on = req.id
+                    streams.setdefault(req.id, [])
+                    rej = router.submit(req)
+                    if rej is not None:
+                        results[req.id] = rej
+                        s.waiting_on = None
+                        s.next_turn = lcfg.turns    # session abandoned
+                    else:
+                        inflight_ids.append(req.id)
+            pending_turns = any(
+                s.next_turn < lcfg.turns for s in sessions)
+            if router.idle:
+                if not pending_turns:
+                    break
+                # nothing in flight: run the clock to the next arrival
+                if virtual_dt > 0:
+                    clock.advance(virtual_dt)
+                else:
+                    # default: no session is submit-ready (a stuck
+                    # state) — spin to the max_steps RuntimeError
+                    # instead of dying on min() of an empty sequence
+                    nxt = min((s.due_t for s in sessions
+                               if s.waiting_on is None
+                               and s.next_turn < lcfg.turns),
+                              default=now - t0)
+                    time.sleep(min(max(nxt - (now - t0), 0.0), 0.05))
+                continue
+            finished = router.step()
+            # deliver: the ONE consumption path (exactly-once ledger)
+            inflight_ids = [rid for rid in inflight_ids
+                            if rid not in results]
+            for rid in inflight_ids:
+                streams[rid].extend(router.take_new_tokens(rid))
+            for res in finished:
+                results[res.id] = res
+                streams[res.id].extend(router.take_new_tokens(res.id))
+                turns_done += 1
+                for s in sessions:
+                    if s.waiting_on == res.id:
+                        s.waiting_on = None
+                        if res.ok:
+                            # next turn re-enters with the WHOLE history
+                            # (previous prompt + generated) — the
+                            # prefix-cache / affinity traffic shape
+                            prev = np.concatenate(
+                                [s.context, s.user_turns[s.next_turn]])
+                            s.context = np.concatenate(
+                                [prev,
+                                 np.fromiter(res.tokens, np.int32,
+                                             count=len(res.tokens))])
+                            s.next_turn += 1
+                            s.due_t = ((clock() - t0)
+                                       + lcfg.think_time_s)
+                        else:
+                            # cancelled / shed / expired / capacity:
+                            # the session has no coherent history to
+                            # continue from — it ends here
+                            s.next_turn = lcfg.turns
+                        break
+            if timeline is not None:
+                timeline.maybe_snapshot(step=router.n_steps)
+            if virtual_dt > 0:
+                clock.advance(virtual_dt)
+    finally:
+        if tel is not None:
+            n_trace_events = tel.export_chrome_trace(trace_out)
+            tel.close()
+        if timeline is not None:
+            timeline.close(step=router.n_steps)
+        router.close()
+    wall_s = clock() - t0
+
+    done = compile_counts()
+    summary = router.fleet_summary()
+    ok = [r for r in results.values() if r.ok]
+    summary.update({
+        "n_sessions": lcfg.n_sessions,
+        "turns_per_session": lcfg.turns,
+        "n_requests": len(results),
+        "turns_finished": turns_done,
+        "n_completed": len(ok),
+        "n_rejected": sum(r.finish_reason.startswith("rejected")
+                          for r in results.values()),
+        "generated_tokens": sum(len(r.tokens)
+                                for r in results.values()),
+        "wall_s": round(wall_s, 3),
+        "recompiles_after_warmup": (sum(done.values())
+                                    - sum(warm.values())),
+    })
+    artifacts = {}
+    if tel is not None:
+        artifacts["trace_out"] = trace_out
+        artifacts["trace_events"] = n_trace_events
+    if timeline is not None:
+        artifacts["metrics_timeline"] = metrics_timeline
+        artifacts["metrics_timeline_snapshots"] = timeline.n_snapshots
+    if metrics_out:
+        with open(metrics_out, "w") as f:
+            f.write(prometheus_text(router.metrics, prefix="tpu_gpt_fleet"))
+        artifacts["metrics_out"] = metrics_out
+    if artifacts:
+        summary["artifacts"] = artifacts
+    if collect_streams:
+        summary["streams"] = streams
+        summary["results"] = results
+    return summary

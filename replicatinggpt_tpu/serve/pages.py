@@ -405,6 +405,18 @@ class PagedCachePool:
         return bool(self._free_slots) and self.alloc.can_acquire(
             np.asarray(prompt, np.int32), cap)
 
+    def cached_prefix_tokens(self, prompt: np.ndarray) -> int:
+        """Longest radix-cached prefix of ``prompt`` in TOKENS, without
+        touching LRU stamps or claiming anything — the fleet router's
+        affinity probe (route a session to the replica that already
+        owns its prefix). 0 with the prefix cache off."""
+        if not self.alloc.prefix_cache:
+            return 0
+        chain = self.alloc.radix.lookup(
+            np.asarray(prompt, np.int32).reshape(-1), self.page_size,
+            touch=False)
+        return len(chain) * self.page_size
+
     def acquire(self, request_id: str, prompt: np.ndarray,
                 cap: int) -> Optional[Admission]:
         if not self._free_slots:

@@ -1,0 +1,720 @@
+"""Multi-replica router: the fleet tier over N in-process engines.
+
+One engine is a chip; "millions of users" is a fleet. This module
+load-balances requests across N engine replicas and keeps the fleet's
+promises when replicas misbehave:
+
+- **Radix-prefix affinity**: a request is routed to the replica whose
+  ``RadixIndex`` already owns the longest prefix of its prompt
+  (``PagedCachePool.cached_prefix_tokens`` — a pure peek, no LRU
+  touch), falling back to least-loaded. Multi-turn sessions therefore
+  stick to the replica holding their conversation's KV pages, and the
+  fleet's aggregate prefix-hit rate stays close to a single replica's
+  (pinned in tests/test_fleet.py).
+- **Health probes**: the router times every replica step and reads each
+  engine's telemetry counters (queue depth, slots, watchdog stalls —
+  the PR-7 Metrics substrate) into per-replica gauges. A replica whose
+  steps blow the wedge budget ``wedge_patience`` times in a row is
+  *wedged* — quarantined from new routes with its in-flight work
+  re-routed (below).
+- **Requeue across death**: a killed replica's accepted-but-unfinished
+  requests are rebuilt from its crash journal
+  (``RequestJournal.unfinished`` over the shared torn-tail-tolerant
+  ``utils.jsonl`` reader) and resubmitted to survivors with bounded
+  retry + exponential backoff. Regeneration is deterministic (prompt +
+  sampling + per-request rng_seed), so greedy output is token-identical
+  to an uninterrupted run; the router's delivery ledger
+  (:meth:`Router.take_new_tokens`) dedupes the stream so a client sees
+  every token exactly once across a migration — no drops, no
+  duplicates.
+- **Hedged re-route on wedge**: a wedged (but not dead) replica's
+  in-flight requests are cancelled with ``migrated=True`` (the engine
+  releases their slots/pages immediately and tags the telemetry
+  envelope as a non-terminal segment) and re-raced onto healthy
+  replicas — the fleet never double-decodes an id (the PR-5
+  in-flight-id invariant, extended fleet-wide by the router's own
+  dedupe at :meth:`submit`).
+
+Single-threaded by design, like the engine: one loop drives
+:meth:`Router.step`. The HTTP front door (serve/http.py) and the fleet
+replay driver (serve/loadgen.py) are both such loops.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..config import ModelConfig
+from ..faults.fleet import (KIND_REPLICA_KILL, KIND_REPLICA_WEDGE,
+                            fleet_step_fault)
+from ..utils.jsonl import load_jsonl_if_exists
+from ..utils.logging import Metrics
+from ..utils.telemetry import (NULL, REPLICA_TRACK_STRIDE, ROUTER_TRACK,
+                               ROUTER_TRACK_NAME)
+from .engine import Engine, EngineConfig
+from .journal import RequestJournal
+from .requests import (FINISH_CANCELLED, FINISH_DEADLINE,
+                       REJECT_BAD_REQUEST, REJECT_PROMPT_TOO_LONG,
+                       REJECT_QUEUE_FULL, Request, RequestResult)
+
+#: finish_reason when bounded retry exhausts without a replica
+#: accepting the requeued request
+REJECT_FLEET_CAPACITY = "rejected_fleet_capacity"
+
+#: rejection verdicts deterministic across replicas (same config, same
+#: clock): every replica would say the same thing, so trying another
+#: one — or retrying later — is pointless and would inflate the
+#: fleet_route_fallbacks capacity-pressure signal
+TERMINAL_REJECTS = frozenset({REJECT_BAD_REQUEST,
+                              REJECT_PROMPT_TOO_LONG, FINISH_DEADLINE})
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Fleet sizing + routing/recovery knobs (docs/serving.md)."""
+
+    n_replicas: int = 2
+    #: per-replica crash journals live here (replica{i}.jsonl); None
+    #: disables journals — and with them cross-replica requeue
+    journal_dir: Optional[str] = None
+    #: route by longest cached prefix (False: pure least-loaded)
+    affinity: bool = True
+    #: requeue/submit retry ladder: a rejected resubmission retries up
+    #: to retry_max times, backing off retry_backoff_steps * 2^attempt
+    #: router steps between tries
+    retry_max: int = 4
+    retry_backoff_steps: int = 1
+    #: wedge probe: a replica step slower than wedge_budget_s,
+    #: wedge_patience times consecutively, marks the replica wedged
+    #: (0 = detection off). The first wedge_skip_steps steps per
+    #: replica are exempt (warmup compiles).
+    wedge_budget_s: float = 0.0
+    wedge_patience: int = 2
+    wedge_skip_steps: int = 3
+    #: router steps a wedged replica sits out before rejoining rotation
+    quarantine_steps: int = 8
+
+
+@dataclass
+class _InFlight:
+    """Router-side ledger entry for one accepted request."""
+
+    req: Request
+    replica: int
+    t_submit: float            # fleet submit time (router clock)
+    attempts: int = 0
+
+
+@dataclass
+class _Requeue:
+    """A request between replicas: awaiting (re)submission."""
+
+    req: Request
+    t_submit: float
+    attempts: int
+    due_step: int
+
+
+@dataclass
+class Replica:
+    """One engine + its crash journal + router-side health state."""
+
+    idx: int
+    engine: Engine
+    journal_path: Optional[str]
+    journal: Optional[RequestJournal]
+    alive: bool = True
+    wedged: bool = False
+    suspect_streak: int = 0
+    skip_steps: int = 0
+    quarantine_until: int = 0
+    last_step_s: float = 0.0
+    steps: int = 0
+
+    @property
+    def routable(self) -> bool:
+        return self.alive and not self.wedged
+
+    @property
+    def load(self) -> int:
+        e = self.engine
+        return e.scheduler.depth + int(e._active.sum())
+
+    def health(self) -> dict:
+        """The per-replica health probe: router-side state + the
+        engine's own telemetry counters/gauges (PR-7 Metrics)."""
+        c = self.engine.metrics.counters
+        return {
+            "replica": self.idx,
+            "alive": self.alive,
+            "wedged": self.wedged,
+            "queue_depth": self.engine.scheduler.depth,
+            "slots_active": int(self.engine._active.sum()),
+            "pages_in_use": self.engine.pool.alloc.pages_in_use,
+            "watchdog_stalls": int(c.get("watchdog_stalls", 0)),
+            "shed_requests": int(c.get("shed_requests", 0)),
+            "requests_admitted": int(c.get("requests_admitted", 0)),
+            "last_step_ms": round(self.last_step_s * 1e3, 3),
+        }
+
+
+class Router:
+    """N-replica front tier: submit/cancel/step/drain over the fleet.
+
+    Same single-threaded host API shape as :class:`Engine` — ``submit``
+    returns None (accepted) or a terminal rejection, ``step`` advances
+    every live replica one scheduling iteration and returns the fleet's
+    newly finished results, ``drain`` runs to idle.
+    """
+
+    def __init__(self, params, cfg: ModelConfig,
+                 rcfg: RouterConfig = RouterConfig(),
+                 ecfg: EngineConfig = EngineConfig(),
+                 clock: Callable[[], float] = time.monotonic,
+                 telemetry=None, resilience=None,
+                 drafter_factory: Optional[Callable[[], object]] = None):
+        assert rcfg.n_replicas >= 1, rcfg.n_replicas
+        self.rcfg = rcfg
+        self.clock = clock
+        self.tel = telemetry or NULL
+        if self.tel.enabled:
+            self.tel.name_track(ROUTER_TRACK, ROUTER_TRACK_NAME)
+        self.metrics = Metrics()
+        self.replicas: List[Replica] = []
+        for i in range(rcfg.n_replicas):
+            jpath = jr = None
+            if rcfg.journal_dir is not None:
+                jpath = os.path.join(rcfg.journal_dir,
+                                     f"replica{i}.jsonl")
+                jr = RequestJournal(jpath)
+            eng = Engine(params, cfg, ecfg, clock=clock,
+                         drafter=(drafter_factory() if drafter_factory
+                                  else None),
+                         rcfg=resilience, journal=jr, telemetry=self.tel,
+                         track_base=i * REPLICA_TRACK_STRIDE,
+                         track_label=f"replica{i} ")
+            self.replicas.append(Replica(
+                idx=i, engine=eng, journal_path=jpath, journal=jr,
+                skip_steps=rcfg.wedge_skip_steps))
+        self.n_steps = 0
+        self._inflight: Dict[str, _InFlight] = {}
+        self._requeue: List[_Requeue] = []
+        #: id -> replica whose engine-surfaced terminal result must be
+        #: swallowed (hedged re-route cancelled that copy on that
+        #: replica; keyed by replica so the LIVE copy's finish on a
+        #: different replica is never mistaken for the dead one's)
+        self._superseded: Dict[str, int] = {}
+        #: tokens handed to the consumer per id — survives migration,
+        #: making delivery exactly-once (take_new_tokens)
+        self._delivered: Dict[str, int] = {}
+        self._ttft: Dict[str, float] = {}      # fleet TTFT per id
+        #: terminal results produced by the ROUTER (kill without a
+        #: journal, journaled-finish on a dead replica, cancel of a
+        #: requeued request) — drained into the next step()'s return so
+        #: drivers consuming step() output learn about them exactly
+        #: like engine-surfaced finishes
+        self._router_finished: List[RequestResult] = []
+        self.results: Dict[str, RequestResult] = {}
+        self.events: List[str] = []
+        self._gauges()     # /metrics carries per-replica gauges from step 0
+
+    # ---------------------------------------------------------------- API
+
+    def submit(self, req: Request) -> Optional[RequestResult]:
+        """Route and submit one request; None = accepted somewhere.
+        Duplicate in-flight ids are rejected fleet-wide (an id keys the
+        delivery ledger, the journals, and cancellation — the PR-5
+        invariant, now across replicas: a duplicate arriving at a
+        *second* replica after a kill is rejected, never
+        double-decoded)."""
+        self.metrics.inc("fleet_requests_submitted")
+        if self.knows(req.id):
+            self.metrics.inc("fleet_dedup_rejects")
+            return RequestResult(id=req.id, tokens=[],
+                                 finish_reason=REJECT_BAD_REQUEST)
+        return self._submit_routed(req, self.clock(), attempts=0)
+
+    def cancel(self, request_id: str) -> bool:
+        fi = self._inflight.get(request_id)
+        if fi is not None:
+            return self.replicas[fi.replica].engine.cancel(request_id)
+        for i, item in enumerate(self._requeue):
+            if item.req.id == request_id:
+                del self._requeue[i]
+                self._record_result(RequestResult(
+                    id=request_id, tokens=[],
+                    finish_reason=FINISH_CANCELLED), item.t_submit)
+                return True
+        return False
+
+    @property
+    def idle(self) -> bool:
+        # undelivered router-side terminal results keep the fleet
+        # non-idle: one more step() must run to surface them
+        return (not self._requeue and not self._router_finished
+                and all(r.engine.idle for r in self.replicas if r.alive))
+
+    @property
+    def n_alive(self) -> int:
+        return sum(r.alive for r in self.replicas)
+
+    def step(self) -> List[RequestResult]:
+        """One fleet scheduling iteration: fire fleet faults -> step
+        every live replica (timing each step for the wedge probe) ->
+        surface finishes -> re-route wedged replicas' work -> drain the
+        requeue/retry ladder -> refresh per-replica gauges."""
+        step_idx = self.n_steps
+        self.n_steps += 1
+        t0_us = self.tel.now_us() if self.tel.enabled else 0.0
+        wedge_delay: Dict[int, float] = {}
+
+        flt = fleet_step_fault(step_idx)
+        if flt is not None:
+            if flt.kind == KIND_REPLICA_KILL:
+                self._kill(int(flt.arg), step_idx)
+            elif flt.kind == KIND_REPLICA_WEDGE:
+                wedge_delay[int(flt.arg2)] = float(flt.arg)
+
+        out: List[RequestResult] = []
+        if self._router_finished:      # router-side terminals (kill
+            out.extend(self._router_finished)   # paths, cancels) surface
+            self._router_finished = []          # with this step's batch
+        now = self.clock()
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            t_wall = time.perf_counter()
+            delay = wedge_delay.get(rep.idx, 0.0)
+            if delay:
+                # the injected wedge: the replica's step stalls, inside
+                # the router's measurement — indistinguishable from a
+                # wedged device or a partition to that replica
+                time.sleep(delay)
+            finished = rep.engine.step()
+            rep.last_step_s = time.perf_counter() - t_wall
+            rep.steps += 1
+            self._probe(rep, step_idx)
+            for res in finished:
+                done = self._on_finish(res, rep.idx, now)
+                if done is not None:
+                    out.append(done)
+
+        self._observe_ttft(now)
+        self._drain_requeue(step_idx)
+        if self._router_finished:   # terminals recorded DURING this
+            out.extend(self._router_finished)   # step (retry exhaustion)
+            self._router_finished = []          # surface with its batch
+        self._gauges()
+        if self.tel.enabled:
+            self.tel.complete("router_step", ROUTER_TRACK, t0_us,
+                              self.tel.now_us() - t0_us, step=step_idx,
+                              n_finished=len(out),
+                              n_alive=self.n_alive)
+        return out
+
+    def drain(self, max_steps: int = 1_000_000) -> List[RequestResult]:
+        out: List[RequestResult] = []
+        for _ in range(max_steps):
+            if self.idle:
+                return out
+            out.extend(self.step())
+        raise RuntimeError(f"fleet did not drain in {max_steps} steps")
+
+    def take_new_tokens(self, request_id: str) -> List[int]:
+        """Consume the tokens newly available for ``request_id`` since
+        the last call — the ONE delivery path (SSE streaming and the
+        fleet replay both read through here). Exactly-once across
+        migration: a requeued request regenerates deterministically
+        from token 0, and this ledger suppresses the prefix already
+        delivered, so the concatenated stream equals the uninterrupted
+        token list."""
+        sent = self._delivered.get(request_id, 0)
+        res = self.results.get(request_id)
+        if res is not None:
+            new = res.tokens[sent:]
+        else:
+            fi = self._inflight.get(request_id)
+            if fi is None:
+                return []
+            partial = (self.replicas[fi.replica].engine
+                       .partial_tokens(request_id)) or []
+            new = partial[sent:]
+        if new:
+            self._delivered[request_id] = sent + len(new)
+        return new
+
+    def result(self, request_id: str) -> Optional[RequestResult]:
+        return self.results.get(request_id)
+
+    def knows(self, request_id: str) -> bool:
+        """Whether the id is anywhere in the fleet: in flight, between
+        replicas awaiting resubmission, or terminal-but-unclaimed."""
+        return (request_id in self._inflight
+                or request_id in self.results
+                or any(q.req.id == request_id for q in self._requeue))
+
+    def pop_result(self, request_id: str) -> Optional[RequestResult]:
+        """Take a terminal result out of the router's memory (the HTTP
+        layer calls this once a stream fully delivered — a long-lived
+        front door must not grow its results map without bound)."""
+        self._delivered.pop(request_id, None)
+        self._ttft.pop(request_id, None)
+        return self.results.pop(request_id, None)
+
+    def close(self) -> None:
+        for rep in self.replicas:
+            if rep.journal is not None:
+                rep.journal.close()
+
+    # ------------------------------------------------------------ summary
+
+    def fleet_summary(self) -> dict:
+        """Fleet-level health/metrics block: router counters, fleet
+        TTFT, per-replica occupancy + pages, aggregate prefix-hit rate
+        (the affinity claim is about the FLEET's aggregate)."""
+        c = self.metrics.counters
+        hit_tokens = prompt_tokens = 0
+        per_replica = []
+        for rep in self.replicas:
+            a = rep.engine.pool.alloc
+            hit_tokens += a.prefix_hit_tokens
+            prompt_tokens += a.prompt_tokens
+            s = rep.engine.metrics_summary()
+            per_replica.append({
+                "health": rep.health(),
+                "occupancy_mean": round(
+                    s["histograms"].get("batch_fill_ratio", {})
+                    .get("mean", 0.0), 4),
+                "n_steps": rep.engine.n_steps,
+                "pages": s["pages"],
+                "finished": {k: int(v) for k, v in
+                             rep.engine.metrics.counters.items()
+                             if k.startswith("finished_")},
+            })
+        return {
+            "n_replicas": len(self.replicas),
+            "n_alive": self.n_alive,
+            "n_steps": self.n_steps,
+            "router": {k: int(v) for k, v in sorted(c.items())},
+            "fleet_ttft_s": self.metrics.hist_summary("fleet_ttft_s"),
+            "aggregate_prefix_hit_rate": (
+                round(hit_tokens / prompt_tokens, 4)
+                if prompt_tokens else 0.0),
+            "replicas": per_replica,
+            "events": list(self.events[-32:]),
+        }
+
+    def healthz(self) -> dict:
+        """The /healthz body: ok iff at least one replica is routable."""
+        return {"ok": any(r.routable for r in self.replicas),
+                "n_alive": self.n_alive,
+                "replicas": [r.health() for r in self.replicas]}
+
+    # ----------------------------------------------------------- internals
+
+    def _event(self, msg: str) -> None:
+        self.events.append(msg)
+        if len(self.events) > 256:
+            del self.events[:len(self.events) - 256]
+
+    def _candidates(self, req: Request) -> List[int]:
+        """Replica indices to try, best first: longest cached prefix,
+        then least load, then index (stable)."""
+        avail = [r for r in self.replicas if r.routable]
+        if not avail:
+            # a fully wedged fleet still beats dropping the request on
+            # the floor: route to a wedged-but-alive replica
+            avail = [r for r in self.replicas if r.alive]
+        if not avail:
+            return []
+
+        def key(rep: Replica):
+            aff = (rep.engine.pool.cached_prefix_tokens(req.prompt)
+                   if self.rcfg.affinity else 0)
+            return (-aff, rep.load, rep.idx)
+
+        return [r.idx for r in sorted(avail, key=key)]
+
+    def _submit_routed(self, req: Request, t_submit: float,
+                       attempts: int) -> Optional[RequestResult]:
+        """Try every candidate replica once, in affinity/load order;
+        returns None on acceptance or the LAST rejection."""
+        last: Optional[RequestResult] = None
+        for idx in self._candidates(req):
+            rep = self.replicas[idx]
+            rej = rep.engine.submit(req)
+            if rej is None:
+                self._inflight[req.id] = _InFlight(
+                    req=req, replica=idx, t_submit=t_submit,
+                    attempts=attempts)
+                self.metrics.inc("fleet_requests_routed")
+                if self.tel.enabled:
+                    self.tel.instant(
+                        "route", ROUTER_TRACK, request=req.id,
+                        replica=idx, attempt=attempts,
+                        affinity_tokens=int(
+                            rep.engine.pool.cached_prefix_tokens(
+                                req.prompt)))
+                return None
+            last = rej
+            if rej.finish_reason in TERMINAL_REJECTS:
+                # a deterministic verdict (validation, prompt too long,
+                # dead-on-arrival deadline) — another replica would say
+                # the same thing
+                break
+            self.metrics.inc("fleet_route_fallbacks")
+        if last is None:       # no replicas at all
+            last = RequestResult(id=req.id, tokens=[],
+                                 finish_reason=REJECT_FLEET_CAPACITY)
+        return last
+
+    def _on_finish(self, res: RequestResult, replica: int,
+                   now: float) -> Optional[RequestResult]:
+        if self._superseded.get(res.id) == replica:
+            # the hedged re-route cancelled this copy ON THIS replica;
+            # the live copy is elsewhere — swallow it (keyed by replica
+            # so the live copy's own finish is never mistaken for it)
+            del self._superseded[res.id]
+            return None
+        fi = self._inflight.pop(res.id, None)
+        if fi is not None:
+            res.total_s = now - fi.t_submit
+            if res.id in self._ttft:
+                res.ttft_s = self._ttft[res.id]
+            elif res.tokens:
+                # finished in the same step its first token committed:
+                # _observe_ttft runs after the per-replica loop and only
+                # sees ids still in flight, so the FASTEST requests would
+                # never enter the fleet_ttft_s histogram (biasing the
+                # bench p50/p99 upward) — observe them here
+                res.ttft_s = now - fi.t_submit
+                self._ttft[res.id] = res.ttft_s
+                self.metrics.observe("fleet_ttft_s", res.ttft_s)
+        self.metrics.inc("fleet_requests_finished")
+        self.results[res.id] = res
+        return res
+
+    def _record_result(self, res: RequestResult, t_submit: float,
+                       envelope: bool = True) -> None:
+        """Terminal result produced by the ROUTER (requeue-retry
+        exhaustion, cancel-between-replicas, journaled-finish on a dead
+        replica) — when no engine closed this request's envelope, the
+        router emits the one terminal close itself, as a zero-length
+        envelope on the router track: every request id still forms
+        exactly one complete span tree (tools/trace_check.py), even
+        when its engine segments all ended ``migrated``.
+        ``envelope=False`` is the journaled-finish path: the engine
+        closed the terminal envelope when it journaled the finish (the
+        two happen together in ``_finish_slot``) — a second close here
+        would violate the exactly-one-terminal invariant."""
+        now = self.clock()
+        res.total_s = now - t_submit
+        if self.tel.enabled and envelope:
+            ts = self.tel.ts_us(now)
+            self.tel.begin("request", ROUTER_TRACK, ts_us=ts,
+                           request=res.id)
+            self.tel.end("request", ROUTER_TRACK, ts_us=ts,
+                         request=res.id, reason=res.finish_reason,
+                         n_tokens=len(res.tokens))
+        self.metrics.inc("fleet_requests_finished")
+        self.results[res.id] = res
+        self._router_finished.append(res)
+
+    def _observe_ttft(self, now: float) -> None:
+        """Fleet TTFT: first token OBSERVABLE at the router for each
+        in-flight id (tokens delivered before a migration count — the
+        client had them)."""
+        for rid, fi in self._inflight.items():
+            if rid in self._ttft or self._delivered.get(rid, 0):
+                continue
+            partial = (self.replicas[fi.replica].engine
+                       .partial_tokens(rid))
+            if partial:
+                self._ttft[rid] = now - fi.t_submit
+                self.metrics.observe("fleet_ttft_s", now - fi.t_submit)
+
+    def _probe(self, rep: Replica, step_idx: int) -> None:
+        """Wedge detection over per-step wall time + quarantine expiry."""
+        cfg = self.rcfg
+        if rep.wedged and step_idx >= rep.quarantine_until:
+            rep.wedged = False
+            rep.suspect_streak = 0
+            self.metrics.inc("fleet_replica_rejoins")
+            self._event(f"step {step_idx}: replica {rep.idx} rejoined")
+            self.tel.instant("replica_rejoin", ROUTER_TRACK,
+                             replica=rep.idx)
+        if cfg.wedge_budget_s <= 0 or rep.wedged:
+            return
+        if rep.skip_steps > 0:        # warmup compiles are not wedges
+            rep.skip_steps -= 1
+            return
+        if rep.last_step_s > cfg.wedge_budget_s:
+            rep.suspect_streak += 1
+        else:
+            rep.suspect_streak = 0
+        if rep.suspect_streak >= cfg.wedge_patience:
+            self._wedge(rep, step_idx)
+
+    def _wedge(self, rep: Replica, step_idx: int) -> None:
+        """Quarantine a wedged replica and hedge its in-flight work onto
+        healthy replicas (cancel-with-migrated on the suspect first, so
+        no id is ever live on two replicas — double-decode is
+        structurally impossible)."""
+        rep.wedged = True
+        rep.suspect_streak = 0
+        rep.quarantine_until = step_idx + self.rcfg.quarantine_steps
+        self.metrics.inc("fleet_replica_wedges")
+        self._event(f"step {step_idx}: replica {rep.idx} wedged "
+                    f"({rep.last_step_s * 1e3:.1f} ms step over "
+                    f"{self.rcfg.wedge_budget_s * 1e3:.1f} ms budget); "
+                    f"re-routing its in-flight work")
+        self.tel.instant("replica_wedge", ROUTER_TRACK, replica=rep.idx,
+                         step_ms=rep.last_step_s * 1e3)
+        n = 0
+        for rid in rep.engine.in_flight_ids():
+            fi = self._inflight.pop(rid, None)
+            if fi is None:
+                continue
+            rep.engine.cancel(rid, migrated=True)
+            self._superseded[rid] = rep.idx
+            self._requeue.append(_Requeue(
+                req=fi.req, t_submit=fi.t_submit,
+                attempts=fi.attempts, due_step=step_idx))
+            n += 1
+        if n:
+            self.metrics.inc("fleet_requeued_requests", n)
+            self.tel.instant("requeue", ROUTER_TRACK, replica=rep.idx,
+                             n=n, cause="wedge")
+
+    def _kill(self, idx: int, step_idx: int) -> None:
+        """Abandon a replica (the in-process stand-in for a process
+        death): close its telemetry envelopes as migrated segments,
+        replay its crash journal, requeue the unfinished."""
+        if not (0 <= idx < len(self.replicas)):
+            return
+        rep = self.replicas[idx]
+        if not rep.alive:
+            return
+        rep.alive = False
+        rep.wedged = False
+        self.metrics.inc("fleet_replica_kills")
+        self._event(f"step {step_idx}: replica {idx} KILLED; replaying "
+                    f"its journal")
+        self.tel.instant("replica_kill", ROUTER_TRACK, replica=idx)
+        now = self.clock()
+        # close open request envelopes on the dead replica's slot
+        # tracks: the router observed the death — the segments are
+        # non-terminal (migrated), the real tree completes elsewhere
+        if self.tel.enabled:
+            for rid, fi in self._inflight.items():
+                if fi.replica != idx:
+                    continue
+                slot = rep.engine.pool.slot_of(rid)
+                if slot is None:
+                    continue
+                partial = rep.engine.partial_tokens(rid) or []
+                self.tel.end("request", rep.engine.slot_track(slot),
+                             ts_us=self.tel.ts_us(now), request=rid,
+                             reason="replica_dead", migrated=True,
+                             n_tokens=len(partial))
+        if rep.journal is not None:
+            rep.journal.close()
+        pending: List[Request] = []
+        finished_reasons: Dict[str, str] = {}
+        if rep.journal_path is not None:
+            pending = RequestJournal.unfinished(rep.journal_path,
+                                                telemetry=self.tel)
+            finished_reasons = {
+                r["id"]: r.get("reason", "")
+                for r in load_jsonl_if_exists(rep.journal_path)
+                if r.get("ev") == "finish"}
+        # the router's in-memory ledger is authoritative for THIS run:
+        # only replay journal entries for ids the router has in flight
+        # ON THE DEAD REPLICA. Anything else is a ghost — a stale
+        # record from a previous run sharing this journal dir, or an id
+        # that migrated away earlier (its finish landed in the
+        # survivor's journal, not here). Resurrecting a ghost whose id
+        # collides with a live request would double-decode it.
+        live = []
+        for p in pending:
+            fi = self._inflight.get(p.id)
+            if fi is not None and fi.replica == idx:
+                live.append(p)
+        pending = live
+        pending_ids = {r.id for r in pending}
+        for p in pending:
+            fi = self._inflight.pop(p.id)
+            self._requeue.append(_Requeue(
+                req=p, t_submit=fi.t_submit, attempts=fi.attempts,
+                due_step=step_idx))
+        if pending:
+            self.metrics.inc("fleet_requeued_requests", len(pending))
+            self.tel.instant("requeue", ROUTER_TRACK, replica=idx,
+                             n=len(pending), cause="kill")
+        # in-flight ids the journal says finished but whose terminal
+        # result died undelivered with the replica: surface the
+        # journaled reason (the tokens are lost with the process — an
+        # honest crash semantics, pinned in tests)
+        for rid in [r for r, fi in list(self._inflight.items())
+                    if fi.replica == idx and r not in pending_ids]:
+            fi = self._inflight.pop(rid)
+            # a journaled finish means the engine already emitted the
+            # terminal envelope close (or the request_unstarted
+            # instant) — the router must not close it a second time
+            self._record_result(RequestResult(
+                id=rid, tokens=[],
+                finish_reason=finished_reasons.get(rid, "cancelled")),
+                fi.t_submit, envelope=rid not in finished_reasons)
+
+    def _drain_requeue(self, step_idx: int) -> None:
+        """Bounded retry with exponential backoff for requests between
+        replicas (requeued after a kill/wedge, or bounced by
+        backpressure). Terminal results (retry exhaustion) go through
+        :meth:`_record_result` onto the ``_router_finished`` ledger —
+        the caller drains it into this step's return."""
+        still: List[_Requeue] = []
+        for item in self._requeue:
+            if item.due_step > step_idx:
+                still.append(item)
+                continue
+            rej = self._submit_routed(item.req, item.t_submit,
+                                      attempts=item.attempts)
+            if rej is None:
+                self.metrics.inc("fleet_requeue_submits")
+                continue
+            item.attempts += 1
+            if (item.attempts > self.rcfg.retry_max
+                    or rej.finish_reason in TERMINAL_REJECTS):
+                reason = (REJECT_FLEET_CAPACITY
+                          if rej.finish_reason == REJECT_QUEUE_FULL
+                          else rej.finish_reason)
+                self._record_result(RequestResult(
+                    id=item.req.id, tokens=[], finish_reason=reason),
+                    item.t_submit)
+                self.metrics.inc("fleet_requeue_exhausted")
+                continue
+            item.due_step = step_idx + (self.rcfg.retry_backoff_steps
+                                        * (2 ** (item.attempts - 1)))
+            self.metrics.inc("fleet_requeue_retries")
+            still.append(item)
+        self._requeue = still
+
+    def _gauges(self) -> None:
+        for rep in self.replicas:
+            i = rep.idx
+            self.metrics.gauge(f"replica{i}_alive", int(rep.alive))
+            self.metrics.gauge(f"replica{i}_wedged", int(rep.wedged))
+            self.metrics.gauge(f"replica{i}_queue_depth",
+                               rep.engine.scheduler.depth
+                               if rep.alive else 0)
+            self.metrics.gauge(f"replica{i}_slots_active",
+                               int(rep.engine._active.sum())
+                               if rep.alive else 0)
+            self.metrics.gauge(f"replica{i}_pages_in_use",
+                               rep.engine.pool.alloc.pages_in_use
+                               if rep.alive else 0)
+        self.metrics.gauge("fleet_requeue_depth", len(self._requeue))
+        self.metrics.gauge("fleet_inflight", len(self._inflight))

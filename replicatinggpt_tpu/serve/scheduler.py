@@ -42,6 +42,12 @@ class Scheduler:
         check; O(queue), which is bounded by max_queue)."""
         return any(req.id == request_id for req, _ in self._queue)
 
+    def ids(self) -> List[str]:
+        """Queued request ids in arrival order — the fleet router's
+        re-route path enumerates a wedged replica's backlog with this
+        (bounded by max_queue, like ``contains``)."""
+        return [req.id for req, _ in self._queue]
+
     def submit(self, req: Request) -> Optional[str]:
         """Enqueue ``req``; returns None on acceptance or a rejection
         reason (backpressure / validation) — the caller must surface

@@ -378,7 +378,8 @@ def make_drafter(mode: str, k: int, ngram: int, pool_size: int,
 
 
 def timed_draft(drafter: Drafter, ctx: DraftContext,
-                vocab_size: int = 0, tel=NULL
+                vocab_size: int = 0, tel=NULL,
+                track: int = ENGINE_TRACK
                 ) -> Tuple[np.ndarray, np.ndarray, float]:
     """``drafter.draft`` + wall-clock overhead (seconds) — the engine
     records it per step so the drafter's cost is visible next to the
@@ -401,6 +402,6 @@ def timed_draft(drafter: Drafter, ctx: DraftContext,
         toks = (toks + 1) % vocab_size
     dt = time.perf_counter() - t0
     if tel.enabled:
-        tel.complete("draft", ENGINE_TRACK, t0_us, dt * 1e6,
+        tel.complete("draft", track, t0_us, dt * 1e6,
                      drafter=drafter.name, k=drafter.k)
     return toks, lens, dt

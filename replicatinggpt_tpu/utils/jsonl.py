@@ -1,0 +1,52 @@
+"""Torn-tail-tolerant JSONL reading — the one shared reader.
+
+Three subsystems write append-only (or per-run) JSONL whose most
+interesting files are the ones a crash tore mid-line: the request
+journal (serve/journal.py), the telemetry event sink and metrics
+timeline (utils/telemetry.py), and the per-replica journals the fleet
+router replays after a replica death (serve/router.py). They used to
+carry private copies of the same skip-blank/skip-torn loop; this module
+is the single implementation all of them call.
+
+The contract: blank lines are skipped, a line that does not parse as
+JSON is skipped (the torn final record a crash leaves mid-write — by
+construction at most the tail can be torn, and silently dropping an
+*interior* corrupt line is still the right call for recovery readers:
+every record is independently meaningful and a reader that refuses the
+whole file loses everything instead of one record).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List
+
+
+def iter_jsonl(path: str) -> Iterator[dict]:
+    """Yield each parseable JSON object in ``path``, skipping blank and
+    torn lines. Streams — callers that may read very large soak
+    artifacts should prefer this over :func:`load_jsonl`."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue              # torn record (crash mid-write)
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Read a whole JSONL file tolerantly (see :func:`iter_jsonl`)."""
+    return list(iter_jsonl(path))
+
+
+def load_jsonl_if_exists(path: str) -> List[dict]:
+    """Recovery-reader convenience: a journal that was never created
+    (engine died before its first write) is an empty history, not an
+    error."""
+    if not os.path.exists(path):
+        return []
+    return load_jsonl(path)

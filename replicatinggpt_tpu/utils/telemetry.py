@@ -47,10 +47,29 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, TextIO
 
+from .jsonl import load_jsonl
+
+__all__ = [
+    "ENGINE_TRACK", "SLOT_TRACK_BASE", "REPLICA_TRACK_STRIDE",
+    "ROUTER_TRACK", "ROUTER_TRACK_NAME", "NULL", "NullTelemetry",
+    "Telemetry", "MetricsTimeline", "chrome_trace_from_jsonl",
+    "load_jsonl", "prometheus_text",
+]
+
 #: engine-level track (steps, drafts, recovery markers); per-slot
 #: request trees live on SLOT_TRACK_BASE + slot
 ENGINE_TRACK = 0
 SLOT_TRACK_BASE = 1
+
+#: fleet layout: replica ``i``'s engine passes ``track_base = i *
+#: REPLICA_TRACK_STRIDE`` so its engine/slot tracks never collide with a
+#: neighbor's on the shared fleet recorder (pool sizes are far below the
+#: stride). The router's own spans/instants (route decisions, requeues,
+#: health transitions) live on ROUTER_TRACK, named ROUTER_TRACK_NAME —
+#: tools/trace_check.py recognizes the *name*, so it needs no import.
+REPLICA_TRACK_STRIDE = 100
+ROUTER_TRACK = 9000
+ROUTER_TRACK_NAME = "router"
 
 
 class _NullSpan:
@@ -167,6 +186,15 @@ class Telemetry:
         if self._track_names.get(track) == name:
             return
         self._track_names[track] = name
+        if self._sink is not None:
+            # the crash-tolerant sink must carry the metadata too: a
+            # trace assembled offline (chrome_trace_from_jsonl) needs
+            # the thread_name M event for trace_check's router-track
+            # envelope exemption
+            self._sink.write(json.dumps(
+                {"ph": "M", "name": "thread_name", "pid": 0,
+                 "tid": track, "args": {"name": name}}) + "\n")
+            self._sink.flush()
 
     def begin(self, name: str, track: int = ENGINE_TRACK,
               ts_us: Optional[float] = None, **args) -> None:
@@ -254,26 +282,11 @@ class Telemetry:
 
 
 # ---------------------------------------------------------------------------
-# torn-tail-tolerant JSONL readers + offline Chrome-trace assembly
+# offline Chrome-trace assembly (the torn-tail-tolerant JSONL reader
+# itself is utils.jsonl.load_jsonl — one implementation shared with the
+# request journal and the fleet router's journal replay; re-exported
+# here for existing callers)
 # ---------------------------------------------------------------------------
-
-def load_jsonl(path: str) -> List[dict]:
-    """Read a JSONL file written by the sink above (or by
-    :class:`MetricsTimeline`), skipping blank and torn lines — the
-    crash that makes the file interesting is the one that tears its
-    tail (same contract as ``serve.journal``)."""
-    out: List[dict] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue              # torn tail record
-    return out
-
 
 def chrome_trace_from_jsonl(jsonl_path: str, out_path: str,
                             process_name: str = "replicatinggpt_tpu"

@@ -1,0 +1,717 @@
+"""Fleet-tier tests (serve/router.py + serve/loadgen.py +
+faults/fleet.py): prefix-affinity routing, journal requeue across a
+replica kill with greedy token parity and exactly-once delivery,
+wedge detection + hedged re-route + rejoin, fleet-wide duplicate-id
+dedupe, the bounded retry ladder, trace validity through envelope
+migration, and the chaos soak (slow tier)."""
+
+import importlib.util
+import pathlib
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from replicatinggpt_tpu.config import ModelConfig
+from replicatinggpt_tpu.faults import Fault, FaultPlan, installed
+from replicatinggpt_tpu.faults.fleet import (FLEET_SESSION, FLEET_STEP,
+                                             KIND_HOT_KEY_SKEW,
+                                             KIND_REPLICA_KILL,
+                                             KIND_REPLICA_WEDGE)
+from replicatinggpt_tpu.models.gpt import init_params
+from replicatinggpt_tpu.sample import GenerateConfig, generate
+from replicatinggpt_tpu.serve import (EngineConfig, REJECT_FLEET_CAPACITY,
+                                      Request, Router, RouterConfig,
+                                      SamplingParams, SessionLoadConfig,
+                                      make_sessions, run_fleet_replay)
+from replicatinggpt_tpu.serve.requests import (FINISH_MAX_TOKENS,
+                                               REJECT_BAD_REQUEST)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+CFG = ModelConfig(vocab_size=65, block_size=64, n_layer=2, n_head=2,
+                  n_embd=32, dropout=0.0, attn_dropout=0.0,
+                  dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _reqs(n, seed=7, max_new=10):
+    rng = np.random.default_rng(seed)
+    return [Request(
+        id=f"r{i}",
+        prompt=rng.integers(1, CFG.vocab_size - 1,
+                            (int(rng.integers(2, 12)),)).astype(np.int32),
+        max_new_tokens=max_new, sampling=SamplingParams(greedy=True),
+        rng_seed=i) for i in range(n)]
+
+
+def _offline(params, reqs):
+    return {r.id: np.asarray(generate(
+        params, r.prompt[None, :], CFG,
+        GenerateConfig(max_new_tokens=r.max_new_tokens, greedy=True))
+    )[0].tolist() for r in reqs}
+
+
+def _drain_streaming(router, ids):
+    """Drain the fleet while consuming the delivery ledger every step;
+    returns (results, per-id streamed tokens)."""
+    results, streams = {}, {i: [] for i in ids}
+    while not router.idle:
+        for res in router.step():
+            results[res.id] = res
+        for rid in streams:
+            streams[rid].extend(router.take_new_tokens(rid))
+    return results, streams
+
+
+def _trace_check():
+    spec = importlib.util.spec_from_file_location(
+        "trace_check", REPO / "tools" / "trace_check.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_router_parity_across_replicas(params):
+    """Greedy output through a 2-replica fleet is token-identical to
+    offline generate per request — routing must not change results."""
+    reqs = _reqs(6)
+    want = _offline(params, reqs)
+    r = Router(params, CFG, RouterConfig(n_replicas=2),
+               EngineConfig(pool_size=2, max_queue=8))
+    for q in reqs:
+        assert r.submit(q) is None
+    out = {res.id: res for res in r.drain()}
+    assert {k: v.tokens for k, v in out.items()} == want
+    s = r.fleet_summary()
+    # both replicas actually served (least-loaded spread)
+    served = [rep["finished"].get("finished_max_tokens", 0)
+              for rep in s["replicas"]]
+    assert all(n > 0 for n in served), served
+    r.close()
+
+
+@pytest.mark.fleet
+def test_duplicate_inflight_id_rejected_fleet_wide(params):
+    r = Router(params, CFG, RouterConfig(n_replicas=2),
+               EngineConfig(pool_size=1, max_queue=8))
+    q = _reqs(1)[0]
+    assert r.submit(q) is None
+    dup = r.submit(q)
+    assert dup is not None and dup.finish_reason == REJECT_BAD_REQUEST
+    assert r.metrics.counters["fleet_dedup_rejects"] == 1
+    out = r.drain()
+    assert [res.id for res in out] == [q.id]     # decoded exactly once
+    r.close()
+
+
+@pytest.mark.fleet
+def test_fleet_ttft_includes_same_step_finishers(params):
+    """Regression: a request that finishes in the same router step its
+    first token commits (max_new_tokens=1) was invisible to the
+    fleet_ttft_s histogram — _observe_ttft runs after the per-replica
+    loop and only iterates ids still in flight — so the bench TTFT
+    p50/p99 silently excluded exactly the fastest requests."""
+    reqs = _reqs(3, max_new=1)
+    r = Router(params, CFG, RouterConfig(n_replicas=1),
+               EngineConfig(pool_size=4, max_queue=8))
+    for q in reqs:
+        assert r.submit(q) is None
+    results = {res.id: res for res in r.drain(max_steps=200)}
+    assert all(res.finish_reason == FINISH_MAX_TOKENS
+               and len(res.tokens) == 1 for res in results.values())
+    assert r.metrics.hist_summary("fleet_ttft_s")["n"] == len(reqs)
+    assert all(res.ttft_s > 0 for res in results.values())
+    r.close()
+
+
+@pytest.mark.fleet
+def test_prefix_affinity_keeps_fleet_hit_rate(params):
+    """The acceptance bar: the 2-replica fleet's aggregate prefix-hit
+    rate on session traffic stays within 10% of the single-replica
+    baseline (affinity routes each session to the replica owning its
+    history), and beats the same fleet with affinity off."""
+    lcfg = SessionLoadConfig(n_sessions=8, turns=3, prefix_len=12,
+                             n_prefix_groups=2, max_new_tokens=4,
+                             user_len_min=2, user_len_max=3, seed=3)
+    ecfg = EngineConfig(pool_size=2, max_queue=32, page_size=4)
+
+    def run(n_replicas, affinity):
+        s = run_fleet_replay(params, CFG, lcfg,
+                             RouterConfig(n_replicas=n_replicas,
+                                          affinity=affinity),
+                             ecfg, virtual_dt=0.01)
+        assert s["n_completed"] == lcfg.n_sessions * lcfg.turns
+        return s["aggregate_prefix_hit_rate"]
+
+    single = run(1, True)
+    fleet = run(2, True)
+    blind = run(2, False)
+    assert single > 0.3, single          # the workload is prefix-heavy
+    assert fleet >= 0.9 * single, (fleet, single)
+    assert fleet >= blind, (fleet, blind)
+
+
+@pytest.mark.fleet
+def test_hot_key_skew_collapses_sessions():
+    """The fleet/session chaos seam: with hot_key_skew planned, most
+    sessions collapse onto prefix group 0 (deterministically per
+    seed)."""
+    lcfg = SessionLoadConfig(n_sessions=16, turns=1, n_prefix_groups=4,
+                             prefix_len=8, seed=5)
+    base = make_sessions(CFG, lcfg)
+    with installed(FaultPlan(Fault(site=FLEET_SESSION,
+                                   kind=KIND_HOT_KEY_SKEW, at=0,
+                                   times=16, arg=1.0))) as plan:
+        skewed = make_sessions(CFG, lcfg)
+        assert plan.count(FLEET_SESSION) == 16
+    assert len({s.group for s in base}) > 1
+    assert all(s.group == 0 for s in skewed)
+
+
+# ---------------------------------------------------------------------------
+# replica death: journal requeue, parity, exactly-once delivery
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_replica_kill_requeues_with_parity_and_streams(params, tmp_path):
+    """THE fleet invariant: replica_kill mid-decode -> every in-flight
+    request requeues via the dead replica's journal and completes with
+    greedy output token-identical to an uninterrupted run, and the
+    router's delivery ledger hands every token exactly once (no drops,
+    no duplicates across the migration)."""
+    reqs = _reqs(8)
+    want = _offline(params, reqs)
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL, at=3,
+                                   arg=0))) as plan:
+        r = Router(params, CFG,
+                   RouterConfig(n_replicas=2,
+                                journal_dir=str(tmp_path)),
+                   EngineConfig(pool_size=2, max_queue=16))
+        for q in reqs:
+            assert r.submit(q) is None
+        results, streams = _drain_streaming(r, [q.id for q in reqs])
+        assert plan.count(FLEET_STEP, KIND_REPLICA_KILL) == 1
+    c = r.metrics.counters
+    assert c["fleet_replica_kills"] == 1
+    assert c["fleet_requeued_requests"] > 0         # work WAS in flight
+    assert r.n_alive == 1
+    for q in reqs:
+        assert results[q.id].finish_reason == FINISH_MAX_TOKENS
+        assert results[q.id].tokens == want[q.id], q.id
+        assert streams[q.id] == want[q.id], q.id    # exactly-once
+    r.close()
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_duplicate_id_after_kill_never_double_decoded(params, tmp_path):
+    """The PR-5 in-flight-id invariant, fleet edition: after a kill
+    requeues r onto the surviving replica, a duplicate submit of r
+    (a stale client retry racing the recovery) is rejected with
+    rejected_bad_request — never decoded twice."""
+    reqs = _reqs(4, max_new=12)
+    want = _offline(params, reqs)
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL, at=3,
+                                   arg=0))):
+        r = Router(params, CFG,
+                   RouterConfig(n_replicas=2,
+                                journal_dir=str(tmp_path)),
+                   EngineConfig(pool_size=2, max_queue=16))
+        for q in reqs:
+            assert r.submit(q) is None
+        results = {}
+        retried = False
+        while not r.idle:
+            for res in r.step():
+                results[res.id] = res
+            if (r.metrics.counters.get("fleet_replica_kills", 0)
+                    and not retried):
+                retried = True
+                for q in reqs:
+                    if q.id not in results:
+                        dup = r.submit(q)     # the stale client retry
+                        assert dup is not None
+                        assert (dup.finish_reason
+                                == REJECT_BAD_REQUEST), q.id
+        assert retried
+    # every request decoded exactly once, with parity
+    for q in reqs:
+        assert results[q.id].tokens == want[q.id]
+    assert (r.metrics.counters["fleet_requests_finished"]
+            == len(reqs))
+    r.close()
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_kill_with_no_survivors_exhausts_retry_ladder(params, tmp_path):
+    """Bounded retry-with-backoff: killing the ONLY replica leaves
+    nowhere to requeue — after retry_max backoff attempts each request
+    surfaces as rejected_fleet_capacity instead of hanging the fleet.
+    The trace still forms one complete span tree per request: the
+    router itself emits the terminal envelope close for requests that
+    die router-side (their engine segments all ended migrated)."""
+    from replicatinggpt_tpu.utils.telemetry import Telemetry
+    reqs = _reqs(3, max_new=12)
+    tel = Telemetry()
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL, at=2,
+                                   arg=0))):
+        r = Router(params, CFG,
+                   RouterConfig(n_replicas=1, journal_dir=str(tmp_path),
+                                retry_max=2, retry_backoff_steps=1),
+                   EngineConfig(pool_size=2, max_queue=8),
+                   telemetry=tel)
+        for q in reqs:
+            assert r.submit(q) is None
+        results = {res.id: res for res in r.drain(max_steps=200)}
+    assert r.n_alive == 0
+    assert len(results) == len(reqs)
+    assert all(res.finish_reason == REJECT_FLEET_CAPACITY
+               for res in results.values())
+    assert r.metrics.counters["fleet_requeue_exhausted"] == len(reqs)
+    out = tmp_path / "exhausted_trace.json"
+    tel.export_chrome_trace(str(out))
+    tc = _trace_check()
+    assert tc.check_trace(str(out), min_requests=len(reqs)) == []
+    r.close()
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_kill_without_journals_surfaces_cancelled_via_step(params):
+    """``journal_dir=None`` is a documented configuration: a kill
+    cannot requeue, so the dead replica's in-flight requests terminate
+    router-side as cancelled — and those router-recorded results must
+    come back from step()/drain() like any engine finish. Regression:
+    they used to land only in ``router.results``, so a driver consuming
+    step() output (the fleet replay, the SSE driver) waited forever on
+    ids that had already terminated."""
+    lcfg = SessionLoadConfig(n_sessions=4, turns=2, rate=1000.0,
+                             max_new_tokens=4)
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL, at=6,
+                                   arg=0))):
+        s = run_fleet_replay(params, CFG, lcfg,
+                             RouterConfig(n_replicas=2,
+                                          journal_dir=None),
+                             EngineConfig(pool_size=2, max_queue=16),
+                             virtual_dt=0.01, max_steps=2000)
+    assert s["n_alive"] == 1
+    assert s["router"]["fleet_replica_kills"] == 1
+    # every submitted request surfaced a terminal result through the
+    # step() return — completed, rejected at submit, or cancelled with
+    # the kill; none vanished (the replay would have hit max_steps)
+    assert s["turns_finished"] + s["n_rejected"] >= s["n_requests"]
+
+
+@pytest.mark.fleet
+def test_cancel_of_requeued_request_surfaces_from_step(params, tmp_path):
+    """Cancelling a request while it sits BETWEEN replicas (in the
+    retry-backoff queue after its replica died) records the terminal
+    result router-side; the next step() must return it — the
+    router-finished ledger, not just the results map."""
+    from replicatinggpt_tpu.serve.requests import FINISH_CANCELLED
+    reqs = _reqs(2, max_new=12)
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL, at=2,
+                                   arg=0))):
+        r = Router(params, CFG,
+                   RouterConfig(n_replicas=1, journal_dir=str(tmp_path),
+                                retry_max=5, retry_backoff_steps=8),
+                   EngineConfig(pool_size=2, max_queue=8))
+        for q in reqs:
+            assert r.submit(q) is None
+        for _ in range(4):       # past the kill; work is backing off
+            r.step()
+        assert r._requeue, "expected requests between replicas"
+        target = r._requeue[0].req.id
+        assert r.cancel(target)
+        assert not r.idle        # the undelivered terminal keeps it live
+        surfaced = r.step()
+    assert any(res.id == target
+               and res.finish_reason == FINISH_CANCELLED
+               for res in surfaced)
+    assert r.result(target).finish_reason == FINISH_CANCELLED
+    r.close()
+
+
+@pytest.mark.fleet
+def test_loadgen_runaway_guard_counts_idle_iterations(params):
+    """Regression: the idle branch used to ``continue`` without
+    counting, so a stall with pending turns but an idle router spun
+    forever instead of raising the promised RuntimeError — max_steps
+    now bounds every loop iteration, idle ticks included."""
+    # the only session's arrival is ~1/rate seconds out: at rate=1e-4
+    # the virtual clock needs millions of idle ticks to reach it — the
+    # runaway guard must trip first
+    lcfg = SessionLoadConfig(n_sessions=1, turns=1, rate=1e-4,
+                             max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="did not finish"):
+        run_fleet_replay(params, CFG, lcfg,
+                         RouterConfig(n_replicas=1, journal_dir=None),
+                         EngineConfig(pool_size=2),
+                         warmup=False, virtual_dt=0.001, max_steps=50)
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_stale_journal_ghosts_never_resurrected(params, tmp_path):
+    """A journal dir reused across runs holds permanently-unfinished
+    entries (requests that migrated off a killed replica finish in the
+    SURVIVOR's journal). A later kill must not resurrect those ghosts —
+    and above all must not double-decode a live request whose id
+    collides with one. The router's in-memory ledger gates the
+    replay."""
+    import json as jsonmod
+    reqs = _reqs(3, max_new=8)
+    want = _offline(params, reqs)
+    # "previous run" residue in replica0's journal: one id a live
+    # request reuses — and (deterministic least-loaded routing) that
+    # request lives on replica 1, so resurrecting the stale entry off
+    # replica 0's journal would put the id live on two replicas — plus
+    # one id nothing reuses
+    stale = tmp_path / "replica0.jsonl"
+    recs = []
+    for rid in (reqs[1].id, "ghost-from-run-1"):
+        recs.append({"ev": "submit", "id": rid, "prompt": [1, 2, 3],
+                     "max_new_tokens": 8, "rng_seed": 0,
+                     "temperature": 1.0, "top_k": 0, "top_p": 0.0,
+                     "greedy": True})
+    stale.write_text("".join(jsonmod.dumps(x) + "\n" for x in recs))
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL, at=3,
+                                   arg=0))):
+        r = Router(params, CFG,
+                   RouterConfig(n_replicas=2,
+                                journal_dir=str(tmp_path)),
+                   EngineConfig(pool_size=2, max_queue=16))
+        for q in reqs:
+            assert r.submit(q) is None
+        results = {res.id: res for res in r.drain(max_steps=300)}
+    # every live request decoded exactly once, parity intact
+    assert sorted(results) == sorted(q.id for q in reqs)
+    for q in reqs:
+        assert results[q.id].tokens == want[q.id], q.id
+    assert "ghost-from-run-1" not in results
+    assert (r.metrics.counters["fleet_requests_finished"]
+            == len(reqs))
+    r.close()
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_replica_wedge_reroutes_then_rejoins(params, tmp_path):
+    """Wedge probe: injected step stalls past the budget quarantine the
+    replica, its in-flight work re-routes (hedged: cancelled-with-
+    migrated on the suspect, so no id is ever live twice), results stay
+    token-identical, and the replica rejoins after quarantine."""
+    reqs = _reqs(4, max_new=12)
+    want = _offline(params, reqs)
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_WEDGE, at=4,
+                                   times=4, arg=0.05, arg2=0))):
+        r = Router(params, CFG,
+                   RouterConfig(n_replicas=2, journal_dir=str(tmp_path),
+                                wedge_budget_s=0.02, wedge_patience=2,
+                                quarantine_steps=5),
+                   EngineConfig(pool_size=2, max_queue=16))
+        for q in reqs:
+            assert r.submit(q) is None
+        results, streams = _drain_streaming(r, [q.id for q in reqs])
+    c = r.metrics.counters
+    assert c["fleet_replica_wedges"] >= 1
+    assert c["fleet_replica_rejoins"] >= 1
+    assert all(rep.alive for rep in r.replicas)      # wedged != dead
+    for q in reqs:
+        assert results[q.id].tokens == want[q.id]
+        assert streams[q.id] == want[q.id]
+    r.close()
+
+
+@pytest.mark.fleet
+def test_journal_unfinished_dedupes_reused_ids(tmp_path):
+    """An id can legally reappear in one journal (finished, popped by
+    the client, then a fresh request reused the id): unfinished() must
+    return the reused id exactly ONCE — a duplicate would requeue and
+    decode it twice."""
+    import json as jsonmod
+
+    from replicatinggpt_tpu.serve import RequestJournal
+    p = tmp_path / "j.jsonl"
+    sub = {"ev": "submit", "id": "x", "prompt": [1, 2],
+           "max_new_tokens": 4, "rng_seed": 0, "temperature": 1.0,
+           "top_k": 0, "top_p": 0.0, "greedy": True}
+    p.write_text(jsonmod.dumps(sub) + "\n"
+                 + jsonmod.dumps({"ev": "finish", "id": "x",
+                                  "reason": "max_tokens"}) + "\n"
+                 + jsonmod.dumps({**sub, "prompt": [3, 4]}) + "\n")
+    out = RequestJournal.unfinished(str(p))
+    assert [r.id for r in out] == ["x"]          # exactly once
+    assert out[0].prompt.tolist() == [3, 4]      # the LIVE submission
+
+
+# ---------------------------------------------------------------------------
+# telemetry: migrated envelopes + router track
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_fleet_trace_validates_through_migration(params, tmp_path):
+    """A kill replay's Perfetto trace still forms exactly one complete
+    span tree per request id: dead-replica segments close tagged
+    'migrated', the terminal envelope lives on the surviving replica,
+    and router-track instants are envelope-exempt."""
+    out = tmp_path / "fleet_trace.json"
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL, at=6,
+                                   arg=0))):
+        s = run_fleet_replay(
+            params, CFG,
+            SessionLoadConfig(n_sessions=5, turns=2, prefix_len=8,
+                              max_new_tokens=5, user_len_max=3, seed=2),
+            RouterConfig(n_replicas=2, journal_dir=str(tmp_path)),
+            EngineConfig(pool_size=2, max_queue=16, page_size=4),
+            virtual_dt=0.01, trace_out=str(out))
+    assert s["n_completed"] == s["n_requests"] == 10
+    assert s["router"]["fleet_requeued_requests"] > 0
+    tc = _trace_check()
+    assert tc.check_trace(str(out), min_requests=10) == []
+    # CLI contract too (stdlib-only invocation)
+    rc = subprocess.run([sys.executable,
+                         str(REPO / "tools" / "trace_check.py"),
+                         str(out), "--min-requests", "10"],
+                        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_cancel_then_kill_emits_one_terminal_envelope(params, tmp_path):
+    """The cancel-then-kill race: a client cancels an active request
+    (the engine closes its envelope terminally and journals the
+    finish; the result sits in engine._pending), then the replica dies
+    before its next step. The router's journaled-finish path must NOT
+    close the envelope a second time — exactly one terminal segment
+    per id (regression: trace_check flagged 2)."""
+    from replicatinggpt_tpu.serve.requests import FINISH_CANCELLED
+    from replicatinggpt_tpu.utils.telemetry import Telemetry
+    reqs = _reqs(4, max_new=12)
+    tel = Telemetry()
+    r = Router(params, CFG,
+               RouterConfig(n_replicas=2, journal_dir=str(tmp_path)),
+               EngineConfig(pool_size=2, max_queue=8), telemetry=tel)
+    for q in reqs:
+        assert r.submit(q) is None
+    for _ in range(3):             # admit + decode a few tokens
+        r.step()
+    victim = next(rid for rid, fi in r._inflight.items()
+                  if r.replicas[fi.replica].engine.pool.slot_of(rid)
+                  is not None)
+    victim_replica = r._inflight[victim].replica
+    assert r.cancel(victim)        # envelope closed + finish journaled;
+    #                                the result dies undelivered with:
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL,
+                                   at=r.n_steps,
+                                   arg=victim_replica))):
+        results = {res.id: res for res in r.drain(max_steps=300)}
+    assert results[victim].finish_reason == FINISH_CANCELLED
+    assert results[victim].tokens == []     # lost with the process
+    out = tmp_path / "cancel_kill_trace.json"
+    tel.export_chrome_trace(str(out))
+    tc = _trace_check()
+    assert tc.check_trace(str(out)) == []
+    r.close()
+
+
+@pytest.mark.fleet
+def test_jsonl_sink_trace_assembles_and_validates(params, tmp_path):
+    """The crash-tolerant sink path: a fleet trace assembled OFFLINE
+    from the JSONL event sink (chrome_trace_from_jsonl — the artifact
+    of a run that died mid-flight) must carry the router track's
+    thread_name metadata, or trace_check treats router instants as
+    ordinary tagged events and fails a valid trace (regression)."""
+    from replicatinggpt_tpu.utils.telemetry import (
+        Telemetry, chrome_trace_from_jsonl)
+    sink = tmp_path / "events.jsonl"
+    tel = Telemetry(jsonl_path=str(sink))
+    reqs = _reqs(4, max_new=6)
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL, at=3,
+                                   arg=0))):
+        r = Router(params, CFG,
+                   RouterConfig(n_replicas=2, journal_dir=str(tmp_path)),
+                   EngineConfig(pool_size=2, max_queue=8), telemetry=tel)
+        for q in reqs:
+            assert r.submit(q) is None
+        r.drain(max_steps=300)
+    tel.close()
+    r.close()
+    out = tmp_path / "assembled.json"
+    n = chrome_trace_from_jsonl(str(sink), str(out))
+    assert n > 0
+    tc = _trace_check()
+    assert tc.check_trace(str(out), min_requests=len(reqs)) == []
+
+
+@pytest.mark.fleet
+def test_deterministic_rejects_skip_route_fallback(params):
+    """prompt_too_long / dead-on-arrival deadline are the same verdict
+    on every replica — the router must not try the others (and must not
+    count the identical rejections as routing fallbacks, a capacity-
+    pressure signal)."""
+    r = Router(params, CFG, RouterConfig(n_replicas=3, journal_dir=None),
+               EngineConfig(pool_size=2, max_queue=8))
+    too_long = Request(
+        id="huge",
+        prompt=np.ones((CFG.block_size + 8,), np.int32),
+        max_new_tokens=4, sampling=SamplingParams(greedy=True))
+    rej = r.submit(too_long)
+    assert rej is not None and "too_long" in rej.finish_reason
+    assert r.metrics.counters.get("fleet_route_fallbacks", 0) == 0
+    r.close()
+
+
+@pytest.mark.fleet
+def test_trace_check_rejects_double_terminal_and_unclosed(tmp_path):
+    """Adversarial traces: two unmigrated envelope closes for one id,
+    or a migrated segment never followed by a terminal one, must fail
+    validation."""
+    tc = _trace_check()
+    import json
+
+    def write(events, name):
+        p = tmp_path / name
+        p.write_text(json.dumps({"traceEvents": events}))
+        return str(p)
+
+    env = lambda ph, tid, ts, **a: {  # noqa: E731
+        "ph": ph, "name": "request", "pid": 0, "tid": tid, "ts": ts,
+        "args": {"request": "r0", **a}}
+    # two terminal segments
+    p = write([env("B", 1, 0), env("E", 1, 10),
+               env("B", 101, 20), env("E", 101, 30)], "double.json")
+    assert any("terminal" in e for e in tc.check_trace(p))
+    # migrated segment with no terminal close at all
+    p = write([env("B", 1, 0), env("E", 1, 10, migrated=True)],
+              "no_terminal.json")
+    assert any("terminal" in e for e in tc.check_trace(p))
+    # the valid migration shape passes
+    p = write([env("B", 1, 0), env("E", 1, 10, migrated=True),
+               env("B", 101, 20), env("E", 101, 30)], "ok.json")
+    assert tc.check_trace(p, min_requests=1) == []
+    # router-track instants are envelope-exempt (by thread name)
+    p = write([{"ph": "M", "name": "thread_name", "pid": 0, "tid": 9000,
+                "args": {"name": "router"}},
+               {"ph": "i", "name": "route", "pid": 0, "tid": 9000,
+                "ts": 5, "s": "t", "args": {"request": "r0"}},
+               env("B", 1, 10), env("E", 1, 20)], "router_ok.json")
+    assert tc.check_trace(p, min_requests=1) == []
+
+
+# ---------------------------------------------------------------------------
+# the chaos soak (slow tier): loadgen + kill + wedge, everything holds
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_fleet_chaos_soak(params, tmp_path):
+    """The full acceptance scenario in one run: multi-turn session
+    traffic over 3 replicas with a replica kill AND a wedge injected
+    mid-soak — every turn completes, every delivered stream equals the
+    final token list (exactly-once across migrations), the aggregate
+    prefix-hit rate stays within 10% of the single-replica baseline on
+    the same workload, the trace validates, and steady state stays
+    zero-recompile."""
+    lcfg = SessionLoadConfig(n_sessions=16, turns=3, prefix_len=12,
+                             n_prefix_groups=3, max_new_tokens=4,
+                             user_len_min=2, user_len_max=3, seed=11)
+    ecfg = EngineConfig(pool_size=2, max_queue=64, page_size=4)
+    baseline = run_fleet_replay(params, CFG, lcfg,
+                                RouterConfig(n_replicas=1), ecfg,
+                                virtual_dt=0.01)
+    assert baseline["n_completed"] == lcfg.n_sessions * lcfg.turns
+
+    out = tmp_path / "soak_trace.json"
+    with installed(FaultPlan(
+            Fault(site=FLEET_STEP, kind=KIND_REPLICA_KILL, at=20, arg=0),
+            Fault(site=FLEET_STEP, kind=KIND_REPLICA_WEDGE, at=40,
+                  times=4, arg=0.05, arg2=1))) as plan:
+        s = run_fleet_replay(
+            params, CFG, lcfg,
+            RouterConfig(n_replicas=3, journal_dir=str(tmp_path),
+                         wedge_budget_s=0.02, wedge_patience=2,
+                         quarantine_steps=6),
+            ecfg, virtual_dt=0.01, collect_streams=True,
+            trace_out=str(out))
+        assert plan.count(FLEET_STEP, KIND_REPLICA_KILL) == 1
+        assert plan.count(FLEET_STEP, KIND_REPLICA_WEDGE) >= 1
+    n_turns = lcfg.n_sessions * lcfg.turns
+    assert s["n_completed"] == s["n_requests"] == n_turns
+    assert s["router"]["fleet_replica_kills"] == 1
+    assert s["router"]["fleet_requeued_requests"] > 0
+    assert s["n_alive"] == 2
+    # exactly-once delivery through every migration
+    for rid, res in s["results"].items():
+        assert s["streams"][rid] == res.tokens, rid
+    # fleet affinity holds under chaos: within 10% of single-replica
+    assert (s["aggregate_prefix_hit_rate"]
+            >= 0.9 * baseline["aggregate_prefix_hit_rate"]), (
+        s["aggregate_prefix_hit_rate"],
+        baseline["aggregate_prefix_hit_rate"])
+    assert s["recompiles_after_warmup"] == 0
+    tc = _trace_check()
+    assert tc.check_trace(str(out), min_requests=n_turns) == []
+
+
+@pytest.mark.fleet
+@pytest.mark.slow
+def test_bench_fleet_mode_emits_artifact(tmp_path, capsys, monkeypatch):
+    """bench.py --mode fleet end to end (in-process): the artifact
+    carries per-replica occupancy, requeue counts, and the fleet TTFT
+    distribution — the acceptance criteria's dashboard keys."""
+    import json
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    args = bench.main.__globals__["argparse"].Namespace(
+        preset="test-tiny", serve_pool=2, serve_rate=200.0,
+        serve_max_new_tokens=6, serve_page_size=4, serve_n_pages=0,
+        fleet_replicas=2, fleet_sessions=5, fleet_turns=2,
+        fleet_prefix_groups=2, fleet_prefix_len=8, fleet_kill_at=6,
+        fleet_journal_dir=str(tmp_path), trace_out=None,
+        metrics_timeline=None, metrics_out=None)
+    bench.bench_fleet(args)
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    assert lines, "bench_fleet emitted no artifact JSON"
+    doc = json.loads(lines[-1])
+    assert doc["metric"] == "fleet_replay_aggregate_tokens_per_sec"
+    assert doc["value"] > 0
+    assert doc["chaos"] == "replica_kill"
+    assert doc["n_completed"] == doc["n_requests"]
+    assert doc["router"]["fleet_replica_kills"] == 1
+    assert len(doc["replicas"]) == 2
+    for rep in doc["replicas"]:
+        assert {"occupancy_mean", "pages_in_use",
+                "prefix_hit_rate"} <= set(rep)
+    assert "fleet_ttft_p50_ms" in doc and "fleet_ttft_p99_ms" in doc

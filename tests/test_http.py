@@ -1,0 +1,548 @@
+"""HTTP/SSE front-door tests (serve/http.py): real sockets on
+loopback, speaking real HTTP/1.1 against the asyncio server — SSE
+token streaming with greedy parity, backpressure status mapping,
+cancel (explicit and by client disconnect mid-stream, which must free
+the slot and KV pages promptly), healthz, and Prometheus metrics."""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from replicatinggpt_tpu.config import ModelConfig
+from replicatinggpt_tpu.models.gpt import init_params
+from replicatinggpt_tpu.sample import GenerateConfig, generate
+from replicatinggpt_tpu.serve import EngineConfig, Router, RouterConfig
+from replicatinggpt_tpu.serve.http import ServeApp
+
+pytestmark = pytest.mark.fleet
+
+CFG = ModelConfig(vocab_size=65, block_size=32, n_layer=2, n_head=2,
+                  n_embd=32, dropout=0.0, attn_dropout=0.0,
+                  dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+async def _request(host, port, method, path, body=None):
+    """One HTTP exchange; returns (status, parsed-or-raw body)."""
+    r, w = await asyncio.open_connection(host, port)
+    payload = json.dumps(body).encode() if body is not None else b""
+    w.write(f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+            f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload)
+    await w.drain()
+    data = await r.read()
+    w.close()
+    await w.wait_closed()
+    head, _, rest = data.partition(b"\r\n\r\n")
+    status = int(head.split(b" ")[1])
+    try:
+        return status, json.loads(rest)
+    except ValueError:
+        return status, rest
+
+
+def _sse_events(raw: bytes):
+    """Parse an SSE byte stream into (event, data) pairs."""
+    out = []
+    for block in raw.decode().split("\n\n"):
+        ev, data = "message", None
+        for line in block.splitlines():
+            if line.startswith("event: "):
+                ev = line[len("event: "):]
+            elif line.startswith("data: "):
+                data = json.loads(line[len("data: "):])
+        if data is not None:
+            out.append((ev, data))
+    return out
+
+
+async def _stream(host, port, rid):
+    r, w = await asyncio.open_connection(host, port)
+    w.write(f"GET /v1/stream/{rid} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    await w.drain()
+    data = await r.read()
+    w.close()
+    await w.wait_closed()
+    body = data.partition(b"\r\n\r\n")[2]
+    return _sse_events(body)
+
+
+def _app(params, **router_kw):
+    router = Router(params, CFG,
+                    RouterConfig(**{"n_replicas": 1, **router_kw}),
+                    EngineConfig(pool_size=2, max_queue=4))
+    return ServeApp(router)
+
+
+def _offline(params, prompt, n):
+    return np.asarray(generate(
+        params, np.asarray(prompt, np.int32)[None, :], CFG,
+        GenerateConfig(max_new_tokens=n, greedy=True)))[0].tolist()
+
+
+def test_submit_stream_greedy_parity(params):
+    """Submit + SSE stream: the delivered token sequence equals offline
+    greedy generate, ends with one done event, and the id is freed
+    after delivery."""
+    want = _offline(params, [1, 2, 3], 8)
+
+    async def main():
+        app = _app(params, n_replicas=2)
+        host, port = await app.start()
+        try:
+            st, body = await _request(
+                host, port, "POST", "/v1/submit",
+                {"id": "a", "prompt": [1, 2, 3], "max_new_tokens": 8,
+                 "greedy": True})
+            assert st == 200 and body["status"] == "accepted"
+            events = await _stream(host, port, "a")
+            toks = [d["token"] for ev, d in events if ev == "message"]
+            done = [d for ev, d in events if ev == "done"]
+            assert toks == want
+            assert len(done) == 1
+            assert done[0]["finish_reason"] == "max_tokens"
+            assert done[0]["n_tokens"] == 8
+            # delivered -> popped -> unknown now
+            st, _ = await _request(host, port, "GET", "/v1/result/a")
+            assert st == 404
+        finally:
+            await app.stop()
+
+    asyncio.run(main())
+
+
+def test_generate_roundtrip_and_result_endpoint(params):
+    async def main():
+        app = _app(params)
+        host, port = await app.start()
+        try:
+            # one-shot generate: submit + stream in one response
+            r, w = await asyncio.open_connection(host, port)
+            payload = json.dumps({"prompt": [5, 6], "max_new_tokens": 4,
+                                  "greedy": True}).encode()
+            w.write(b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+                    + f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                    + payload)
+            await w.drain()
+            data = await r.read()
+            w.close()
+            await w.wait_closed()
+            events = _sse_events(data.partition(b"\r\n\r\n")[2])
+            toks = [d["token"] for ev, d in events if ev == "message"]
+            assert toks == _offline(params, [5, 6], 4)
+            # non-streaming path: submit then poll the result endpoint
+            st, _ = await _request(host, port, "POST", "/v1/submit",
+                                   {"id": "poll", "prompt": [9],
+                                    "max_new_tokens": 3, "greedy": True})
+            assert st == 200
+            while True:
+                st, body = await _request(host, port, "GET",
+                                          "/v1/result/poll")
+                if st == 200:
+                    break
+                assert st == 202
+                await asyncio.sleep(0.01)
+            assert body["tokens"] == _offline(params, [9], 3)
+        finally:
+            await app.stop()
+
+    asyncio.run(main())
+
+
+def test_backpressure_and_validation_status_codes(params):
+    async def main():
+        app = _app(params)      # pool 2, queue 4
+        host, port = await app.start()
+        # freeze the fleet while the submit storm lands so the bounded
+        # queue's backpressure is deterministic (the driver would
+        # otherwise race the storm and drain between round trips)
+        real_step = app.router.step
+        app.router.step = lambda: []
+        try:
+            statuses = []
+            for i in range(12):
+                st, _ = await _request(
+                    host, port, "POST", "/v1/submit",
+                    {"id": f"b{i}", "prompt": [1, 2],
+                     "max_new_tokens": 20, "greedy": True})
+                statuses.append(st)
+            assert statuses[:4] == [200] * 4     # max_queue accepted
+            assert set(statuses[4:]) == {429}    # the rest pushed back
+            st, body = await _request(host, port, "POST", "/v1/submit",
+                                      {"prompt": []})
+            assert st == 400                 # empty prompt
+            st, _ = await _request(host, port, "POST", "/v1/submit",
+                                   {"prompt": [1] * 100,
+                                    "max_new_tokens": 2})
+            assert st == 413                 # prompt > block_size
+            st, _ = await _request(host, port, "POST", "/v1/submit",
+                                   {"prompt": "nope"})
+            assert st == 400
+            # non-numeric deadline_s: a 400, not a dropped connection
+            st, body = await _request(host, port, "POST", "/v1/submit",
+                                      {"prompt": [1],
+                                       "deadline_s": "ten"})
+            assert st == 400 and "bad request field" in body["error"]
+            # out-of-range token id: the embedding gather would clamp
+            # it silently — the front door must 400 it instead
+            st, body = await _request(host, port, "POST", "/v1/submit",
+                                      {"prompt": [1, 10_000],
+                                       "max_new_tokens": 2})
+            assert st == 400 and "[0, 65)" in body["error"]
+            # bools pass isinstance(int) — they are not token ids
+            st, _ = await _request(host, port, "POST", "/v1/submit",
+                                   {"prompt": [True]})
+            assert st == 400
+            # malformed Content-Length: a 400 response, not an
+            # uncaught ValueError dropping the socket
+            r, w = await asyncio.open_connection(host, port)
+            w.write(b"POST /v1/submit HTTP/1.1\r\nHost: t\r\n"
+                    b"Content-Length: ten\r\n\r\n")
+            await w.drain()
+            data = await r.read()
+            w.close()
+            await w.wait_closed()
+            assert b" 400 " in data.split(b"\r\n", 1)[0]
+            assert b"malformed request" in data
+            st, _ = await _request(host, port, "GET",
+                                   "/v1/stream/nonexistent")
+            assert st == 404
+            st, _ = await _request(host, port, "GET", "/no/such/route")
+            assert st == 404
+            # duplicate in-flight id -> 400 (fleet-wide dedupe; b0 is
+            # pinned in the frozen queue, so this is deterministic)
+            st, body = await _request(
+                host, port, "POST", "/v1/submit",
+                {"id": "b0", "prompt": [3], "max_new_tokens": 2})
+            assert st == 400
+            assert body["error"] == "rejected_bad_request"
+        finally:
+            app.router.step = real_step
+            await app.stop()
+
+    asyncio.run(main())
+
+
+def test_cancel_endpoint_mid_stream(params):
+    """Explicit cancel of a long-running request: the stream closes
+    with a done event carrying finish_reason=cancelled and the partial
+    token count; the slot frees for the next request."""
+    async def main():
+        app = _app(params)
+        host, port = await app.start()
+        router = app.router
+        try:
+            st, _ = await _request(host, port, "POST", "/v1/submit",
+                                   {"id": "long", "prompt": [1],
+                                    "max_new_tokens": 28,
+                                    "greedy": True})
+            assert st == 200
+            stream_task = asyncio.ensure_future(
+                _stream(host, port, "long"))
+            while not (router.take_new_tokens("long") or
+                       router.result("long")):
+                await asyncio.sleep(0.005)
+            st, body = await _request(host, port, "POST",
+                                      "/v1/cancel/long")
+            assert st == 200 and body["cancelled"]
+            events = await stream_task
+            done = [d for ev, d in events if ev == "done"]
+            assert len(done) == 1
+            assert done[0]["finish_reason"] == "cancelled"
+            eng = router.replicas[0].engine
+            # slot + pages released (radix-cached prefix pages may stay)
+            assert eng.pool.n_free == eng.pool.n_slots
+        finally:
+            await app.stop()
+
+    asyncio.run(main())
+
+
+def test_client_disconnect_mid_stream_releases_slot_and_pages(params):
+    """The satellite behavior at the HTTP layer: a client that vanishes
+    mid-SSE cancels its request — the engine releases the slot and its
+    reserved KV pages promptly, not at what would have been
+    completion."""
+    async def main():
+        app = _app(params)
+        host, port = await app.start()
+        router = app.router
+        eng = router.replicas[0].engine
+        try:
+            st, _ = await _request(host, port, "POST", "/v1/submit",
+                                   {"id": "gone", "prompt": [2, 3],
+                                    "max_new_tokens": 28,
+                                    "greedy": True})
+            assert st == 200
+            r, w = await asyncio.open_connection(host, port)
+            w.write(b"GET /v1/stream/gone HTTP/1.1\r\nHost: t\r\n\r\n")
+            await w.drain()
+            await r.readuntil(b"data: ")      # first token is flowing
+            pages_held = eng.pool.alloc.pages_in_use
+            assert pages_held > 0 and eng.pool.n_free < eng.pool.n_slots
+            # vanish mid-stream (RST, not graceful close)
+            sock = w.get_extra_info("socket")
+            import socket as socketmod
+            sock.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_LINGER,
+                            b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            w.close()
+            for _ in range(400):
+                if (eng.pool.n_free == eng.pool.n_slots
+                        and not eng._active.any()):
+                    break
+                await asyncio.sleep(0.005)
+            assert eng.pool.n_free == eng.pool.n_slots
+            assert not eng._active.any()
+            # reserved (non-radix) pages are back: only refcount-0
+            # radix-cached prefix pages may remain resident
+            assert (eng.pool.alloc.ref > 0).sum() == 0
+        finally:
+            await app.stop()
+
+    asyncio.run(main())
+
+
+def test_client_disconnect_pops_terminal_result(params):
+    """Regression: a client that vanished mid-SSE used to leak its
+    terminal result forever — the cancelled RequestResult surfaced on a
+    later step with nobody left to pop it, growing results/_delivered/
+    _ttft by one entry per disconnect. The driver's abandoned sweep
+    must pop it the moment it surfaces."""
+    async def main():
+        app = _app(params)
+        host, port = await app.start()
+        router = app.router
+        try:
+            st, _ = await _request(host, port, "POST", "/v1/submit",
+                                   {"id": "leak", "prompt": [2, 3],
+                                    "max_new_tokens": 28,
+                                    "greedy": True})
+            assert st == 200
+            r, w = await asyncio.open_connection(host, port)
+            w.write(b"GET /v1/stream/leak HTTP/1.1\r\nHost: t\r\n\r\n")
+            await w.drain()
+            await r.readuntil(b"data: ")      # stream is flowing
+            sock = w.get_extra_info("socket")
+            import socket as socketmod
+            sock.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_LINGER,
+                            b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            w.close()                         # vanish (RST)
+            for _ in range(600):
+                if (not app._abandoned
+                        and not router.knows("leak")):
+                    break
+                await asyncio.sleep(0.005)
+            assert "leak" not in router.results
+            assert "leak" not in router._delivered
+            assert "leak" not in router._ttft
+            assert not app._abandoned
+        finally:
+            await app.stop()
+
+    asyncio.run(main())
+
+
+def test_driver_death_is_loud_and_fails_server(params):
+    """Regression: an exception from router.step() used to sit in the
+    never-awaited driver future while the server kept accepting
+    connections that could never complete. The done-callback must mark
+    the app not running, fail the step future (waking blocked SSE
+    handlers with the error), close the listener, and stop() must
+    re-raise the original exception."""
+    async def main():
+        app = _app(params)
+        host, port = await app.start()
+        boom = RuntimeError("scheduler invariant violated")
+
+        def exploding_step():
+            raise boom
+
+        app.router.step = exploding_step
+        st, _ = await _request(host, port, "POST", "/v1/submit",
+                               {"id": "d", "prompt": [1],
+                                "max_new_tokens": 4, "greedy": True})
+        assert st == 200          # accepted before the step explodes
+        for _ in range(400):
+            if not app._running:
+                break
+            await asyncio.sleep(0.005)
+        assert not app._running
+        assert app._driver.done()
+        # blocked waiters get the failure instead of spinning
+        assert app._step_fut.done()
+        assert app._step_fut.exception() is boom
+        # the listener is closed: new connections are refused
+        with pytest.raises(OSError):
+            await asyncio.open_connection(host, port)
+        with pytest.raises(RuntimeError, match="scheduler invariant"):
+            await app.stop()
+
+    asyncio.run(main())
+
+
+def test_stream_drains_ledger_before_done_event():
+    """The drain()-suspension race: if the request finishes (with more
+    tokens) while the SSE handler is suspended in writer.drain(), the
+    handler must drain the delivery ledger once more before emitting
+    `done` — otherwise the tail tokens are silently dropped while
+    done.n_tokens still counts them."""
+    from replicatinggpt_tpu.serve.requests import RequestResult
+
+    class ScriptedRouter:
+        """The handler takes [7], suspends in drain(), and by the time
+        it polls result() the request is terminal with tokens [8, 9]
+        still undelivered — they must come out of the final ledger
+        drain, not be dropped."""
+
+        def __init__(self):
+            self._takes = [[7], [8, 9]]
+            self._results = [RequestResult(
+                id="r", tokens=[7, 8, 9], finish_reason="max_tokens")]
+            self.popped = False
+
+        def take_new_tokens(self, rid):
+            return self._takes.pop(0) if self._takes else []
+
+        def result(self, rid):
+            return self._results.pop(0) if self._results else None
+
+        def pop_result(self, rid):
+            self.popped = True
+
+        def knows(self, rid):
+            return True
+
+    class FakeWriter:
+        def __init__(self):
+            self.data = b""
+
+        def write(self, b):
+            self.data += b
+
+        async def drain(self):
+            pass
+
+    router = ScriptedRouter()
+    app = ServeApp.__new__(ServeApp)       # no server/driver needed
+    app.router = router
+    app.idle_sleep_s = 0.0
+    app.step_wait_s = 0.0
+    app._step_fut = None
+    w = FakeWriter()
+    asyncio.run(app._stream("r", w))
+    events = _sse_events(w.data.partition(b"\r\n\r\n")[2])
+    toks = [d["token"] for ev, d in events if ev == "message"]
+    done = [d for ev, d in events if ev == "done"]
+    assert toks == [7, 8, 9]               # tail NOT dropped
+    assert len(done) == 1 and done[0]["n_tokens"] == 3
+    assert router.popped
+
+
+def test_serve_cli_subprocess_smoke(tmp_path):
+    """`python -m replicatinggpt_tpu serve` end to end in a real
+    subprocess: binds an ephemeral port, answers /healthz, completes a
+    /v1/generate round trip over SSE, and shuts down cleanly on
+    SIGINT (closing the per-replica journals)."""
+    import http.client
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    jdir = tmp_path / "journals"
+    jdir.mkdir()
+    sink = tmp_path / "events.jsonl"
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "replicatinggpt_tpu", "serve",
+         "--preset", "test-tiny", "--replicas", "2", "--port", "0",
+         "--pool-size", "2", "--journal-dir", str(jdir),
+         "--trace-jsonl", str(sink)],
+        stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        port = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stderr.readline()
+            if not line and proc.poll() is not None:
+                raise AssertionError("serve exited before binding")
+            m = re.search(r"serving on http://[\d.]+:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port is not None, "never saw the serving banner"
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        health = json.loads(r.read())
+        assert r.status == 200 and health["ok"]
+        assert len(health["replicas"]) == 2
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/v1/generate", json.dumps(
+            {"prompt": [1, 2, 3], "max_new_tokens": 4, "greedy": True}))
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.getheader("Content-Type") == "text/event-stream"
+        events = _sse_events(r.read())
+        toks = [d["token"] for ev, d in events if ev == "message"]
+        done = [d for ev, d in events if ev == "done"]
+        assert len(toks) == 4
+        assert len(done) == 1 and done[0]["finish_reason"] == "max_tokens"
+
+        proc.send_signal(signal.SIGINT)
+        rc = proc.wait(timeout=30)
+        assert rc == 0
+        # journals exist and are closed with the submit+finish records
+        recs = (jdir / "replica0.jsonl").read_text() \
+            + (jdir / "replica1.jsonl").read_text()
+        assert '"ev": "submit"' in recs and '"ev": "finish"' in recs
+        # --trace-jsonl alone (no --trace-out) must produce the sink
+        evs = sink.read_text()
+        assert '"request"' in evs and '"router_step"' in evs
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        proc.stderr.close()
+
+
+def test_healthz_and_metrics(params):
+    async def main():
+        app = _app(params, n_replicas=2)
+        host, port = await app.start()
+        router = app.router
+        try:
+            st, body = await _request(host, port, "GET", "/healthz")
+            assert st == 200 and body["ok"]
+            assert len(body["replicas"]) == 2
+            assert {"alive", "wedged", "queue_depth", "slots_active",
+                    "pages_in_use"} <= set(body["replicas"][0])
+            st, _ = await _request(host, port, "POST", "/v1/submit",
+                                   {"id": "m", "prompt": [4],
+                                    "max_new_tokens": 2,
+                                    "greedy": True})
+            assert st == 200
+            st, raw = await _request(host, port, "GET", "/metrics")
+            assert st == 200
+            text = raw.decode()
+            assert "tpu_gpt_fleet_fleet_requests_routed" in text
+            assert "tpu_gpt_fleet_replica0_queue_depth" in text
+            # no routable replica -> 503 (kill both in-process)
+            router._kill(0, router.n_steps)
+            router._kill(1, router.n_steps)
+            st, body = await _request(host, port, "GET", "/healthz")
+            assert st == 503 and not body["ok"]
+        finally:
+            await app.stop()
+
+    asyncio.run(main())

@@ -285,6 +285,46 @@ def test_duplicate_request_id_rejected_in_flight(params):
     assert len(eng.drain()) == 1
 
 
+def test_cancel_during_decode_releases_pages_promptly(params):
+    """Cancel of an ACTIVELY STREAMING request (tokens already
+    committed, mid-decode — the SSE-stream cancellation path): the slot
+    AND every reserved KV page release immediately at cancel(), not at
+    the next step or at what would have been completion. Only
+    refcount-0 radix-cached prefix pages may stay resident, and the
+    freed capacity admits a page-hungry successor at once — with
+    parity, without a recompile."""
+    ecfg = EngineConfig(pool_size=2, max_queue=4, page_size=4, n_pages=8)
+    eng = Engine(params, CFG, ecfg)
+    # 6-token prompt + 20-token budget = ceil(25/4) = 7 of 8 pages
+    doomed = _greedy("doomed", np.arange(1, 7, dtype=np.int32),
+                     max_new=20)
+    assert eng.submit(doomed) is None
+    for _ in range(5):
+        eng.step()
+    n_streamed = len(eng.partial_tokens("doomed"))
+    assert n_streamed >= 4                      # genuinely mid-stream
+    assert eng.pool.alloc.pages_in_use == 7
+    counts = compile_counts()
+    assert eng.cancel("doomed")
+    # released NOW: slot free, every slot-referenced page refcount 0
+    assert eng.pool.n_free == eng.pool.n_slots
+    assert (eng.pool.alloc.ref > 0).sum() == 0
+    # resident pages are exactly the radix-cached prefix (refcount 0)
+    assert (eng.pool.alloc.pages_in_use
+            == len(eng.pool.alloc.page_node))
+    # a successor needing most of the pool admits immediately
+    succ = _greedy("succ", np.arange(2, 8, dtype=np.int32), max_new=18)
+    want = _offline_greedy(params, [succ])
+    assert eng.submit(succ) is None
+    res = {r.id: r for r in eng.step()}   # surfaces doomed's terminal
+    assert eng.pool.slot_of("succ") is not None     # admitted at once
+    res.update({r.id: r for r in eng.drain()})
+    assert res["doomed"].finish_reason == "cancelled"
+    assert len(res["doomed"].tokens) == n_streamed  # partials preserved
+    assert res["succ"].tokens == want["succ"]
+    assert compile_counts() == counts               # cancel is host-only
+
+
 def test_scheduler_fits_blocks_head_fifo():
     sch = Scheduler(max_queue=4, block_size=8, clock=lambda: 0.0)
     a = Request(id="a", prompt=np.array([1, 1, 1], np.int32))

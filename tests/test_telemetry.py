@@ -184,6 +184,34 @@ def test_jsonl_sink_tolerates_torn_tail(tmp_path):
     assert tc.check_trace(str(out), min_requests=1) == []
 
 
+def test_shared_jsonl_reader_contract_and_dedup(tmp_path):
+    """utils.jsonl is THE torn-tail reader: telemetry and the request
+    journal import it rather than carrying private copies, and its
+    contract (skip blank, skip unparseable, missing file == empty
+    history) is pinned here once for all three consumers."""
+    from replicatinggpt_tpu.serve import journal as journal_mod
+    from replicatinggpt_tpu.utils import jsonl as jsonl_mod
+    from replicatinggpt_tpu.utils import telemetry as telemetry_mod
+
+    # dedup: both consumers resolve to the one shared implementation
+    assert telemetry_mod.load_jsonl is jsonl_mod.load_jsonl
+    assert (journal_mod.load_jsonl_if_exists
+            is jsonl_mod.load_jsonl_if_exists)
+
+    p = tmp_path / "records.jsonl"
+    p.write_text('{"a": 1}\n'
+                 '\n'                        # blank line
+                 'not json at all\n'         # interior corruption
+                 '{"b": 2}\n'
+                 '{"c": 3, "torn')           # crash mid-write
+    assert jsonl_mod.load_jsonl(str(p)) == [{"a": 1}, {"b": 2}]
+    assert list(jsonl_mod.iter_jsonl(str(p))) == [{"a": 1}, {"b": 2}]
+    # a journal that was never created is an empty history, not an error
+    assert jsonl_mod.load_jsonl_if_exists(str(tmp_path / "never")) == []
+    with pytest.raises(FileNotFoundError):
+        jsonl_mod.load_jsonl(str(tmp_path / "never"))
+
+
 def test_metrics_timeline_interval_and_forced_final(tmp_path):
     t = [0.0]
     m = Metrics()

@@ -9,16 +9,28 @@ an error, so CI validates structure explicitly:
 - duration (B/E) events balance per track with LIFO name matching —
   an unclosed or crossed span renders as garbage nesting;
 - complete (X) events carry a non-negative ``dur``;
-- every request envelope (a B/E pair named ``request``) opens exactly
-  once and closes exactly once per request id, end at-or-after start;
-- every span/instant tagged with a request id nests inside that
-  request's envelope on the same track (``request_unstarted`` markers
-  excepted — a shed/expired request never got a slot or an envelope).
+- request envelopes (B/E pairs named ``request``) form **exactly one
+  complete span tree per request id**. A request that migrated
+  replicas (fleet router requeue / hedged re-route) closes its old
+  segment with an E tagged ``migrated`` — those are non-terminal
+  segments; every request must have exactly ONE terminal (unmigrated)
+  close, each segment must end at-or-after it begins, and no segment
+  may be left open;
+- every span/instant tagged with a request id nests inside one of that
+  request's envelope segments on the same track
+  (``request_unstarted`` markers excepted — a shed/expired request
+  never got a slot or an envelope — and so is everything on a
+  **router track**: the router observes requests from outside their
+  slot lifetime, so its route/requeue/health instants legitimately
+  fall outside any envelope). Router tracks are recognized by their
+  thread-name metadata (``utils.telemetry.ROUTER_TRACK_NAME``) so
+  this validator stays stdlib-only with no imports from the package.
 
 Exits 0 on a valid trace, 1 with one line per violation otherwise.
-Used by tests/test_telemetry.py on a tiny replay's output (tier-1) and
-by hand on soak artifacts. Stdlib-only on purpose: the validator must
-run anywhere the artifact lands, including hosts without jax.
+Used by tests/test_telemetry.py on a tiny replay's output (tier-1), by
+tests/test_fleet.py on a replica-kill chaos replay's output, and by
+hand on soak artifacts. Stdlib-only on purpose: the validator must run
+anywhere the artifact lands, including hosts without jax.
 """
 
 from __future__ import annotations
@@ -34,6 +46,10 @@ EPS_US = 1.0
 #: terminal markers for requests that never got a slot (no envelope)
 UNSTARTED = {"request_unstarted"}
 
+#: thread-name metadata marking the fleet router's track — events there
+#: are envelope-exempt (must match utils.telemetry.ROUTER_TRACK_NAME)
+ROUTER_TRACK_NAME = "router"
+
 
 def check_trace(path: str, min_requests: int = 0) -> List[str]:
     """Validate one trace file; returns a list of violation strings
@@ -48,9 +64,19 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
     if not isinstance(events, list):
         return ["no traceEvents list"]
 
+    # first pass: which tracks are router tracks (by thread_name meta)
+    router_tracks = set()
+    for ev in events:
+        if (ev.get("ph") == "M" and ev.get("name") == "thread_name"
+                and (ev.get("args") or {}).get("name")
+                == ROUTER_TRACK_NAME):
+            router_tracks.add((ev.get("pid", 0), ev.get("tid", 0)))
+
     stacks: Dict[Tuple[int, int], List[dict]] = {}
-    # request id -> (tid, ts_begin, ts_end or None, n_begin, n_end)
-    envelopes: Dict[str, dict] = {}
+    # request id -> closed envelope segments
+    # [{"tid", "b", "e", "migrated"}]; open segments keyed (rid, track)
+    segments: Dict[str, List[dict]] = {}
+    open_envs: Dict[Tuple[str, Tuple[int, int]], List[float]] = {}
     tagged: List[dict] = []
 
     for ev in events:
@@ -63,16 +89,13 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
         if not isinstance(ts, (int, float)):
             errors.append(f"{ph} event {name!r} has no numeric ts")
             continue
-        rid = (ev.get("args") or {}).get("request")
+        args = ev.get("args") or {}
+        rid = args.get("request")
+        on_router = key in router_tracks
         if ph == "B":
             stacks.setdefault(key, []).append(ev)
             if name == "request":
-                env = envelopes.setdefault(
-                    rid, {"tid": key, "b": ts, "e": None,
-                          "n_b": 0, "n_e": 0})
-                env["n_b"] += 1
-                env["b"] = ts
-                env["tid"] = key
+                open_envs.setdefault((rid, key), []).append(ts)
         elif ph == "E":
             stack = stacks.get(key, [])
             if not stack:
@@ -84,58 +107,73 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
                         f"E {name!r} closes B {top.get('name')!r} on "
                         f"track {key} (crossed spans)")
             if name == "request":
-                env = envelopes.setdefault(
-                    rid, {"tid": key, "b": None, "e": ts,
-                          "n_b": 0, "n_e": 0})
-                env["n_e"] += 1
-                env["e"] = ts
+                opened = open_envs.get((rid, key))
+                if not opened:
+                    errors.append(f"request {rid!r}: E envelope on "
+                                  f"track {key} with no open B")
+                    continue
+                b = opened.pop()
+                segments.setdefault(rid, []).append(
+                    {"tid": key, "b": b, "e": ts,
+                     "migrated": bool(args.get("migrated"))})
         elif ph == "X":
             dur = ev.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
                 errors.append(f"X {name!r} has bad dur {dur!r}")
-            elif rid is not None:
+            elif rid is not None and not on_router:
                 tagged.append(ev)
         elif ph == "i":
-            if rid is not None and name not in UNSTARTED:
+            if rid is not None and name not in UNSTARTED and not on_router:
                 tagged.append(ev)
 
     for key, stack in stacks.items():
         for ev in stack:
             errors.append(f"B {ev.get('name')!r} on track {key} never "
                           f"closed")
+    for (rid, key), opened in open_envs.items():
+        for _ in opened:
+            errors.append(f"request {rid!r}: envelope segment on track "
+                          f"{key} never closed")
 
     n_complete = 0
-    for rid, env in sorted(envelopes.items(), key=lambda kv: str(kv[0])):
-        if env["n_b"] != 1 or env["n_e"] != 1:
-            errors.append(f"request {rid!r}: {env['n_b']} B / "
-                          f"{env['n_e']} E envelope events (want 1/1)")
-            continue
-        if env["e"] < env["b"] - EPS_US:
-            errors.append(f"request {rid!r}: envelope ends before it "
-                          f"begins ({env['e']} < {env['b']})")
-            continue
-        n_complete += 1
+    for rid, segs in sorted(segments.items(), key=lambda kv: str(kv[0])):
+        bad = False
+        for seg in segs:
+            if seg["e"] < seg["b"] - EPS_US:
+                errors.append(
+                    f"request {rid!r}: envelope segment on track "
+                    f"{seg['tid']} ends before it begins "
+                    f"({seg['e']} < {seg['b']})")
+                bad = True
+        terminal = [s for s in segs if not s["migrated"]]
+        if len(terminal) != 1:
+            errors.append(
+                f"request {rid!r}: {len(terminal)} terminal envelope "
+                f"segment(s) across {len(segs)} segment(s) (want "
+                f"exactly 1 — migrated segments must carry the "
+                f"'migrated' tag)")
+            bad = True
+        if not bad:
+            n_complete += 1
 
     for ev in tagged:
         rid = ev["args"]["request"]
-        env = envelopes.get(rid)
+        segs = segments.get(rid)
         name = ev.get("name")
-        if env is None or env["b"] is None or env["e"] is None:
+        if not segs:
             errors.append(f"{ev['ph']} {name!r} tagged request {rid!r} "
                           f"which has no complete envelope")
             continue
         key = (ev.get("pid", 0), ev.get("tid", 0))
-        if key != env["tid"]:
-            errors.append(f"{ev['ph']} {name!r} for request {rid!r} on "
-                          f"track {key}, envelope on {env['tid']}")
-            continue
         lo = ev["ts"]
         hi = lo + ev.get("dur", 0.0)
-        if lo < env["b"] - EPS_US or hi > env["e"] + EPS_US:
+        if not any(seg["tid"] == key
+                   and lo >= seg["b"] - EPS_US
+                   and hi <= seg["e"] + EPS_US for seg in segs):
             errors.append(
                 f"{ev['ph']} {name!r} for request {rid!r} "
-                f"[{lo:.1f}, {hi:.1f}] outside its envelope "
-                f"[{env['b']:.1f}, {env['e']:.1f}]")
+                f"[{lo:.1f}, {hi:.1f}] on track {key} outside every "
+                f"envelope segment of that request")
 
     if n_complete < min_requests:
         errors.append(f"only {n_complete} complete request envelope(s); "
