@@ -483,12 +483,21 @@ def bench_fleet(args) -> None:
     replica 0 at router step N mid-run (faults/fleet.py): the artifact
     then also demonstrates the requeue path — every in-flight request
     finishes via the crash journal, and the run is tagged
-    ``chaos: replica_kill``."""
+    ``chaos: replica_kill``.
+
+    ``--multiproc`` runs the replicas as real worker PROCESSES
+    (serve-worker + faults/procsup.py supervisor) speaking serve/rpc.py
+    over loopback sockets: the artifact gains per-worker pid/restart
+    counts and the requeue-latency distribution, and ``--fleet-kill-at``
+    becomes a REAL ``SIGKILL`` of worker 0's process (``proc_kill``) —
+    recovery is supervised restart + journal replay, and the completed
+    turn count still has to come out whole."""
     import jax
 
     from replicatinggpt_tpu.config import get_config
     from replicatinggpt_tpu.faults import Fault, FaultPlan, installed
     from replicatinggpt_tpu.faults.fleet import (FLEET_STEP,
+                                                 KIND_PROC_KILL,
                                                  KIND_REPLICA_KILL)
     from replicatinggpt_tpu.serve import (EngineConfig, RouterConfig,
                                           SessionLoadConfig,
@@ -520,32 +529,78 @@ def bench_fleet(args) -> None:
                         page_size=page_size,
                         n_pages=args.serve_n_pages)
     log(f"fleet replay: {lcfg.n_sessions} sessions x {lcfg.turns} turns "
-        f"@ {lcfg.rate}/s over {rcfg.n_replicas} replicas "
+        f"@ {lcfg.rate}/s over {rcfg.n_replicas} "
+        f"{'worker process' if args.multiproc else 'replica'}(s) "
         f"(pool {ecfg.pool_size} each), prefix {prefix_len} tok x "
         f"{lcfg.n_prefix_groups} groups, model {cfg.model.n_layer}L/"
         f"{cfg.model.n_head}H/{cfg.model.n_embd}C on {dev.device_kind}")
-    state = create_train_state(jax.random.PRNGKey(0), cfg.model,
-                               cfg.train)
     import contextlib
     import tempfile
     plan_ctx = contextlib.nullcontext()
     if args.fleet_kill_at >= 0:
+        # in-process: simulated replica_kill; multiproc: a REAL SIGKILL
+        # of worker 0's OS process through the supervisor
+        kind = KIND_PROC_KILL if args.multiproc else KIND_REPLICA_KILL
         plan_ctx = installed(FaultPlan(Fault(
-            site=FLEET_STEP, kind=KIND_REPLICA_KILL,
-            at=args.fleet_kill_at, arg=0)))
+            site=FLEET_STEP, kind=kind, at=args.fleet_kill_at, arg=0)))
+    workers = None
     with tempfile.TemporaryDirectory() as td:
         if rcfg.journal_dir is None:
             # requeue-after-kill needs journals; default them to a temp
             # dir so the chaos arm always has the recovery path
             import dataclasses
             rcfg = dataclasses.replace(rcfg, journal_dir=td)
-        with plan_ctx:
-            summary = run_fleet_replay(
-                state.params, cfg.model, lcfg, rcfg, ecfg,
-                trace_out=args.trace_out,
-                metrics_timeline=args.metrics_timeline,
-                metrics_out=args.metrics_out)
+        if args.multiproc:
+            from replicatinggpt_tpu.faults.procsup import (
+                SupervisorConfig, make_worker_specs, spawn_fleet)
+            specs = make_worker_specs(
+                rcfg.n_replicas, rcfg.journal_dir,
+                ["--preset", args.preset],
+                ["--pool-size", str(ecfg.pool_size),
+                 "--max-queue", str(ecfg.max_queue),
+                 "--page-size", str(ecfg.page_size),
+                 "--n-pages", str(ecfg.n_pages)])
+            log(f"spawning {rcfg.n_replicas} worker process(es) "
+                f"(journals in {rcfg.journal_dir})")
+            tel = None
+            if args.trace_out:
+                # the pre-built-router replay exports the ROUTER's own
+                # recorder — it must exist before spawn_fleet wires it
+                from replicatinggpt_tpu.utils.telemetry import Telemetry
+                tel = Telemetry()
+            router, sup = spawn_fleet(specs, rcfg,
+                                      SupervisorConfig(backoff_s=0.2),
+                                      telemetry=tel)
+            try:
+                with plan_ctx:
+                    summary = run_fleet_replay(
+                        None, cfg.model, lcfg,
+                        router=router, supervisor=sup,
+                        trace_out=args.trace_out,
+                        metrics_timeline=args.metrics_timeline,
+                        metrics_out=args.metrics_out)
+                workers = [{
+                    "worker": h.spec.idx, "pid": h.pid, "gen": h.gen,
+                    "restarts": h.restarts,
+                    "crash_restarts": h.crash_restarts,
+                    "state": h.state,
+                } for h in sup.handles]
+            finally:
+                sup.stop_all()
+                router.close()
+                if tel is not None:
+                    tel.close()
+        else:
+            state = create_train_state(jax.random.PRNGKey(0),
+                                       cfg.model, cfg.train)
+            with plan_ctx:
+                summary = run_fleet_replay(
+                    state.params, cfg.model, lcfg, rcfg, ecfg,
+                    trace_out=args.trace_out,
+                    metrics_timeline=args.metrics_timeline,
+                    metrics_out=args.metrics_out)
     ttft = summary["fleet_ttft_s"]
+    requeue_lat = summary["requeue_latency_s"]
     agg = (summary["generated_tokens"] / summary["wall_s"]
            if summary["wall_s"] > 0 else 0.0)
     log(f"fleet: {summary['n_completed']}/{summary['n_requests']} "
@@ -567,6 +622,10 @@ def bench_fleet(args) -> None:
         "n_completed": summary["n_completed"],
         "fleet_ttft_p50_ms": round(ttft.get("p50", 0) * 1e3, 2),
         "fleet_ttft_p99_ms": round(ttft.get("p99", 0) * 1e3, 2),
+        "requeue_latency_p50_ms": round(
+            requeue_lat.get("p50", 0) * 1e3, 2),
+        "requeue_latency_p99_ms": round(
+            requeue_lat.get("p99", 0) * 1e3, 2),
         "aggregate_prefix_hit_rate":
             summary["aggregate_prefix_hit_rate"],
         "recompiles_after_warmup": summary["recompiles_after_warmup"],
@@ -579,12 +638,18 @@ def bench_fleet(args) -> None:
             "alive": r["health"]["alive"],
             "occupancy_mean": r["occupancy_mean"],
             "n_steps": r["n_steps"],
-            "pages_in_use": r["pages"]["pages_in_use"],
-            "page_utilization": r["pages"]["page_utilization"],
-            "prefix_hit_rate": r["pages"]["prefix_hit_rate"],
+            "pages_in_use": r.get("pages", {}).get("pages_in_use", 0),
+            "page_utilization": r.get("pages", {})
+            .get("page_utilization", 0.0),
+            "prefix_hit_rate": r.get("pages", {})
+            .get("prefix_hit_rate", 0.0),
             "finished": r["finished"],
         } for r in summary["replicas"]],
-        **({"chaos": "replica_kill", "kill_at": args.fleet_kill_at}
+        **({"multiproc": True, "workers": workers}
+           if args.multiproc else {}),
+        **({"chaos": ("proc_kill" if args.multiproc
+                      else "replica_kill"),
+            "kill_at": args.fleet_kill_at}
            if args.fleet_kill_at >= 0 else {}),
         **({"artifacts": summary["artifacts"]}
            if "artifacts" in summary else {}),
@@ -958,7 +1023,14 @@ def main() -> None:
                    help="--mode fleet: inject replica_kill of replica 0 "
                         "at this router step (-1 = no chaos); the "
                         "journal-requeue path then runs inside the "
-                        "measured replay")
+                        "measured replay. With --multiproc this is a "
+                        "REAL SIGKILL of worker 0's process")
+    p.add_argument("--multiproc", action="store_true",
+                   help="--mode fleet: run the replicas as real worker "
+                        "PROCESSES (serve-worker subprocesses over "
+                        "serve/rpc.py under the faults/procsup.py "
+                        "supervisor); the artifact gains per-worker "
+                        "pid/restart counts and requeue latency")
     p.add_argument("--fleet-journal-dir", default="",
                    help="--mode fleet: per-replica crash journals "
                         "(default: a temp dir)")

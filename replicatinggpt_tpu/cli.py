@@ -296,48 +296,103 @@ def cmd_serve_replay(args) -> int:
 def cmd_serve(args) -> int:
     """The fleet front door: N engine replicas behind the prefix-
     affinity router (serve/router.py), exposed over HTTP/SSE
-    (serve/http.py) — submit/stream/cancel/healthz/metrics. Binds
-    loopback by default (the zero-egress image takes no outside
+    (serve/http.py) — submit/stream/cancel/healthz/readyz/metrics.
+    Binds loopback by default (the zero-egress image takes no outside
     traffic; this is the ingress path's real implementation, exercised
     by tests and local clients). Ctrl-C shuts down cleanly, closing
-    the per-replica crash journals."""
+    the per-replica crash journals.
+
+    ``--multiproc`` runs the replicas as real worker PROCESSES
+    (serve-worker subcommand) under the process supervisor
+    (faults/procsup.py): each worker owns its own engine and an
+    exclusively-locked journal in --journal-dir; the router speaks
+    serve/rpc.py to them, the supervisor restarts the dead with
+    backoff and quarantines past the restart budget
+    (docs/serving.md#deployment)."""
     _apply_rng_impl(args)
     import asyncio
 
-    import jax
-
-    from .config import config_from_args
-    from .serve import EngineConfig, Router, RouterConfig
     from .serve.http import ServeApp
-    from .train.state import create_train_state
-    cfg = config_from_args(args)
-    state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
-                               cfg.model, cfg.train)
-    if args.checkpoint_dir:
-        from .train.checkpoint import CheckpointManager
-        restored = CheckpointManager(args.checkpoint_dir).restore_latest(state)
-        if restored is None:
-            print("no checkpoint found; serving random init",
-                  file=sys.stderr)
-        else:
-            state = restored
+    from .serve.router import RouterConfig
+
+    rcfg = RouterConfig(n_replicas=args.replicas,
+                        journal_dir=args.journal_dir,
+                        affinity=not args.no_affinity,
+                        wedge_budget_s=args.wedge_budget_s,
+                        wedge_patience=args.wedge_patience,
+                        step_timeout_s=args.step_timeout_s)
     telemetry = None
     if args.trace_out or args.trace_jsonl:
         from .utils.telemetry import Telemetry
         telemetry = Telemetry(jsonl_path=args.trace_jsonl)
-    router = Router(
-        state.params, cfg.model,
-        RouterConfig(n_replicas=args.replicas,
-                     journal_dir=args.journal_dir,
-                     affinity=not args.no_affinity,
-                     wedge_budget_s=args.wedge_budget_s,
-                     wedge_patience=args.wedge_patience),
-        EngineConfig(pool_size=args.pool_size, max_queue=args.max_queue,
-                     prefill_chunk=args.prefill_chunk,
-                     page_size=args.page_size, n_pages=args.n_pages,
-                     prefix_cache=not args.no_prefix_cache),
-        telemetry=telemetry)
-    app = ServeApp(router)
+    supervisor = None
+    if args.multiproc:
+        if not args.journal_dir:
+            print("--multiproc requires --journal-dir (shared journal "
+                  "storage is the cross-process source of truth)",
+                  file=sys.stderr)
+            return 2
+        from .faults.procsup import (SupervisorConfig,
+                                     make_worker_specs, spawn_fleet)
+        # the workers must build the SAME model the operator asked
+        # for: forward every set model-override flag (the serve-worker
+        # parser takes the full add_config_flags set too) — silently
+        # serving the preset's defaults would be a different model.
+        # The flag list lives NEXT TO add_config_flags
+        # (config.MODEL_OVERRIDE_FLAGS) so new flags can't fall out.
+        from .config import config_override_args
+        config_args = (["--preset", args.preset]
+                       + config_override_args(args))
+        if args.rng_impl is not None:
+            config_args += ["--rng-impl", args.rng_impl]
+        engine_args = ["--pool-size", str(args.pool_size),
+                       "--max-queue", str(args.max_queue),
+                       "--prefill-chunk", str(args.prefill_chunk),
+                       "--page-size", str(args.page_size),
+                       "--n-pages", str(args.n_pages)]
+        if args.no_prefix_cache:
+            engine_args.append("--no-prefix-cache")
+        if args.no_fsync:
+            engine_args.append("--no-fsync")
+        if args.checkpoint_dir:
+            engine_args += ["--checkpoint-dir", args.checkpoint_dir]
+        specs = make_worker_specs(args.replicas, args.journal_dir,
+                                  config_args, engine_args)
+        print(f"spawning {args.replicas} worker process(es); waiting "
+              f"for warmup + ready files in {args.journal_dir}",
+              file=sys.stderr)
+        router, supervisor = spawn_fleet(
+            specs, rcfg,
+            SupervisorConfig(restart_budget=args.restart_budget),
+            telemetry=telemetry)
+    else:
+        import jax
+
+        from .config import config_from_args
+        from .serve import EngineConfig, Router
+        from .train.state import create_train_state
+        cfg = config_from_args(args)
+        state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
+                                   cfg.model, cfg.train)
+        if args.checkpoint_dir:
+            from .train.checkpoint import CheckpointManager
+            restored = (CheckpointManager(args.checkpoint_dir)
+                        .restore_latest(state))
+            if restored is None:
+                print("no checkpoint found; serving random init",
+                      file=sys.stderr)
+            else:
+                state = restored
+        router = Router(
+            state.params, cfg.model, rcfg,
+            EngineConfig(pool_size=args.pool_size,
+                         max_queue=args.max_queue,
+                         prefill_chunk=args.prefill_chunk,
+                         page_size=args.page_size, n_pages=args.n_pages,
+                         prefix_cache=not args.no_prefix_cache),
+            telemetry=telemetry)
+    app = ServeApp(router, idle_timeout_s=args.idle_timeout_s,
+                   supervisor=supervisor)
     rc = 0
     try:
         asyncio.run(app.serve_forever(args.host, args.port))
@@ -348,6 +403,8 @@ def cmd_serve(args) -> int:
         # traceback and closed the server out from under serve_forever)
         rc = 1
     finally:
+        if supervisor is not None:
+            supervisor.stop_all()
         router.close()
         if telemetry is not None:
             if args.trace_out:
@@ -359,6 +416,18 @@ def cmd_serve(args) -> int:
                 print(f"telemetry: event sink -> {args.trace_jsonl}",
                       file=sys.stderr)
     return rc
+
+
+def cmd_serve_worker(args) -> int:
+    """One fleet worker process (serve/worker.py): builds + warms one
+    engine, opens its exclusively-locked crash journal, replays the
+    previous incarnation's unfinished requests, then serves the
+    serve/rpc.py protocol on loopback until the router shuts it down
+    (or something kills it — which is the point: the journal + the
+    router's delivery ledger make that survivable)."""
+    _apply_rng_impl(args)
+    from .serve.worker import run_worker
+    return run_worker(args)
 
 
 def cmd_eval(args) -> int:
@@ -582,6 +651,26 @@ def main(argv=None) -> int:
     pv.add_argument("--page-size", type=int, default=0)
     pv.add_argument("--n-pages", type=int, default=0)
     pv.add_argument("--no-prefix-cache", action="store_true")
+    pv.add_argument("--multiproc", action="store_true",
+                    help="run replicas as real worker PROCESSES "
+                         "(serve-worker) under the process supervisor: "
+                         "supervised restarts with backoff, rolling "
+                         "restarts, SIGKILL-survivable exactly-once "
+                         "streams; requires --journal-dir")
+    pv.add_argument("--restart-budget", type=int, default=3,
+                    help="--multiproc: crash restarts per worker before "
+                         "quarantine (journal requeued onto survivors)")
+    pv.add_argument("--step-timeout-s", type=float, default=10.0,
+                    help="--multiproc: RPC budget for one worker step; "
+                         "a hung (SIGSTOPped) worker costs the router "
+                         "at most this per step")
+    pv.add_argument("--no-fsync", action="store_true",
+                    help="--multiproc: disable the workers' "
+                         "fsync-per-finish journal durability")
+    pv.add_argument("--idle-timeout-s", type=float, default=30.0,
+                    help="drop a connection that stalls mid-headers/"
+                         "body or stops consuming its SSE stream for "
+                         "this long (slow-loris guard; 0 = off)")
     pv.add_argument("--trace-out", default=None,
                     help="write a Perfetto trace (router + per-replica "
                          "tracks) at shutdown")
@@ -589,6 +678,43 @@ def main(argv=None) -> int:
                     help="stream trace events to this JSONL sink as "
                          "they happen (crash-tolerant)")
     pv.set_defaults(fn=cmd_serve)
+
+    pw = sub.add_parser("serve-worker",
+                        help="one fleet worker process: an engine "
+                             "behind the serve/rpc.py socket protocol "
+                             "with a locked crash journal and startup "
+                             "journal replay (spawned by `serve "
+                             "--multiproc` / the process supervisor; "
+                             "runnable by hand for debugging)")
+    add_config_flags(pw)
+    pw.add_argument("--rng-impl", default=None,
+                    choices=["threefry2x32", "rbg"])
+    pw.add_argument("--checkpoint-dir", default=None)
+    pw.add_argument("--host", default="127.0.0.1")
+    pw.add_argument("--port", type=int, default=0,
+                    help="RPC port (0 = ephemeral; the bound port is "
+                         "published in --ready-file and the stderr "
+                         "banner)")
+    pw.add_argument("--journal", default=None,
+                    help="crash journal path (exclusively flock-ed; "
+                         "replayed at startup)")
+    pw.add_argument("--ready-file", default=None,
+                    help="atomically write {port, pid, gen, replayed} "
+                         "here once warmed + replayed (the supervisor's "
+                         "readiness handshake)")
+    pw.add_argument("--gen", type=int, default=0,
+                    help="spawn generation (stamped into --ready-file "
+                         "so the supervisor never attaches a stale "
+                         "incarnation)")
+    pw.add_argument("--no-fsync", action="store_true",
+                    help="disable fsync-per-finish journal durability")
+    pw.add_argument("--pool-size", type=int, default=8)
+    pw.add_argument("--max-queue", type=int, default=64)
+    pw.add_argument("--prefill-chunk", type=int, default=0)
+    pw.add_argument("--page-size", type=int, default=0)
+    pw.add_argument("--n-pages", type=int, default=0)
+    pw.add_argument("--no-prefix-cache", action="store_true")
+    pw.set_defaults(fn=cmd_serve_worker)
 
     pe = sub.add_parser("eval", help="estimate train/val loss")
     add_config_flags(pe)

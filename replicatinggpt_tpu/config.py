@@ -402,6 +402,39 @@ def add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dataset", default=None)
 
 
+#: (dest, flag) pairs for every MODEL-shape override registered by
+#: add_config_flags — kept adjacent so a new model flag is added to
+#: both in one edit. config_override_args() reconstructs these for a
+#: respawned process (`serve --multiproc` workers): a flag missing
+#: here means a worker silently builds a DIFFERENT model than the
+#: operator asked for.
+MODEL_OVERRIDE_FLAGS = (
+    ("vocab_size", "--vocab-size"), ("block_size", "--block-size"),
+    ("n_layer", "--n-layer"), ("n_head", "--n-head"),
+    ("n_embd", "--n-embd"), ("dropout", "--dropout"),
+    ("dtype", "--dtype"), ("attention_impl", "--attention"),
+    ("loss_chunk", "--loss-chunk"),
+    ("decode_cache_layout", "--decode-cache-layout"),
+    ("remat_policy", "--remat-policy"),
+)
+
+
+def config_override_args(args: argparse.Namespace) -> list:
+    """Reconstruct the model-override CLI arguments present on
+    ``args`` (None = unset = omitted) so one process can spawn another
+    with the same model config through its own add_config_flags
+    parser."""
+    out: list = []
+    for dest, flag in MODEL_OVERRIDE_FLAGS:
+        v = getattr(args, dest, None)
+        if v is not None:
+            out += [flag, str(v)]
+    remat = getattr(args, "remat", None)
+    if remat is not None:                # tri-state store_true/false
+        out.append("--remat" if remat else "--no-remat")
+    return out
+
+
 def config_from_args(args: argparse.Namespace) -> Config:
     cfg = get_config(args.preset)
     m, t, mesh = cfg.model, cfg.train, cfg.mesh

@@ -26,6 +26,18 @@ Sites (consulted once per router step / per generated session):
   ``arg`` (seeded — deterministic per loadgen seed), turning a uniform
   session mix into hot-key traffic that hammers one radix subtree and
   one affinity target.
+- ``fleet/step`` with kind ``proc_kill``: the multi-process fleet's
+  real death — the supervisor (faults/procsup.py) SIGKILLs worker
+  ``int(arg)``'s actual OS process at router step ``at``. No Python
+  cleanup runs in the worker; recovery is supervised restart + the
+  worker's own journal replay (or, past the restart budget,
+  router-side requeue onto survivors). In-process routers (no
+  supervisor attached) log and ignore it.
+- ``fleet/step`` with kind ``proc_hang``: SIGSTOP worker
+  ``int(arg2)``'s process for ``int(arg)`` supervisor ticks, then
+  SIGCONT. From the router's side this is indistinguishable from a
+  wedged device: RPC calls time out while the process stays "alive" —
+  exactly what the wedge probe and hedged re-route must handle.
 """
 
 from __future__ import annotations
@@ -44,6 +56,9 @@ FLEET_SESSION = "fleet/session"
 KIND_REPLICA_KILL = "replica_kill"
 KIND_REPLICA_WEDGE = "replica_wedge"
 KIND_HOT_KEY_SKEW = "hot_key_skew"
+#: process-level chaos (multi-process fleet only; needs a supervisor)
+KIND_PROC_KILL = "proc_kill"
+KIND_PROC_HANG = "proc_hang"
 
 
 def fleet_step_fault(step: int) -> Optional[Fault]:

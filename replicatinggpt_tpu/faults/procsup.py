@@ -1,0 +1,500 @@
+"""Process supervisor: restart-on-exit, backoff, quarantine, rolling
+restarts — and real process-level chaos for the multi-process fleet.
+
+PR 4's supervision heals *inside* a process (rollback, watchdog,
+shedding); PR 8's router heals *across* in-process replicas. This
+module closes the last gap: the replicas are now worker **processes**
+(serve/worker.py), and something must notice when one of them actually
+dies. The supervisor owns that policy; the router
+(serve/router.py) owns the request ledger. The split is deliberate —
+the router decides what happens to *requests* (keep waiting for a
+restart, requeue onto survivors), the supervisor decides what happens
+to *processes* (restart with backoff, give up and quarantine):
+
+- **Death detection**: ``Popen.poll`` per tick, plus periodic RPC
+  ``health`` probes with short timeouts (a zombie that holds its port
+  but answers nothing is as dead as an exited one — two consecutive
+  probe failures escalate to SIGKILL so the exit path takes over).
+- **Restart-on-exit**: an unexpected exit marks the replica down in
+  the router (its in-flight ledger entries WAIT — the restarted worker
+  replays its journal and resumes them), then respawns after an
+  exponential backoff (``backoff_s * backoff_mult^n``). Each spawn
+  writes a fresh generation into the worker's ready file; the
+  supervisor attaches the router only when the ready file shows the
+  generation it launched.
+- **Restart budget → quarantine**: past ``restart_budget`` *crash*
+  restarts (intentional rolling-restart stops are free), the
+  supervisor stops trying: ``Router.abandon_replica`` requeues the
+  worker's journaled in-flight work onto the survivors and the
+  replica leaves rotation for good.
+- **Rolling restart**: replica by replica — drain (the router
+  migrates its in-flight requests onto the rest of the fleet), stop
+  gracefully (``shutdown`` RPC, SIGTERM fallback), respawn, wait
+  attached, move on. At least ``n-1`` workers serve at every moment,
+  so a fleet of two or more drops nothing; ``/readyz`` reports 503
+  exactly when zero routable warmed workers remain.
+- **Chaos**: ``proc_kill`` (a real ``SIGKILL`` — no Python cleanup,
+  no flushed buffers, the fault every other layer only simulated) and
+  ``proc_hang`` (``SIGSTOP`` for N ticks, then ``SIGCONT`` — the
+  process is alive but frozen, which the router's RPC timeouts and
+  wedge probe must survive). Both arrive through the standard
+  ``FaultPlan`` machinery: ``Router.step`` fires the ``fleet/step``
+  seam and delegates the proc kinds here (faults/fleet.py).
+
+Everything is ticked from the same single-threaded loop that steps the
+router (the HTTP driver task, or the fleet replay loop): one
+``supervisor.tick()`` after each ``router.step()``. No threads, no
+signals-as-control-flow — deaths are observed, never raced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+#: handle lifecycle states
+RUNNING = "running"
+BACKOFF = "backoff"
+SPAWNING = "spawning"       # process launched, ready file not seen yet
+QUARANTINED = "quarantined"
+STOPPED = "stopped"
+
+
+@dataclass(frozen=True)
+class SupervisorConfig:
+    """Restart policy knobs (docs/robustness.md has the fault matrix)."""
+
+    #: crash restarts per worker before quarantine (intentional
+    #: rolling-restart stops do not count)
+    restart_budget: int = 3
+    backoff_s: float = 0.5
+    backoff_mult: float = 2.0
+    #: a spawned worker must write its ready file within this budget
+    #: (covers jax import + compile warmup) or the spawn counts as a
+    #: crash
+    ready_timeout_s: float = 180.0
+    #: RPC health-probe budget; two consecutive failures escalate to
+    #: SIGKILL
+    probe_timeout_s: float = 2.0
+    #: probe every N ticks (0 disables probing — the router's own step
+    #: RPC failures still catch deaths)
+    probe_every: int = 8
+
+
+@dataclass
+class WorkerSpec:
+    """How to (re)launch one worker. ``cmd`` is the full command minus
+    the per-spawn ``--gen``; the supervisor appends that."""
+
+    idx: int
+    cmd: List[str]
+    journal_path: str
+    ready_file: str
+    log_path: Optional[str] = None
+    env: Optional[dict] = None
+
+
+@dataclass
+class WorkerHandle:
+    spec: WorkerSpec
+    proc: Optional[subprocess.Popen] = None
+    state: str = STOPPED
+    gen: int = -1
+    pid: Optional[int] = None
+    restarts: int = 0          # every respawn (rolling included)
+    crash_restarts: int = 0    # budget-counted respawns
+    backoff_until: float = 0.0
+    spawn_t: float = 0.0
+    hang_ticks: int = 0        # SIGSTOP chaos: SIGCONT when it hits 0
+    probe_failures: int = 0
+    intentional_stop: bool = False
+    events: List[str] = field(default_factory=list)
+
+
+class ProcSupervisor:
+    """Owns the worker processes of one fleet. Drive it with
+    :meth:`tick` from the router's loop; it talks back to the router
+    through ``mark_down`` / ``attach_replica`` / ``abandon_replica``.
+    """
+
+    def __init__(self, specs: List[WorkerSpec],
+                 cfg: SupervisorConfig = SupervisorConfig()):
+        self.cfg = cfg
+        self.handles = [WorkerHandle(spec=s) for s in specs]
+        self.router = None          # attach_router
+        self.ticks = 0
+        self._rolling: List[int] = []
+        self._rolling_phase = ""
+        self._rolling_target_gen = -1
+        self.events: List[str] = []
+
+    def attach_router(self, router) -> None:
+        self.router = router
+        router.supervisor = self
+
+    @property
+    def reviving(self) -> bool:
+        """True while any worker is on its way back (spawning, backing
+        off, or intentionally stopped for a rolling restart) — the
+        router's requeue ladder holds its retry budget while this is
+        set instead of burning attempts against a fleet that is mid-
+        recovery (a zero-routable window during a single-worker rolling
+        restart must not reject the held requests)."""
+        return any(h.state in (SPAWNING, BACKOFF) or h.intentional_stop
+                   for h in self.handles)
+
+    # ------------------------------------------------------------- spawn
+
+    def _event(self, msg: str) -> None:
+        self.events.append(msg)
+        if len(self.events) > 256:
+            del self.events[:len(self.events) - 256]
+        if self.router is not None:
+            from ..utils.telemetry import ROUTER_TRACK
+            self.router._event(f"supervisor: {msg}")
+            self.router.tel.instant("supervisor", ROUTER_TRACK,
+                                    note=msg)
+
+    def _spawn(self, h: WorkerHandle) -> None:
+        h.gen += 1
+        h.restarts += int(h.gen > 0)
+        try:
+            os.remove(h.spec.ready_file)
+        except OSError:
+            pass
+        stdout = subprocess.DEVNULL
+        if h.spec.log_path:
+            stdout = open(h.spec.log_path, "a")
+        env = {**os.environ, **(h.spec.env or {})}
+        h.proc = subprocess.Popen(
+            h.spec.cmd + ["--gen", str(h.gen)],
+            stdout=stdout, stderr=stdout, env=env)
+        if stdout is not subprocess.DEVNULL:
+            stdout.close()      # Popen holds its own dup
+        h.pid = h.proc.pid
+        h.state = SPAWNING
+        h.spawn_t = time.monotonic()
+        h.probe_failures = 0
+        self._event(f"worker {h.spec.idx} spawned "
+                    f"(pid {h.pid}, gen {h.gen})")
+
+    def start_all(self, wait: bool = True,
+                  timeout_s: Optional[float] = None) -> None:
+        """Spawn every worker; with ``wait`` (the default), block until
+        each one is ready and attached to the router. A failed (or
+        interrupted) startup stops EVERY spawned worker before raising
+        — an orphaned worker would hold its journal flock and crash-
+        loop the next run's replacement with JournalBusyError."""
+        for h in self.handles:
+            self._spawn(h)
+        if not wait:
+            return
+        budget = timeout_s or self.cfg.ready_timeout_s
+        deadline = time.monotonic() + budget
+        try:
+            while time.monotonic() < deadline:
+                for h in self.handles:
+                    if h.state == SPAWNING:
+                        self._check_ready(h)
+                    elif (h.state == BACKOFF
+                          and time.monotonic() >= h.backoff_until):
+                        # a worker that crashed during startup retries
+                        # inside the wait (the tick loop is not running
+                        # yet) — without this, one startup crash burns
+                        # the whole ready budget
+                        self._spawn(h)
+                if all(h.state == RUNNING for h in self.handles):
+                    return
+                if any(h.state == QUARANTINED for h in self.handles):
+                    break          # crash-looped out of the budget:
+                    #                fail fast, don't burn the deadline
+                time.sleep(0.05)
+        except BaseException:      # Ctrl-C mid-warmup included
+            self.stop_all()
+            raise
+        bad = [h.spec.idx for h in self.handles if h.state != RUNNING]
+        logs = [self.handles[i].spec.log_path for i in bad]
+        self.stop_all()
+        raise RuntimeError(
+            f"workers {bad} not ready within {budget}s (see {logs})")
+
+    def stop_all(self, timeout_s: float = 15.0) -> None:
+        for h in self.handles:
+            h.intentional_stop = True
+            h.state = STOPPED
+            if h.proc is not None and h.proc.poll() is None:
+                if h.hang_ticks:          # a stopped process cannot
+                    self._signal(h, signal.SIGCONT)   # handle SIGTERM
+                    h.hang_ticks = 0
+                self._signal(h, signal.SIGTERM)
+        deadline = time.monotonic() + timeout_s
+        for h in self.handles:
+            if h.proc is None:
+                continue
+            while (h.proc.poll() is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            if h.proc.poll() is None:
+                self._signal(h, signal.SIGKILL)
+                h.proc.wait()
+
+    @staticmethod
+    def _signal(h: WorkerHandle, sig) -> None:
+        try:
+            os.kill(h.proc.pid, sig)
+        except (OSError, AttributeError):
+            pass
+
+    # -------------------------------------------------------------- tick
+
+    def tick(self) -> None:
+        """One supervision pass: resume chaos hangs, observe deaths,
+        advance backoffs/spawns, probe health, advance any rolling
+        restart. Call after every ``router.step()`` (and on idle loop
+        iterations — restarts must progress while the fleet waits)."""
+        router = self.router
+        assert router is not None, "attach_router first"
+        self.ticks += 1
+        for h in self.handles:
+            if h.hang_ticks > 0:
+                h.hang_ticks -= 1
+                if h.hang_ticks == 0:
+                    self._signal(h, signal.SIGCONT)
+                    self._event(f"worker {h.spec.idx} SIGCONT "
+                                f"(hang over)")
+            if h.state == RUNNING:
+                if h.proc is not None and h.proc.poll() is not None:
+                    self._on_exit(h, h.proc.returncode)
+                    continue
+                self._maybe_probe(h)
+                # the router declared it down (RPC refused / worker
+                # dispatch broken) but the process lingers: a zombie —
+                # SIGKILL it so the exit path owns recovery
+                if (not router.replicas[h.spec.idx].alive
+                        and h.hang_ticks == 0):
+                    self._event(f"worker {h.spec.idx} unreachable but "
+                                f"process alive — escalating SIGKILL")
+                    self._signal(h, signal.SIGKILL)
+            elif h.state == BACKOFF:
+                if time.monotonic() >= h.backoff_until:
+                    self._spawn(h)
+            elif h.state == SPAWNING:
+                self._check_ready(h)
+        self._tick_rolling()
+
+    def _on_exit(self, h: WorkerHandle, rc) -> None:
+        router = self.router
+        router.mark_down(h.spec.idx,
+                         f"process exited rc={rc}")
+        if h.intentional_stop:
+            # rolling restart / operator stop: free respawn, no budget
+            h.intentional_stop = False
+            self._event(f"worker {h.spec.idx} stopped (intentional); "
+                        f"respawning")
+            self._spawn(h)
+            return
+        h.crash_restarts += 1
+        if h.crash_restarts > self.cfg.restart_budget:
+            h.state = QUARANTINED
+            self._event(f"worker {h.spec.idx} exceeded restart budget "
+                        f"({self.cfg.restart_budget}); quarantined — "
+                        f"requeueing its journal onto survivors")
+            router.abandon_replica(h.spec.idx)
+            return
+        delay = (self.cfg.backoff_s
+                 * self.cfg.backoff_mult ** (h.crash_restarts - 1))
+        h.state = BACKOFF
+        h.backoff_until = time.monotonic() + delay
+        self._event(f"worker {h.spec.idx} died rc={rc}; restart "
+                    f"{h.crash_restarts}/{self.cfg.restart_budget} in "
+                    f"{delay:.2f}s")
+
+    def _check_ready(self, h: WorkerHandle) -> None:
+        router = self.router
+        if h.proc is not None and h.proc.poll() is not None:
+            # died during startup — counts as a crash
+            h.state = RUNNING   # route through the common exit path
+            self._on_exit(h, h.proc.returncode)
+            return
+        doc = self._read_ready(h.spec.ready_file)
+        if doc is not None and doc.get("gen") == h.gen:
+            try:
+                info = router.attach_replica(
+                    h.spec.idx, int(doc["port"]),
+                    pid=int(doc["pid"]), gen=h.gen)
+                router.replicas[h.spec.idx].restarts = h.restarts
+            except Exception as e:  # noqa: BLE001 — a worker dying
+                # between ready-file write and attach is a crash like
+                # any other; fold it into the exit path next tick
+                self._event(f"worker {h.spec.idx} attach failed: {e}")
+                self._signal(h, signal.SIGKILL)
+                return
+            h.state = RUNNING
+            self._event(f"worker {h.spec.idx} ready+attached "
+                        f"(gen {h.gen}, kept {info['kept']}, "
+                        f"requeued {info['requeued']}, "
+                        f"ghosts {info['ghosts']})")
+            return
+        if (time.monotonic() - h.spawn_t
+                > self.cfg.ready_timeout_s):
+            self._event(f"worker {h.spec.idx} missed ready deadline; "
+                        f"killing")
+            self._signal(h, signal.SIGKILL)
+
+    @staticmethod
+    def _read_ready(path: str) -> Optional[dict]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _maybe_probe(self, h: WorkerHandle) -> None:
+        if (self.cfg.probe_every <= 0
+                or self.ticks % self.cfg.probe_every
+                or h.hang_ticks > 0):   # a chaos-hung worker is
+            return                      # *supposed* to be unresponsive
+        rep = self.router.replicas[h.spec.idx]
+        try:
+            rep.client.call("health",
+                            timeout_s=self.cfg.probe_timeout_s)
+            h.probe_failures = 0
+        except Exception:  # noqa: BLE001 — timeout, refusal, garbage:
+            # the probe only counts failures, the escalation decides
+            h.probe_failures += 1
+            if h.probe_failures >= 2:
+                self._event(f"worker {h.spec.idx} failed "
+                            f"{h.probe_failures} health probes; "
+                            f"escalating SIGKILL")
+                self._signal(h, signal.SIGKILL)
+
+    # ------------------------------------------------------------- chaos
+
+    def chaos_kill(self, idx: int) -> None:
+        """``proc_kill``: a real SIGKILL — no cleanup, no flushes."""
+        h = self.handles[idx]
+        self._event(f"CHAOS proc_kill worker {idx} (pid {h.pid})")
+        self._signal(h, signal.SIGKILL)
+
+    def chaos_hang(self, idx: int, ticks: int) -> None:
+        """``proc_hang``: SIGSTOP now, SIGCONT after ``ticks`` ticks."""
+        h = self.handles[idx]
+        self._event(f"CHAOS proc_hang worker {idx} for {ticks} ticks")
+        h.hang_ticks = max(int(ticks), 1)
+        self._signal(h, signal.SIGSTOP)
+
+    # --------------------------------------------------- rolling restart
+
+    @property
+    def rolling_active(self) -> bool:
+        return bool(self._rolling)
+
+    def start_rolling_restart(self) -> None:
+        """Queue a drain -> stop -> respawn -> reattach cycle over every
+        worker, one at a time (ticked forward by :meth:`tick`)."""
+        if self._rolling:
+            return
+        self._rolling = [h.spec.idx for h in self.handles
+                         if h.state != QUARANTINED]
+        self._rolling_phase = "drain"
+        self._event(f"rolling restart of workers {self._rolling}")
+
+    def _tick_rolling(self) -> None:
+        if not self._rolling:
+            return
+        router = self.router
+        idx = self._rolling[0]
+        h = self.handles[idx]
+        if self._rolling_phase == "drain":
+            router.drain_replica(idx)
+            h.intentional_stop = True
+            #: advance only once THIS generation is gone and the NEXT
+            #: one is attached — "running and alive" is already true in
+            #: the instant after the shutdown RPC (the worker takes a
+            #: moment to exit), and advancing on it would drain the
+            #: whole fleet at once
+            self._rolling_target_gen = h.gen + 1
+            rep = router.replicas[idx]
+            try:
+                rep.client.call("drain", timeout_s=2.0)
+                rep.client.call("shutdown", timeout_s=2.0)
+            except Exception:  # noqa: BLE001 — graceful path failed;
+                # SIGTERM says the same thing louder
+                self._signal(h, signal.SIGTERM)
+            self._rolling_phase = "await_restart"
+        elif self._rolling_phase == "await_restart":
+            if (h.gen >= self._rolling_target_gen
+                    and h.state == RUNNING
+                    and router.replicas[idx].alive):
+                self._rolling.pop(0)
+                self._rolling_phase = "drain"
+                if not self._rolling:
+                    self._event("rolling restart complete")
+            elif h.state == QUARANTINED:
+                # it crashed its way out of the budget mid-restart —
+                # abandon the rolling pass for this worker
+                self._rolling.pop(0)
+                self._rolling_phase = "drain"
+
+
+# -------------------------------------------------------------- builders
+
+def make_worker_specs(n_workers: int, journal_dir: str,
+                      config_args: List[str],
+                      engine_args: Optional[List[str]] = None,
+                      env: Optional[dict] = None) -> List[WorkerSpec]:
+    """Specs for N ``serve-worker`` subprocesses sharing one journal
+    directory (worker{i}.jsonl + worker{i}.ready.json + worker{i}.log).
+    ``config_args`` select the model (e.g. ``["--preset",
+    "test-tiny"]``); ``engine_args`` are pool/page knobs."""
+    os.makedirs(journal_dir, exist_ok=True)
+    # the workers must import THIS package regardless of the caller's
+    # cwd (`python -m` resolves against the child's sys.path, and the
+    # repo is not necessarily pip-installed)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(env or {})
+    env.setdefault("PYTHONPATH", os.pathsep.join(
+        p for p in (pkg_root, os.environ.get("PYTHONPATH")) if p))
+    specs = []
+    for i in range(n_workers):
+        jpath = os.path.join(journal_dir, f"worker{i}.jsonl")
+        ready = os.path.join(journal_dir, f"worker{i}.ready.json")
+        log = os.path.join(journal_dir, f"worker{i}.log")
+        cmd = [sys.executable, "-m", "replicatinggpt_tpu",
+               "serve-worker", *config_args,
+               "--port", "0", "--journal", jpath,
+               "--ready-file", ready, *(engine_args or [])]
+        specs.append(WorkerSpec(idx=i, cmd=cmd, journal_path=jpath,
+                                ready_file=ready, log_path=log,
+                                env=env))
+    return specs
+
+
+def spawn_fleet(specs: List[WorkerSpec], rcfg=None, scfg=None,
+                telemetry=None, clock=time.monotonic,
+                wait: bool = True):
+    """Launch the out-of-process fleet: one supervisor over ``specs``,
+    one Router over :class:`~..serve.router.RemoteReplica` backends,
+    wired together (``router.supervisor`` set, chaos delegated).
+    Returns ``(router, supervisor)``; callers own shutdown
+    (``supervisor.stop_all()`` then ``router.close()``)."""
+    from ..serve.router import RemoteReplica, Router, RouterConfig
+    rcfg = rcfg or RouterConfig(n_replicas=len(specs))
+    scfg = scfg or SupervisorConfig()
+    backends = [RemoteReplica(s.idx, s.journal_path,
+                              rpc_timeout_s=rcfg.step_timeout_s,
+                              step_timeout_s=rcfg.step_timeout_s)
+                for s in specs]
+    router = Router(rcfg=rcfg, backends=backends, telemetry=telemetry,
+                    clock=clock)
+    sup = ProcSupervisor(specs, scfg)
+    sup.attach_router(router)
+    sup.start_all(wait=wait)
+    return router, sup

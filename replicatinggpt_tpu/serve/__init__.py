@@ -20,10 +20,20 @@
   engine (docs/robustness.md);
 - ``replay``: synthetic Poisson trace driver (`serve-replay` CLI,
   `bench.py --mode serve`);
-- ``router``: the fleet tier — N in-process engine replicas behind one
+- ``router``: the fleet tier — N engine replicas behind one
   submit/cancel/step API with radix-prefix affinity routing, health
   probes, crash-journal requeue across replica death, and hedged
-  re-route off wedged replicas (docs/serving.md);
+  re-route off wedged replicas (docs/serving.md). Replicas are either
+  in-process engines (``Replica``) or worker PROCESSES
+  (``RemoteReplica`` over the ``rpc`` protocol);
+- ``rpc``: length-prefixed JSON RPC over loopback sockets — the wire
+  between the router and worker processes (submit/step/stream-drain/
+  cancel/drain/health verbs, ack-based finish redelivery);
+- ``worker``: the worker process (`serve-worker` CLI) — one engine +
+  an exclusively-locked crash journal, replayed at startup so a
+  ``kill -9`` mid-decode costs nothing the journal + the router's
+  delivery ledger cannot reconstruct (faults/procsup.py supervises
+  restarts);
 - ``loadgen``: multi-turn session load generator + fleet replay driver
   (`bench.py --mode fleet`, the fleet chaos soak);
 - ``http``: the asyncio HTTP/SSE front door (`serve` CLI) —
@@ -37,25 +47,28 @@ fleet-level faults (replica kill/wedge, hot-key skew) live behind
 
 from .cache_pool import CachePool
 from .engine import Engine, EngineConfig, compile_counts
-from .journal import RequestJournal
+from .journal import JournalBusyError, RequestJournal
 from .loadgen import (SessionLoadConfig, StepClock, make_sessions,
                       run_fleet_replay, session_request)
 from .pages import PageAllocator, PagedCachePool, RadixIndex
 from .replay import ReplayConfig, format_summary, make_trace, run_replay
 from .requests import Request, RequestResult, SamplingParams
-from .router import (REJECT_FLEET_CAPACITY, Replica, Router,
-                     RouterConfig)
+from .router import (REJECT_FLEET_CAPACITY, RemoteReplica, Replica,
+                     ReplicaBase, Router, RouterConfig)
+from .rpc import REJECT_REPLICA_DOWN, RpcClient, RpcDown, RpcTimeout
 from .scheduler import Scheduler
 from .speculative import (Drafter, ModelDrafter, NGramDrafter,
                           draft_config_from_preset, make_drafter)
 
 __all__ = ["CachePool", "Engine", "EngineConfig", "compile_counts",
            "PageAllocator", "PagedCachePool", "RadixIndex",
-           "RequestJournal",
+           "JournalBusyError", "RequestJournal",
            "ReplayConfig", "format_summary", "make_trace", "run_replay",
            "Request", "RequestResult", "SamplingParams", "Scheduler",
            "Drafter", "ModelDrafter", "NGramDrafter",
            "draft_config_from_preset", "make_drafter",
-           "REJECT_FLEET_CAPACITY", "Replica", "Router", "RouterConfig",
+           "REJECT_FLEET_CAPACITY", "REJECT_REPLICA_DOWN",
+           "RemoteReplica", "Replica", "ReplicaBase", "Router",
+           "RouterConfig", "RpcClient", "RpcDown", "RpcTimeout",
            "SessionLoadConfig", "StepClock", "make_sessions",
            "run_fleet_replay", "session_request"]

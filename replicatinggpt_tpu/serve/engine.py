@@ -185,6 +185,25 @@ def _engine_page_copy(cache, src, dst):
     return out
 
 
+def engine_summary_block(engine: "Engine") -> dict:
+    """The per-replica block of the fleet summary — ONE definition
+    consumed by both sides of the process boundary (the in-process
+    ``router.Replica.summary_block`` and the worker's ``summary`` RPC),
+    so the multiproc bench artifact can never silently diverge in
+    shape from the in-process one."""
+    s = engine.metrics_summary()
+    return {
+        "occupancy_mean": round(
+            s["histograms"].get("batch_fill_ratio", {})
+            .get("mean", 0.0), 4),
+        "n_steps": engine.n_steps,
+        "pages": s["pages"],
+        "finished": {k: int(v) for k, v in
+                     engine.metrics.counters.items()
+                     if k.startswith("finished_")},
+    }
+
+
 def compile_counts() -> Dict[str, int]:
     """Process-wide compiled-program counts for the engine entry points
     (module-level jits, so they accumulate across engines), including

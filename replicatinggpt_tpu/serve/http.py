@@ -27,8 +27,14 @@ Endpoints (all JSON unless noted):
 - ``POST /v1/cancel/{id}`` — ``{"cancelled": bool}``.
 - ``GET /v1/result/{id}`` — non-streaming terminal result (202 while
   running; popping it frees the id).
-- ``GET /healthz`` — router health (200, or 503 with no routable
-  replica): per-replica alive/wedged/queue/slots/pages.
+- ``GET /healthz`` — **liveness**: 200 whenever the server process is
+  up and answering (per-replica detail rides along). A process that
+  cannot answer this is dead; restart it.
+- ``GET /readyz`` — **readiness**: 200 iff ≥ 1 routable *warmed*
+  replica can take traffic, else 503 — including during a rolling
+  restart's last-survivor drain window. External supervisors gate
+  traffic on THIS, not on /healthz (a live router with zero ready
+  workers must be drained from the load balancer, not restarted).
 - ``GET /metrics`` — Prometheus text exposition of the router metrics
   (fleet counters + per-replica gauges; utils.telemetry).
 
@@ -36,7 +42,16 @@ The server is single-threaded asyncio on purpose: the engine/router
 host API is single-threaded by design, and one driver task calling
 ``router.step()`` between socket reads is exactly the replay loop with
 sockets for arrivals. A step blocks the loop for one dispatch — the
-same latency floor every request already pays.
+same latency floor every request already pays. In multi-process mode
+the same driver task also ticks the process supervisor
+(faults/procsup.py) after every step, so worker restarts progress
+even while the fleet is idle.
+
+Untrusted-peer hygiene: a client that opens a connection and never
+completes its headers (slow-loris), stalls mid-body, or stops
+consuming its SSE stream is dropped after ``idle_timeout_s`` — a
+handler task and its buffers are capacity, and a peer that is not
+making progress does not get to pin them forever.
 """
 
 from __future__ import annotations
@@ -52,7 +67,9 @@ from ..utils.telemetry import prometheus_text
 from .requests import (FINISH_DEADLINE, REJECT_BAD_REQUEST,
                        REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL, Request,
                        SamplingParams)
-from .router import REJECT_FLEET_CAPACITY, Router
+from .router import (REJECT_FLEET_CAPACITY, REJECT_REPLICA_TIMEOUT,
+                     Router)
+from .rpc import REJECT_REPLICA_DOWN
 
 #: rejection reason -> HTTP status for the submit path
 REASON_STATUS = {
@@ -61,11 +78,16 @@ REASON_STATUS = {
     REJECT_BAD_REQUEST: 400,
     REJECT_PROMPT_TOO_LONG: 413,
     FINISH_DEADLINE: 504,
+    # every candidate replica unreachable/hung at submit time: a
+    # try-later server condition, not a client error
+    REJECT_REPLICA_DOWN: 503,
+    REJECT_REPLICA_TIMEOUT: 503,
 }
 
 _STATUS_TEXT = {200: "OK", 202: "Accepted", 400: "Bad Request",
                 404: "Not Found", 405: "Method Not Allowed",
-                413: "Payload Too Large", 429: "Too Many Requests",
+                408: "Request Timeout", 413: "Payload Too Large",
+                429: "Too Many Requests",
                 500: "Internal Server Error", 503: "Service Unavailable",
                 504: "Gateway Timeout"}
 
@@ -116,14 +138,22 @@ class ServeApp:
     ``step_wait_s`` bounds how long an SSE handler waits for the next
     step wakeup before re-checking terminal state (a safety net around
     missed wakeups, not a poll interval); ``idle_sleep_s`` is the
-    driver's sleep when the fleet is idle.
+    driver's sleep when the fleet is idle. ``idle_timeout_s`` is the
+    slow-loris budget: a peer that stalls mid-headers, mid-body, or
+    mid-SSE-consumption is dropped after it (0 disables).
+    ``supervisor`` (faults.procsup.ProcSupervisor) is ticked by the
+    driver after every step — multi-process fleets only.
     """
 
     def __init__(self, router: Router, idle_sleep_s: float = 0.002,
-                 step_wait_s: float = 0.5):
+                 step_wait_s: float = 0.5,
+                 idle_timeout_s: float = 30.0, supervisor=None):
         self.router = router
         self.idle_sleep_s = idle_sleep_s
         self.step_wait_s = step_wait_s
+        self.idle_timeout_s = idle_timeout_s
+        self.supervisor = supervisor
+        self._vocab: Optional[int] = None
         self._ids = itertools.count()
         self._running = False
         self._step_fut: Optional[asyncio.Future] = None
@@ -143,9 +173,14 @@ class ServeApp:
         self._step_fut = loop.create_future()
         while self._running:
             if self.router.idle:
+                # restarts/backoffs must progress while the fleet waits
+                if self.supervisor is not None:
+                    self.supervisor.tick()
                 await asyncio.sleep(self.idle_sleep_s)
                 continue
             self.router.step()
+            if self.supervisor is not None:
+                self.supervisor.tick()
             for rid in [r for r in self._abandoned
                         if not self.router.knows(r)
                         or self.router.result(r) is not None]:
@@ -232,23 +267,43 @@ class ServeApp:
 
     # ----------------------------------------------------------- handlers
 
+    async def _read_request(self, reader: asyncio.StreamReader):
+        """Read one request (start line + headers + body); None on an
+        unparseable start line. Raises ValueError on malformed framing,
+        IncompleteReadError/ConnectionError on a vanished peer."""
+        line = await reader.readline()
+        parts = line.decode("latin-1").split()
+        if len(parts) < 2:
+            return None
+        method, path = parts[0].upper(), parts[1]
+        headers = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode("latin-1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length", "0") or 0)
+        body = b""
+        if n:
+            body = await reader.readexactly(n)
+        return method, path, body
+
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         try:
             try:
-                line = await reader.readline()
-                parts = line.decode("latin-1").split()
-                if len(parts) < 2:
-                    return
-                method, path = parts[0].upper(), parts[1]
-                headers = {}
-                while True:
-                    h = await reader.readline()
-                    if h in (b"\r\n", b"\n", b""):
-                        break
-                    k, _, v = h.decode("latin-1").partition(":")
-                    headers[k.strip().lower()] = v.strip()
-                n = int(headers.get("content-length", "0") or 0)
+                # the slow-loris budget: a peer must DELIVER a complete
+                # request within idle_timeout_s or lose the connection —
+                # half-sent headers / a stalled body must not pin this
+                # handler task forever
+                req = await asyncio.wait_for(
+                    self._read_request(reader),
+                    self.idle_timeout_s or None)
+            except asyncio.TimeoutError:
+                await self._json(writer, 408,
+                                 {"error": "request idle timeout"})
+                return
             except ValueError:
                 # a request/header line over the StreamReader limit
                 # (readline raises ValueError) or a non-numeric
@@ -256,9 +311,9 @@ class ServeApp:
                 await self._json(writer, 400,
                                  {"error": "malformed request"})
                 return
-            body = b""
-            if n:
-                body = await reader.readexactly(n)
+            if req is None:
+                return
+            method, path, body = req
             await self._dispatch(method, path.split("?", 1)[0], body,
                                  writer)
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -273,8 +328,11 @@ class ServeApp:
     async def _dispatch(self, method: str, path: str, body: bytes,
                         writer: asyncio.StreamWriter) -> None:
         if path == "/healthz" and method == "GET":
-            h = self.router.healthz()
-            await self._json(writer, 200 if h["ok"] else 503, h)
+            # liveness: answering at all IS the signal — always 200
+            await self._json(writer, 200, self.router.healthz())
+        elif path == "/readyz" and method == "GET":
+            r = self.router.readyz()
+            await self._json(writer, 200 if r["ok"] else 503, r)
         elif path in ("/metrics", "/v1/metrics") and method == "GET":
             text = prometheus_text(self.router.metrics,
                                    prefix="tpu_gpt_fleet")
@@ -321,6 +379,29 @@ class ServeApp:
                              else 405, {"error": f"no route {method} "
                                                  f"{path}"})
 
+    def _vocab_size(self) -> int:
+        """Token-id bound for ingress validation. Local replicas carry
+        an engine; remote workers report it over the health RPC once
+        (cached — 0, skipping the check, only if no worker has ever
+        been reachable)."""
+        if self._vocab:
+            return self._vocab
+        for rep in self.router.replicas:
+            if rep.is_local:
+                self._vocab = int(rep.engine.cfg.vocab_size)
+                return self._vocab
+            try:
+                # short budget: this runs inside a submit handler on
+                # the single-threaded loop — a hung worker must not
+                # stall every connection for the full RPC timeout
+                self._vocab = int(rep.refresh_health(timeout_s=1.0)
+                                  .get("vocab_size", 0))
+                if self._vocab:
+                    return self._vocab
+            except Exception:  # noqa: BLE001 — unreachable worker;
+                continue       # try the next, or skip the check
+        return 0
+
     def _submit(self, body: bytes):
         """Parse + route one submit; returns (id, None) or
         (None, (status, message))."""
@@ -332,7 +413,7 @@ class ServeApp:
             return None, (400, "body must be a JSON object")
         req, perr = request_from_json(
             doc, f"h{next(self._ids):06d}", self.router.clock,
-            vocab=self.router.replicas[0].engine.cfg.vocab_size)
+            vocab=self._vocab_size())
         if req is None:
             return None, (400, perr)
         rej = self.router.submit(req)
@@ -351,6 +432,18 @@ class ServeApp:
             i += 1
         return i
 
+    async def _drain_sse(self, writer: asyncio.StreamWriter) -> None:
+        """drain() with the idle budget: an SSE consumer that stopped
+        reading (buffer past the high-water mark, drain suspended
+        forever) is indistinguishable from a vanished one — treat it
+        as one instead of pinning the handler and the send buffer."""
+        try:
+            await asyncio.wait_for(writer.drain(),
+                                   self.idle_timeout_s or None)
+        except asyncio.TimeoutError:
+            raise ConnectionError("SSE consumer stalled past the idle "
+                                  "budget") from None
+
     async def _stream(self, rid: str,
                       writer: asyncio.StreamWriter) -> None:
         """SSE token stream through the router's exactly-once delivery
@@ -362,10 +455,10 @@ class ServeApp:
                      b"Connection: close\r\n\r\n")
         i = 0
         try:
-            await writer.drain()
+            await self._drain_sse(writer)
             while True:
                 i = self._emit_new_tokens(rid, writer, i)
-                await writer.drain()
+                await self._drain_sse(writer)
                 res = self.router.result(rid)
                 if res is not None:
                     # final ledger drain: the request may have finished
@@ -378,13 +471,13 @@ class ServeApp:
                             "total_s": round(res.total_s, 6)}
                     writer.write(f"event: done\ndata: "
                                  f"{json.dumps(done)}\n\n".encode())
-                    await writer.drain()
+                    await self._drain_sse(writer)
                     self.router.pop_result(rid)
                     return
                 if not self.router.knows(rid):
                     writer.write(b"event: error\ndata: "
                                  b"{\"error\": \"request lost\"}\n\n")
-                    await writer.drain()
+                    await self._drain_sse(writer)
                     return
                 await self._next_step()
         except (ConnectionError, OSError):

@@ -15,7 +15,29 @@ explicitly shed), across restarts.
 Records are flushed per write: a journal that lags the engine would
 silently drop the most recent admissions, which is exactly the window a
 crash hits. One fsync-free flush per request (not per token) is host
-noise next to a model forward.
+noise next to a model forward. Two multi-process knobs harden this for
+journals on shared storage (the fleet's worker processes,
+serve/worker.py):
+
+- ``fsync_finish=True`` fsyncs after every ``finish`` record — a
+  finish that only reached the page cache when the machine (not just
+  the process) died would make the restarted worker re-decode and
+  re-deliver a request the client already saw complete. Submits stay
+  flush-only: losing a submit record loses at most an un-started
+  request the router will retry, never a duplicate delivery.
+- ``lock=True`` takes an exclusive ``flock`` on the journal file at
+  open, so two processes can never append to the same journal (a
+  supervisor racing a not-quite-dead worker, a misconfigured second
+  worker on one journal path). The kernel drops the lock when the
+  holder dies — including ``kill -9`` — so a restarted worker never
+  waits on its own corpse. A held lock raises
+  :class:`JournalBusyError` instead of blocking.
+
+The reader contract is unchanged by both: readers never lock (they
+tolerate a concurrent appender), and the torn final line a crash can
+leave is skipped by the shared ``utils.jsonl`` reader — fsync narrows
+the torn-tail window, it does not remove the reader's obligation to
+tolerate one.
 
 Deadlines are *not* recovered: they are absolute timestamps on the dead
 engine's monotonic clock, meaningless after restart. A recovered
@@ -35,18 +57,37 @@ from ..utils.jsonl import load_jsonl_if_exists
 from .requests import Request, SamplingParams
 
 
+class JournalBusyError(RuntimeError):
+    """Another live process holds this journal's exclusive write lock."""
+
+
 class RequestJournal:
     """Append-only submit/finish journal (one writer — the engine)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, fsync_finish: bool = False,
+                 lock: bool = False):
         self.path = os.path.abspath(path)
+        self.fsync_finish = fsync_finish
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._f: Optional[TextIO] = open(self.path, "a")
+        if lock:
+            import fcntl
+            try:
+                fcntl.flock(self._f.fileno(),
+                            fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError as e:
+                self._f.close()
+                self._f = None
+                raise JournalBusyError(
+                    f"journal {self.path} is locked by another live "
+                    f"process") from e
 
-    def _write(self, obj: dict) -> None:
+    def _write(self, obj: dict, fsync: bool = False) -> None:
         assert self._f is not None, "journal is closed"
         self._f.write(json.dumps(obj) + "\n")
         self._f.flush()
+        if fsync:
+            os.fsync(self._f.fileno())
 
     def record_submit(self, req: Request) -> None:
         sp = req.sampling
@@ -60,7 +101,8 @@ class RequestJournal:
         })
 
     def record_finish(self, request_id: str, reason: str) -> None:
-        self._write({"ev": "finish", "id": request_id, "reason": reason})
+        self._write({"ev": "finish", "id": request_id, "reason": reason},
+                    fsync=self.fsync_finish)
 
     def close(self) -> None:
         if self._f is not None:

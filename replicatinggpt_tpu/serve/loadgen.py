@@ -151,7 +151,9 @@ def run_fleet_replay(params, mcfg: ModelConfig,
                      metrics_timeline: Optional[str] = None,
                      metrics_timeline_interval_s: float = 0.5,
                      metrics_out: Optional[str] = None,
-                     max_steps: int = 1_000_000) -> dict:
+                     max_steps: int = 1_000_000,
+                     router: Optional[Router] = None,
+                     supervisor=None) -> dict:
     """Drive the session workload through a router fleet; returns the
     fleet summary (per-replica occupancy + pages, requeue counters,
     fleet TTFT distribution, aggregate prefix-hit rate,
@@ -166,18 +168,38 @@ def run_fleet_replay(params, mcfg: ModelConfig,
     ``metrics_timeline`` JSONL series of the ROUTER's metrics,
     ``metrics_out`` Prometheus text with per-replica gauges) mirror
     serve/replay.py's contract; paths land in ``summary["artifacts"]``.
-    """
-    if warmup:
-        w = Engine(params, mcfg, ecfg)
-        w.submit(Request(id="warmup", prompt=np.zeros((1,), np.int32),
-                         max_new_tokens=1,
-                         sampling=SamplingParams(greedy=True)))
-        w.drain()
+
+    Pass ``router`` (and its ``supervisor``) to replay through an
+    ALREADY-BUILT fleet instead of constructing one — the
+    multi-process path (``faults.procsup.spawn_fleet``): ``params`` /
+    ``ecfg`` / ``warmup`` / ``virtual_dt`` are ignored (each worker
+    owns its model and warms itself; remote replays run in wall-clock
+    time), the supervisor is ticked after every router step and while
+    idle (worker restarts must progress while the fleet waits), and
+    the CALLER keeps ownership of shutdown (``supervisor.stop_all()``
+    then ``router.close()``). For a trace, attach a ``Telemetry`` at
+    ``spawn_fleet`` time — ``trace_out`` exports the router's own
+    recorder."""
+    own_router = router is None
+    if own_router:
+        if warmup:
+            w = Engine(params, mcfg, ecfg)
+            w.submit(Request(id="warmup",
+                             prompt=np.zeros((1,), np.int32),
+                             max_new_tokens=1,
+                             sampling=SamplingParams(greedy=True)))
+            w.drain()
     warm = compile_counts()
 
-    clock = StepClock() if virtual_dt > 0 else time.monotonic
-    tel = Telemetry(clock=clock) if trace_out else None
-    router = Router(params, mcfg, rcfg, ecfg, clock=clock, telemetry=tel)
+    if own_router:
+        clock = StepClock() if virtual_dt > 0 else time.monotonic
+        tel = Telemetry(clock=clock) if trace_out else None
+        router = Router(params, mcfg, rcfg, ecfg, clock=clock,
+                        telemetry=tel)
+    else:
+        virtual_dt = 0.0
+        clock = router.clock
+        tel = router.tel if (trace_out and router.tel.enabled) else None
     timeline = None
     if metrics_timeline:
         timeline = MetricsTimeline(router.metrics, metrics_timeline,
@@ -219,6 +241,8 @@ def run_fleet_replay(params, mcfg: ModelConfig,
             if router.idle:
                 if not pending_turns:
                     break
+                if supervisor is not None:
+                    supervisor.tick()
                 # nothing in flight: run the clock to the next arrival
                 if virtual_dt > 0:
                     clock.advance(virtual_dt)
@@ -233,6 +257,8 @@ def run_fleet_replay(params, mcfg: ModelConfig,
                     time.sleep(min(max(nxt - (now - t0), 0.0), 0.05))
                 continue
             finished = router.step()
+            if supervisor is not None:
+                supervisor.tick()
             # deliver: the ONE consumption path (exactly-once ledger)
             inflight_ids = [rid for rid in inflight_ids
                             if rid not in results]
@@ -271,10 +297,12 @@ def run_fleet_replay(params, mcfg: ModelConfig,
     finally:
         if tel is not None:
             n_trace_events = tel.export_chrome_trace(trace_out)
-            tel.close()
+            if own_router:
+                tel.close()
         if timeline is not None:
             timeline.close(step=router.n_steps)
-        router.close()
+        if own_router:
+            router.close()
     wall_s = clock() - t0
 
     done = compile_counts()

@@ -1,4 +1,4 @@
-"""Multi-replica router: the fleet tier over N in-process engines.
+"""Multi-replica router: the fleet tier, in-process or across processes.
 
 One engine is a chip; "millions of users" is a fleet. This module
 load-balances requests across N engine replicas and keeps the fleet's
@@ -35,6 +35,32 @@ promises when replicas misbehave:
   in-flight-id invariant, extended fleet-wide by the router's own
   dedupe at :meth:`submit`).
 
+Two replica backends implement one interface (:class:`ReplicaBase`):
+
+- :class:`Replica` — the in-process engine of PR 8 (one interpreter,
+  simulated faults);
+- :class:`RemoteReplica` — a **worker process** (serve/worker.py)
+  reached over the serve/rpc.py socket protocol. The router drives it
+  with the same verbs (submit/step/cancel), reads its committed-token
+  streams out of the step response (the stream-drain piggyback), and
+  treats transport failures honestly: an RPC *timeout* is a slow step
+  the wedge probe sees (SIGSTOP, wedged device), a *refused/reset
+  connection* marks the replica down for the process supervisor
+  (faults/procsup.py) to restart. A restarted worker replays its own
+  journal; :meth:`Router.attach_replica` then reconciles the router's
+  in-flight ledger against what the worker actually recovered —
+  surviving requests continue (the delivery ledger suppresses the
+  regenerated prefix, so streams stay exactly-once through a real
+  ``kill -9``), journaled-finished-but-undelivered ones surface their
+  journaled reason, and ghost entries the worker replayed but nobody
+  owns are cancelled before they waste a decode.
+
+Rolling restarts ride the same machinery: :meth:`Router.drain_replica`
+marks a replica draining (unroutable, ``/readyz`` excluded), migrates
+its in-flight work onto the rest of the fleet, and the supervisor
+restarts the emptied worker — repeated replica by replica, the fleet
+never drops a request.
+
 Single-threaded by design, like the engine: one loop drives
 :meth:`Router.step`. The HTTP front door (serve/http.py) and the fleet
 replay driver (serve/loadgen.py) are both such loops.
@@ -45,20 +71,21 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
-from ..config import ModelConfig
-from ..faults.fleet import (KIND_REPLICA_KILL, KIND_REPLICA_WEDGE,
+from ..faults.fleet import (KIND_PROC_HANG, KIND_PROC_KILL,
+                            KIND_REPLICA_KILL, KIND_REPLICA_WEDGE,
                             fleet_step_fault)
 from ..utils.jsonl import load_jsonl_if_exists
 from ..utils.logging import Metrics
-from ..utils.telemetry import (NULL, REPLICA_TRACK_STRIDE, ROUTER_TRACK,
-                               ROUTER_TRACK_NAME)
-from .engine import Engine, EngineConfig
+from ..utils.telemetry import (ENGINE_TRACK, NULL, REPLICA_TRACK_STRIDE,
+                               ROUTER_TRACK, ROUTER_TRACK_NAME)
 from .journal import RequestJournal
 from .requests import (FINISH_CANCELLED, FINISH_DEADLINE,
                        REJECT_BAD_REQUEST, REJECT_PROMPT_TOO_LONG,
                        REJECT_QUEUE_FULL, Request, RequestResult)
+from .rpc import (REJECT_REPLICA_DOWN, RpcClient, RpcDown, RpcError,
+                  RpcTimeout, request_to_wire, result_from_wire)
 
 #: finish_reason when bounded retry exhausts without a replica
 #: accepting the requeued request
@@ -71,6 +98,25 @@ REJECT_FLEET_CAPACITY = "rejected_fleet_capacity"
 TERMINAL_REJECTS = frozenset({REJECT_BAD_REQUEST,
                               REJECT_PROMPT_TOO_LONG, FINISH_DEADLINE})
 
+#: a submit RPC that TIMED OUT: unlike a refused connection, the hung
+#: worker may still execute the buffered submit when it resumes
+#: (SIGSTOP). Routing falls through to the next candidate; the
+#: maybe-executed copy's eventual finish is swallowed by the
+#: replica-aware stale guard in Router._on_finish (ledger entry points
+#: at the replica that actually owns the id)
+REJECT_REPLICA_TIMEOUT = "rejected_replica_timeout"
+
+#: backpressure-shaped rejections the retry ladder maps to
+#: REJECT_FLEET_CAPACITY on exhaustion (try-later verdicts)
+RETRYABLE_REJECTS = frozenset({REJECT_QUEUE_FULL, REJECT_REPLICA_DOWN,
+                               REJECT_REPLICA_TIMEOUT})
+
+
+class ReplicaDownError(RuntimeError):
+    """A remote replica's transport is gone (refused/reset) — the
+    process died or is restarting. The router marks it down and the
+    supervisor owns recovery."""
+
 
 @dataclass(frozen=True)
 class RouterConfig:
@@ -78,7 +124,10 @@ class RouterConfig:
 
     n_replicas: int = 2
     #: per-replica crash journals live here (replica{i}.jsonl); None
-    #: disables journals — and with them cross-replica requeue
+    #: disables journals — and with them cross-replica requeue. In
+    #: multi-process mode this is the SHARED journal directory: each
+    #: worker writes worker{i}.jsonl (exclusively locked), the router
+    #: reads them for requeue/reconciliation.
     journal_dir: Optional[str] = None
     #: route by longest cached prefix (False: pure least-loaded)
     affinity: bool = True
@@ -96,6 +145,10 @@ class RouterConfig:
     wedge_skip_steps: int = 3
     #: router steps a wedged replica sits out before rejoining rotation
     quarantine_steps: int = 8
+    #: RPC budget for one remote step (multi-process mode): past it the
+    #: call abandons and the elapsed time feeds the wedge probe. A hung
+    #: (SIGSTOPped) worker costs the router this much per step, bounded.
+    step_timeout_s: float = 10.0
 
 
 @dataclass
@@ -116,49 +169,392 @@ class _Requeue:
     t_submit: float
     attempts: int
     due_step: int
+    t_requeued: float = 0.0    # when it left its replica (requeue
+    #                            latency = resubmit accept - this)
 
 
-@dataclass
-class Replica:
-    """One engine + its crash journal + router-side health state."""
+class ReplicaBase:
+    """The router-side replica contract: health state every backend
+    shares, plus the host-API verbs the router drives. ``Replica``
+    (in-process engine) and ``RemoteReplica`` (worker process over
+    serve/rpc.py) both speak it — affinity routing, the wedge probe,
+    hedged re-route and the delivery ledger are backend-agnostic."""
 
-    idx: int
-    engine: Engine
-    journal_path: Optional[str]
-    journal: Optional[RequestJournal]
-    alive: bool = True
-    wedged: bool = False
-    suspect_streak: int = 0
-    skip_steps: int = 0
-    quarantine_until: int = 0
-    last_step_s: float = 0.0
-    steps: int = 0
+    is_local = True
+
+    def __init__(self, idx: int, journal_path: Optional[str]):
+        self.idx = idx
+        self.journal_path = journal_path
+        self.alive = True
+        self.wedged = False
+        self.draining = False
+        self.suspect_streak = 0
+        self.skip_steps = 0
+        self.quarantine_until = 0
+        self.last_step_s = 0.0
+        self.steps = 0
+
+    # ------------------------------------------------------ router state
 
     @property
     def routable(self) -> bool:
-        return self.alive and not self.wedged
+        return self.alive and not self.wedged and not self.draining
 
     @property
     def load(self) -> int:
-        e = self.engine
-        return e.scheduler.depth + int(e._active.sum())
+        return self.queue_depth + self.slots_active
+
+    @property
+    def warmed(self) -> bool:
+        return True
+
+    def _base_health(self) -> dict:
+        return {"replica": self.idx, "alive": self.alive,
+                "wedged": self.wedged, "draining": self.draining,
+                "last_step_ms": round(self.last_step_s * 1e3, 3)}
+
+    # ----------------------------------------------------- backend verbs
+
+    def submit(self, req: Request) -> Optional[RequestResult]:
+        raise NotImplementedError
+
+    def cancel(self, request_id: str, migrated: bool = False) -> bool:
+        raise NotImplementedError
+
+    def step_engine(self) -> List[RequestResult]:
+        raise NotImplementedError
+
+    def partial_tokens(self, request_id: str) -> Optional[List[int]]:
+        raise NotImplementedError
+
+    def cached_prefix_tokens(self, prompt) -> int:
+        raise NotImplementedError
+
+    @property
+    def queue_depth(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def slots_active(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def pages_in_use(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def engine_idle(self) -> bool:
+        raise NotImplementedError
+
+    def hit_tokens(self) -> Tuple[int, int]:
+        """(prefix_hit_tokens, prompt_tokens) for the fleet aggregate."""
+        raise NotImplementedError
+
+    def health(self) -> dict:
+        raise NotImplementedError
+
+    def summary_block(self) -> dict:
+        """The per-replica block of :meth:`Router.fleet_summary`."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class Replica(ReplicaBase):
+    """One in-process engine + its crash journal (the PR-8 backend)."""
+
+    is_local = True
+
+    def __init__(self, idx: int, engine, journal_path: Optional[str],
+                 journal: Optional[RequestJournal],
+                 skip_steps: int = 0):
+        super().__init__(idx, journal_path)
+        self.engine = engine
+        self.journal = journal
+        self.skip_steps = skip_steps
+
+    def submit(self, req: Request) -> Optional[RequestResult]:
+        return self.engine.submit(req)
+
+    def cancel(self, request_id: str, migrated: bool = False) -> bool:
+        return self.engine.cancel(request_id, migrated=migrated)
+
+    def step_engine(self) -> List[RequestResult]:
+        return self.engine.step()
+
+    def partial_tokens(self, request_id: str) -> Optional[List[int]]:
+        return self.engine.partial_tokens(request_id)
+
+    def cached_prefix_tokens(self, prompt) -> int:
+        return self.engine.pool.cached_prefix_tokens(prompt)
+
+    @property
+    def queue_depth(self) -> int:
+        return self.engine.scheduler.depth
+
+    @property
+    def slots_active(self) -> int:
+        return int(self.engine._active.sum())
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.engine.pool.alloc.pages_in_use
+
+    @property
+    def engine_idle(self) -> bool:
+        return self.engine.idle
+
+    def hit_tokens(self) -> Tuple[int, int]:
+        a = self.engine.pool.alloc
+        return a.prefix_hit_tokens, a.prompt_tokens
 
     def health(self) -> dict:
         """The per-replica health probe: router-side state + the
         engine's own telemetry counters/gauges (PR-7 Metrics)."""
         c = self.engine.metrics.counters
         return {
-            "replica": self.idx,
-            "alive": self.alive,
-            "wedged": self.wedged,
-            "queue_depth": self.engine.scheduler.depth,
-            "slots_active": int(self.engine._active.sum()),
-            "pages_in_use": self.engine.pool.alloc.pages_in_use,
+            **self._base_health(),
+            "queue_depth": self.queue_depth,
+            "slots_active": self.slots_active,
+            "pages_in_use": self.pages_in_use,
             "watchdog_stalls": int(c.get("watchdog_stalls", 0)),
             "shed_requests": int(c.get("shed_requests", 0)),
             "requests_admitted": int(c.get("requests_admitted", 0)),
-            "last_step_ms": round(self.last_step_s * 1e3, 3),
         }
+
+    def summary_block(self) -> dict:
+        from .engine import engine_summary_block
+        return {"health": self.health(),
+                **engine_summary_block(self.engine)}
+
+    def close(self) -> None:
+        if self.journal is not None:
+            self.journal.close()
+
+
+class RemoteReplica(ReplicaBase):
+    """A worker process behind serve/rpc.py. The router's view of it is
+    built from step responses (gauges, committed-token streams, finished
+    results) cached between calls — ``partial_tokens`` and ``health``
+    never block the routing loop on a sick worker.
+
+    Finished results are *redelivered* by the worker until acked (a
+    step response lost to a timeout or a router restart must not lose a
+    finish); ``step_engine`` dedupes redeliveries against the previous
+    response and acks on the next call, so the router sees each finish
+    exactly once. An id is dropped from the dedupe set when the router
+    resubmits it here — a finished-and-popped id is legal to reuse.
+    """
+
+    is_local = False
+
+    def __init__(self, idx: int, journal_path: Optional[str],
+                 host: str = "127.0.0.1", port: int = 0,
+                 rpc_timeout_s: float = 10.0,
+                 step_timeout_s: float = 10.0):
+        super().__init__(idx, journal_path)
+        self.host = host
+        self.client: Optional[RpcClient] = None
+        self.rpc_timeout_s = rpc_timeout_s
+        self.step_timeout_s = step_timeout_s
+        self.pid: Optional[int] = None
+        self.gen = -1
+        self.restarts = 0
+        self.rpc_timeouts = 0
+        self._warmed = False
+        self._idle = True
+        self._gauges = {"queue_depth": 0, "slots_active": 0,
+                        "pages_in_use": 0, "n_steps": 0,
+                        "prefix_hit_tokens": 0, "prompt_tokens": 0}
+        self._partials: Dict[str, List[int]] = {}
+        self._seen: set = set()        # finish ids delivered, unacked
+        self._acks: List[str] = []
+        if port:
+            self.connect(port)
+
+    # ------------------------------------------------------- connection
+
+    def connect(self, port: int, pid: Optional[int] = None,
+                gen: Optional[int] = None) -> None:
+        if self.client is not None:
+            self.client.close()
+        self.client = RpcClient(self.host, port,
+                                timeout_s=self.rpc_timeout_s)
+        if pid is not None:
+            self.pid = pid
+        if gen is not None:
+            self.gen = gen
+
+    def close(self) -> None:
+        if self.client is not None:
+            self.client.close()
+
+    def _call(self, op: str, timeout_s: Optional[float] = None,
+              **kw) -> dict:
+        if self.client is None:
+            raise ReplicaDownError(f"worker {self.idx}: never attached")
+        try:
+            return self.client.call(op, timeout_s=timeout_s, **kw)
+        except RpcTimeout:
+            raise
+        except (RpcDown, RpcError) as e:
+            # RpcError too: a worker whose dispatch raises is sick — the
+            # supervisor's restart path is the recovery for both
+            raise ReplicaDownError(f"worker {self.idx}: {e}") from e
+
+    # ----------------------------------------------------- backend verbs
+
+    def submit(self, req: Request) -> Optional[RequestResult]:
+        try:
+            resp = self._call("submit",
+                              req=request_to_wire(
+                                  req, time.monotonic()))
+        except RpcTimeout:
+            # the worker may still EXECUTE this submit when it resumes
+            # — submit has no ack/redeliver protocol like step, so the
+            # router must supersede this copy before re-routing the id
+            return RequestResult(id=req.id, tokens=[],
+                                 finish_reason=REJECT_REPLICA_TIMEOUT)
+        except ReplicaDownError:
+            return RequestResult(id=req.id, tokens=[],
+                                 finish_reason=REJECT_REPLICA_DOWN)
+        if resp.get("accepted"):
+            # the id may be a legal reuse of a finished-and-popped one:
+            # it must not be swallowed by the finish dedupe set
+            self._seen.discard(req.id)
+            return None
+        return result_from_wire(resp["rejection"])
+
+    def cancel(self, request_id: str, migrated: bool = False) -> bool:
+        try:
+            resp = self._call("cancel", id=request_id,
+                              migrated=migrated)
+        except (ReplicaDownError, RpcTimeout):
+            return False
+        return bool(resp.get("found"))
+
+    def step_engine(self) -> List[RequestResult]:
+        try:
+            resp = self._call("step", acks=self._acks,
+                              timeout_s=self.step_timeout_s)
+        except RpcTimeout:
+            # the worker may be hung (SIGSTOP) — the caller's wall-time
+            # measurement feeds the wedge probe; the call itself may
+            # still execute when the process resumes, which the
+            # ack/redeliver protocol makes safe
+            self.rpc_timeouts += 1
+            return []
+        self._acks = []
+        self._absorb(resp)
+        delivered = [result_from_wire(d)
+                     for d in resp.get("finished", [])]
+        fresh = [r for r in delivered if r.id not in self._seen]
+        # everything in this response stays buffered worker-side until
+        # the next call acks it; everything NOT in it was pruned by a
+        # previous ack and can leave the dedupe set
+        self._seen = {r.id for r in delivered}
+        self._acks = sorted(self._seen)
+        return fresh
+
+    def stream_drain(self) -> None:
+        """Refresh the committed-token cache without forcing a step
+        (reconnect reconciliation)."""
+        resp = self._call("stream_drain")
+        self._partials.update({rid: list(toks) for rid, toks
+                               in resp.get("partials", {}).items()})
+
+    def _absorb(self, resp: dict) -> None:
+        for k in self._gauges:
+            if k in resp:
+                self._gauges[k] = int(resp[k])
+        if "idle" in resp:
+            self._idle = bool(resp["idle"])
+        if "warmed" in resp:
+            self._warmed = bool(resp["warmed"])
+        self._partials = {rid: list(toks) for rid, toks
+                          in resp.get("partials", {}).items()}
+
+    def partial_tokens(self, request_id: str) -> Optional[List[int]]:
+        return self._partials.get(request_id)
+
+    #: budget for the hot-routing-path RPCs (prefix peek) — affinity
+    #: is an optimization, and a hung-but-not-yet-wedged worker must
+    #: not convert every submit into a full rpc_timeout_s stall
+    ROUTE_RPC_TIMEOUT_S = 1.0
+
+    def cached_prefix_tokens(self, prompt) -> int:
+        import numpy as np
+        try:
+            resp = self._call("prefix",
+                              prompt=np.asarray(prompt).tolist(),
+                              timeout_s=min(self.ROUTE_RPC_TIMEOUT_S,
+                                            self.rpc_timeout_s))
+        except (ReplicaDownError, RpcTimeout):
+            return 0
+        return int(resp.get("tokens", 0))
+
+    @property
+    def queue_depth(self) -> int:
+        return self._gauges["queue_depth"]
+
+    @property
+    def slots_active(self) -> int:
+        return self._gauges["slots_active"]
+
+    @property
+    def pages_in_use(self) -> int:
+        return self._gauges["pages_in_use"]
+
+    @property
+    def engine_idle(self) -> bool:
+        return self._idle
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed
+
+    def refresh_health(self, timeout_s: Optional[float] = None) -> dict:
+        """One live ``health`` RPC (attach reconciliation, the
+        front door's one-time vocab lookup); absorbs the gauges it
+        carries. Callers on the serving hot path must pass a short
+        ``timeout_s`` — the default budget is rpc_timeout_s."""
+        resp = self._call("health", timeout_s=timeout_s)
+        self._absorb(resp)
+        return resp
+
+    def hit_tokens(self) -> Tuple[int, int]:
+        return (self._gauges["prefix_hit_tokens"],
+                self._gauges["prompt_tokens"])
+
+    def health(self) -> dict:
+        """Cached state ONLY — /healthz is the liveness probe and must
+        never block the single-threaded loop on a sick worker (the
+        class contract). Gauges are absorbed from every step response;
+        the supervisor's separate probe and :meth:`refresh_health`
+        (attach, vocab lookup) do the live RPCs."""
+        h = dict(self._base_health())
+        h.update({
+            "queue_depth": self.queue_depth,
+            "slots_active": self.slots_active,
+            "pages_in_use": self.pages_in_use,
+            "pid": self.pid, "gen": self.gen,
+            "restarts": self.restarts,
+            "rpc_timeouts": self.rpc_timeouts,
+            "warmed": self.warmed,
+        })
+        return h
+
+    def summary_block(self) -> dict:
+        try:
+            resp = self._call("summary")
+            block = resp.get("block", {})
+        except (ReplicaDownError, RpcTimeout):
+            block = {"occupancy_mean": 0.0,
+                     "n_steps": self._gauges["n_steps"], "pages": {},
+                     "finished": {}, "unreachable": True}
+        block["health"] = self.health()
+        return block
 
 
 class Router:
@@ -167,38 +563,58 @@ class Router:
     Same single-threaded host API shape as :class:`Engine` — ``submit``
     returns None (accepted) or a terminal rejection, ``step`` advances
     every live replica one scheduling iteration and returns the fleet's
-    newly finished results, ``drain`` runs to idle.
-    """
+    newly finished results, ``drain`` runs to idle. Pass ``backends``
+    (a list of :class:`ReplicaBase`, e.g. :class:`RemoteReplica`
+    proxies from ``faults.procsup.spawn_fleet``) to run the fleet
+    across worker processes instead of in-process engines — ``params``
+    and ``cfg`` are unused then (each worker owns its own model)."""
 
-    def __init__(self, params, cfg: ModelConfig,
+    def __init__(self, params=None, cfg=None,
                  rcfg: RouterConfig = RouterConfig(),
-                 ecfg: EngineConfig = EngineConfig(),
+                 ecfg=None,
                  clock: Callable[[], float] = time.monotonic,
                  telemetry=None, resilience=None,
-                 drafter_factory: Optional[Callable[[], object]] = None):
-        assert rcfg.n_replicas >= 1, rcfg.n_replicas
+                 drafter_factory: Optional[Callable[[], object]] = None,
+                 backends: Optional[List[ReplicaBase]] = None):
         self.rcfg = rcfg
         self.clock = clock
         self.tel = telemetry or NULL
         if self.tel.enabled:
             self.tel.name_track(ROUTER_TRACK, ROUTER_TRACK_NAME)
         self.metrics = Metrics()
-        self.replicas: List[Replica] = []
-        for i in range(rcfg.n_replicas):
-            jpath = jr = None
-            if rcfg.journal_dir is not None:
-                jpath = os.path.join(rcfg.journal_dir,
-                                     f"replica{i}.jsonl")
-                jr = RequestJournal(jpath)
-            eng = Engine(params, cfg, ecfg, clock=clock,
-                         drafter=(drafter_factory() if drafter_factory
-                                  else None),
-                         rcfg=resilience, journal=jr, telemetry=self.tel,
-                         track_base=i * REPLICA_TRACK_STRIDE,
-                         track_label=f"replica{i} ")
-            self.replicas.append(Replica(
-                idx=i, engine=eng, journal_path=jpath, journal=jr,
-                skip_steps=rcfg.wedge_skip_steps))
+        self.remote = backends is not None
+        #: the process supervisor (faults/procsup.py), attached by
+        #: spawn_fleet — the delegate for proc_kill/proc_hang chaos and
+        #: the owner of restart/quarantine decisions
+        self.supervisor = None
+        self.replicas: List[ReplicaBase] = []
+        if backends is not None:
+            self.replicas = list(backends)
+            for rep in self.replicas:
+                rep.skip_steps = rcfg.wedge_skip_steps
+                if self.tel.enabled:
+                    self.tel.name_track(self._worker_track(rep.idx),
+                                        f"worker{rep.idx}")
+        else:
+            assert rcfg.n_replicas >= 1, rcfg.n_replicas
+            from .engine import Engine, EngineConfig
+            ecfg = ecfg or EngineConfig()
+            for i in range(rcfg.n_replicas):
+                jpath = jr = None
+                if rcfg.journal_dir is not None:
+                    jpath = os.path.join(rcfg.journal_dir,
+                                         f"replica{i}.jsonl")
+                    jr = RequestJournal(jpath)
+                eng = Engine(params, cfg, ecfg, clock=clock,
+                             drafter=(drafter_factory()
+                                      if drafter_factory else None),
+                             rcfg=resilience, journal=jr,
+                             telemetry=self.tel,
+                             track_base=i * REPLICA_TRACK_STRIDE,
+                             track_label=f"replica{i} ")
+                self.replicas.append(Replica(
+                    idx=i, engine=eng, journal_path=jpath, journal=jr,
+                    skip_steps=rcfg.wedge_skip_steps))
         self.n_steps = 0
         self._inflight: Dict[str, _InFlight] = {}
         self._requeue: List[_Requeue] = []
@@ -211,6 +627,10 @@ class Router:
         #: making delivery exactly-once (take_new_tokens)
         self._delivered: Dict[str, int] = {}
         self._ttft: Dict[str, float] = {}      # fleet TTFT per id
+        #: remote mode: request ids with an open telemetry envelope on
+        #: a worker track (the router emits worker-process envelopes —
+        #: the workers' own recorders live in other processes)
+        self._open_env: Dict[str, int] = {}
         #: terminal results produced by the ROUTER (kill without a
         #: journal, journaled-finish on a dead replica, cancel of a
         #: requeued request) — drained into the next step()'s return so
@@ -240,7 +660,7 @@ class Router:
     def cancel(self, request_id: str) -> bool:
         fi = self._inflight.get(request_id)
         if fi is not None:
-            return self.replicas[fi.replica].engine.cancel(request_id)
+            return self.replicas[fi.replica].cancel(request_id)
         for i, item in enumerate(self._requeue):
             if item.req.id == request_id:
                 del self._requeue[i]
@@ -253,9 +673,13 @@ class Router:
     @property
     def idle(self) -> bool:
         # undelivered router-side terminal results keep the fleet
-        # non-idle: one more step() must run to surface them
+        # non-idle: one more step() must run to surface them. In-flight
+        # entries count too — a DOWN remote replica's requests wait for
+        # its restart, and the fleet must keep stepping (retry ladder,
+        # supervisor ticks ride the driver) until they resolve.
         return (not self._requeue and not self._router_finished
-                and all(r.engine.idle for r in self.replicas if r.alive))
+                and not self._inflight
+                and all(r.engine_idle for r in self.replicas if r.alive))
 
     @property
     def n_alive(self) -> int:
@@ -277,6 +701,19 @@ class Router:
                 self._kill(int(flt.arg), step_idx)
             elif flt.kind == KIND_REPLICA_WEDGE:
                 wedge_delay[int(flt.arg2)] = float(flt.arg)
+            elif flt.kind == KIND_PROC_KILL:
+                if self.supervisor is not None:
+                    self.supervisor.chaos_kill(int(flt.arg))
+                else:
+                    self._event(f"step {step_idx}: proc_kill ignored "
+                                f"(no supervisor attached)")
+            elif flt.kind == KIND_PROC_HANG:
+                if self.supervisor is not None:
+                    self.supervisor.chaos_hang(int(flt.arg2),
+                                               int(flt.arg))
+                else:
+                    self._event(f"step {step_idx}: proc_hang ignored "
+                                f"(no supervisor attached)")
 
         out: List[RequestResult] = []
         if self._router_finished:      # router-side terminals (kill
@@ -293,14 +730,24 @@ class Router:
                 # the router's measurement — indistinguishable from a
                 # wedged device or a partition to that replica
                 time.sleep(delay)
-            finished = rep.engine.step()
+            try:
+                finished = rep.step_engine()
+            except ReplicaDownError as e:
+                rep.last_step_s = time.perf_counter() - t_wall
+                self.mark_down(rep.idx, str(e))
+                continue
             rep.last_step_s = time.perf_counter() - t_wall
             rep.steps += 1
-            self._probe(rep, step_idx)
+            # finishes BEFORE the wedge probe: a request that finished
+            # in the very step that trips the probe must leave the
+            # ledger first, or _wedge would hedge-requeue it — a second
+            # decode (and a second terminal envelope) for a request the
+            # client already has in full
             for res in finished:
                 done = self._on_finish(res, rep.idx, now)
                 if done is not None:
                     out.append(done)
+            self._probe(rep, step_idx)
 
         self._observe_ttft(now)
         self._drain_requeue(step_idx)
@@ -327,8 +774,9 @@ class Router:
         """Consume the tokens newly available for ``request_id`` since
         the last call — the ONE delivery path (SSE streaming and the
         fleet replay both read through here). Exactly-once across
-        migration: a requeued request regenerates deterministically
-        from token 0, and this ledger suppresses the prefix already
+        migration AND across a worker-process restart: a
+        requeued/replayed request regenerates deterministically from
+        token 0, and this ledger suppresses the prefix already
         delivered, so the concatenated stream equals the uninterrupted
         token list."""
         sent = self._delivered.get(request_id, 0)
@@ -339,7 +787,7 @@ class Router:
             fi = self._inflight.get(request_id)
             if fi is None:
                 return []
-            partial = (self.replicas[fi.replica].engine
+            partial = (self.replicas[fi.replica]
                        .partial_tokens(request_id)) or []
             new = partial[sent:]
         if new:
@@ -366,8 +814,154 @@ class Router:
 
     def close(self) -> None:
         for rep in self.replicas:
-            if rep.journal is not None:
-                rep.journal.close()
+            rep.close()
+
+    # ------------------------------------------------------- supervision
+
+    def mark_down(self, idx: int, reason: str = "") -> None:
+        """A remote replica's process is unreachable: stop stepping it,
+        keep its in-flight ledger entries — the supervisor decides
+        between restart (the worker replays its journal and
+        :meth:`attach_replica` reconciles) and abandonment
+        (:meth:`abandon_replica` requeues onto survivors)."""
+        rep = self.replicas[idx]
+        if not rep.alive:
+            return
+        rep.alive = False
+        rep.wedged = False
+        self.metrics.inc("fleet_replica_downs")
+        self._event(f"step {self.n_steps}: replica {idx} DOWN"
+                    + (f" ({reason})" if reason else ""))
+        self.tel.instant("worker_down", ROUTER_TRACK, replica=idx)
+
+    def attach_replica(self, idx: int, port: int,
+                       pid: Optional[int] = None,
+                       gen: Optional[int] = None) -> dict:
+        """(Re)connect a remote replica and reconcile the router's
+        in-flight ledger against what the restarted worker actually
+        recovered from its journal:
+
+        - ids the worker replayed keep their ledger entries — the
+          worker regenerates them from token 0 and the delivery ledger
+          suppresses the already-delivered prefix (exactly-once across
+          ``kill -9``);
+        - ids the journal says *finished* (the result died undelivered
+          with the process) surface their journaled reason;
+        - ids the worker lost entirely (torn submit record) requeue
+          onto the fleet;
+        - ids the worker replayed that the router does NOT own (stale
+          journal ghosts, previously-migrated work) are cancelled
+          before they waste a decode.
+        """
+        rep = self.replicas[idx]
+        assert isinstance(rep, RemoteReplica), "attach is remote-only"
+        rep.connect(port, pid=pid, gen=gen)
+        h = rep.refresh_health()
+        rep.stream_drain()
+        worker_ids = set(h.get("in_flight", []))
+        mine = [rid for rid, fi in self._inflight.items()
+                if fi.replica == idx]
+        finished_reasons: Dict[str, str] = {}
+        if rep.journal_path is not None:
+            finished_reasons = {
+                r["id"]: r.get("reason", "")
+                for r in load_jsonl_if_exists(rep.journal_path)
+                if r.get("ev") == "finish"}
+        kept = lost = 0
+        now = self.clock()
+        for rid in mine:
+            if rid in worker_ids:
+                kept += 1
+                continue
+            fi = self._inflight.pop(rid)
+            if rid in finished_reasons:
+                self._env_close(rid, migrated=True)
+                self._record_result(RequestResult(
+                    id=rid, tokens=[],
+                    finish_reason=finished_reasons[rid]), fi.t_submit)
+            else:
+                self._env_close(rid, migrated=True)
+                self._requeue.append(_Requeue(
+                    req=fi.req, t_submit=fi.t_submit,
+                    attempts=fi.attempts, due_step=self.n_steps,
+                    t_requeued=now))
+                self.metrics.inc("fleet_requeued_requests")
+                lost += 1
+        # a replayed id the router does not own at all, OR owns on a
+        # DIFFERENT replica (it migrated away while this worker was
+        # dead/hung — its live copy is elsewhere), is a ghost here:
+        # cancel it before it wastes a decode
+        ghosts = [rid for rid in worker_ids
+                  if rid not in self._inflight
+                  or self._inflight[rid].replica != idx]
+        for rid in ghosts:
+            rep.cancel(rid, migrated=True)
+            self.metrics.inc("fleet_ghost_cancels")
+        # superseded entries for finishes this incarnation can never
+        # deliver (the pre-restart copy died with the process)
+        self._superseded = {rid: i for rid, i
+                            in self._superseded.items()
+                            if i != idx or rid in worker_ids}
+        rep.alive = True
+        rep.wedged = False
+        rep.draining = False
+        rep.suspect_streak = 0
+        rep.skip_steps = self.rcfg.wedge_skip_steps
+        self.metrics.inc("fleet_replica_attaches")
+        self._event(f"step {self.n_steps}: worker {idx} attached "
+                    f"(pid {rep.pid}, gen {rep.gen}, kept {kept}, "
+                    f"requeued {lost}, ghosts {len(ghosts)})")
+        self.tel.instant("worker_attach", ROUTER_TRACK, replica=idx,
+                         gen=rep.gen, kept=kept, requeued=lost,
+                         ghosts=len(ghosts))
+        return {"kept": kept, "requeued": lost, "ghosts": len(ghosts)}
+
+    def abandon_replica(self, idx: int) -> None:
+        """Give up on a replica for good (restart budget exhausted →
+        quarantine): journal-driven requeue of its in-flight work onto
+        the survivors — the same path a fleet-fault ``replica_kill``
+        takes."""
+        self._kill(idx, self.n_steps)
+
+    def drain_replica(self, idx: int) -> int:
+        """Graceful drain for a rolling restart: mark the replica
+        draining (unroutable, `/readyz`-excluded), migrate its
+        in-flight work onto the rest of the fleet (cancel-with-migrated
+        on the replica — its journal records the finishes, so a restart
+        never resurrects them), and return how many requests moved.
+        The replica keeps stepping while drained (it may still be
+        flushing its own cancels); :meth:`attach_replica` (remote) or
+        :meth:`undrain_replica` (local) lifts the drain."""
+        rep = self.replicas[idx]
+        if not rep.alive or rep.draining:
+            return 0
+        rep.draining = True
+        now = self.clock()
+        ids = [rid for rid, fi in self._inflight.items()
+               if fi.replica == idx]
+        n = 0
+        for rid in ids:
+            fi = self._inflight.pop(rid)
+            rep.cancel(rid, migrated=True)
+            self._superseded[rid] = idx
+            self._env_close(rid, migrated=True)
+            self._requeue.append(_Requeue(
+                req=fi.req, t_submit=fi.t_submit, attempts=fi.attempts,
+                due_step=self.n_steps, t_requeued=now))
+            n += 1
+        if n:
+            self.metrics.inc("fleet_requeued_requests", n)
+            self.tel.instant("requeue", ROUTER_TRACK, replica=idx,
+                             n=n, cause="drain")
+        self.metrics.inc("fleet_drains")
+        self._event(f"step {self.n_steps}: replica {idx} draining "
+                    f"({n} request(s) migrated)")
+        self.tel.instant("replica_drain", ROUTER_TRACK, replica=idx,
+                         n=n)
+        return n
+
+    def undrain_replica(self, idx: int) -> None:
+        self.replicas[idx].draining = False
 
     # ------------------------------------------------------------ summary
 
@@ -379,27 +973,18 @@ class Router:
         hit_tokens = prompt_tokens = 0
         per_replica = []
         for rep in self.replicas:
-            a = rep.engine.pool.alloc
-            hit_tokens += a.prefix_hit_tokens
-            prompt_tokens += a.prompt_tokens
-            s = rep.engine.metrics_summary()
-            per_replica.append({
-                "health": rep.health(),
-                "occupancy_mean": round(
-                    s["histograms"].get("batch_fill_ratio", {})
-                    .get("mean", 0.0), 4),
-                "n_steps": rep.engine.n_steps,
-                "pages": s["pages"],
-                "finished": {k: int(v) for k, v in
-                             rep.engine.metrics.counters.items()
-                             if k.startswith("finished_")},
-            })
+            h, p = rep.hit_tokens()
+            hit_tokens += h
+            prompt_tokens += p
+            per_replica.append(rep.summary_block())
         return {
             "n_replicas": len(self.replicas),
             "n_alive": self.n_alive,
             "n_steps": self.n_steps,
             "router": {k: int(v) for k, v in sorted(c.items())},
             "fleet_ttft_s": self.metrics.hist_summary("fleet_ttft_s"),
+            "requeue_latency_s": self.metrics.hist_summary(
+                "fleet_requeue_latency_s"),
             "aggregate_prefix_hit_rate": (
                 round(hit_tokens / prompt_tokens, 4)
                 if prompt_tokens else 0.0),
@@ -408,10 +993,28 @@ class Router:
         }
 
     def healthz(self) -> dict:
-        """The /healthz body: ok iff at least one replica is routable."""
-        return {"ok": any(r.routable for r in self.replicas),
+        """The /healthz body — *liveness*: the router loop is up and
+        answering; per-replica detail rides along. Readiness (can the
+        fleet take traffic?) is :meth:`readyz` — external supervisors
+        gate traffic on that, not on this."""
+        return {"ok": True, "live": True,
                 "n_alive": self.n_alive,
                 "replicas": [r.health() for r in self.replicas]}
+
+    def readyz(self) -> dict:
+        """The /readyz body — *readiness*: ok iff at least one replica
+        is routable (alive, not wedged, not draining) AND warmed
+        (compiled its programs — a worker that would eat the first
+        request's compile latency is not ready). 503 during a
+        single-survivor rolling-restart drain window, 200 again when a
+        restarted worker attaches."""
+        ready = [r.idx for r in self.replicas
+                 if r.routable and r.warmed]
+        return {"ok": bool(ready),
+                "ready_replicas": len(ready),
+                "n_alive": self.n_alive,
+                "draining": [r.idx for r in self.replicas
+                             if r.draining]}
 
     # ----------------------------------------------------------- internals
 
@@ -420,46 +1023,78 @@ class Router:
         if len(self.events) > 256:
             del self.events[:len(self.events) - 256]
 
-    def _candidates(self, req: Request) -> List[int]:
-        """Replica indices to try, best first: longest cached prefix,
-        then least load, then index (stable)."""
+    @staticmethod
+    def _worker_track(idx: int) -> int:
+        """Remote mode: the router emits each worker's request
+        envelopes on one track per worker (the worker's own recorder
+        lives in another process). Concurrent envelopes interleave on
+        the track; tools/trace_check.py pairs them by request id."""
+        return idx * REPLICA_TRACK_STRIDE + ENGINE_TRACK
+
+    def _env_open(self, rid: str, idx: int) -> None:
+        if not (self.remote and self.tel.enabled):
+            return
+        self._open_env[rid] = idx
+        self.tel.begin("request", self._worker_track(idx),
+                       ts_us=self.tel.ts_us(self.clock()), request=rid)
+
+    def _env_close(self, rid: str, migrated: bool,
+                   reason: str = "", n_tokens: int = 0) -> None:
+        idx = self._open_env.pop(rid, None)
+        if idx is None or not self.tel.enabled:
+            return
+        args = {"request": rid, "n_tokens": n_tokens}
+        if migrated:
+            args["migrated"] = True
+        if reason:
+            args["reason"] = reason
+        self.tel.end("request", self._worker_track(idx),
+                     ts_us=self.tel.ts_us(self.clock()), **args)
+
+    def _candidates(self, req: Request
+                    ) -> List[Tuple[ReplicaBase, int]]:
+        """(replica, cached-prefix-tokens) pairs to try, best first:
+        longest cached prefix, then least load, then index (stable)."""
         avail = [r for r in self.replicas if r.routable]
         if not avail:
             # a fully wedged fleet still beats dropping the request on
-            # the floor: route to a wedged-but-alive replica
-            avail = [r for r in self.replicas if r.alive]
+            # the floor: route to a wedged-but-alive replica (never a
+            # draining one — it is being emptied on purpose)
+            avail = [r for r in self.replicas
+                     if r.alive and not r.draining]
         if not avail:
             return []
-
-        def key(rep: Replica):
-            aff = (rep.engine.pool.cached_prefix_tokens(req.prompt)
-                   if self.rcfg.affinity else 0)
-            return (-aff, rep.load, rep.idx)
-
-        return [r.idx for r in sorted(avail, key=key)]
+        scored = [(rep, (rep.cached_prefix_tokens(req.prompt)
+                         if self.rcfg.affinity else 0))
+                  for rep in avail]
+        scored.sort(key=lambda t: (-t[1], t[0].load, t[0].idx))
+        return scored
 
     def _submit_routed(self, req: Request, t_submit: float,
                        attempts: int) -> Optional[RequestResult]:
         """Try every candidate replica once, in affinity/load order;
         returns None on acceptance or the LAST rejection."""
         last: Optional[RequestResult] = None
-        for idx in self._candidates(req):
-            rep = self.replicas[idx]
-            rej = rep.engine.submit(req)
+        for rep, aff in self._candidates(req):
+            rej = rep.submit(req)
             if rej is None:
                 self._inflight[req.id] = _InFlight(
-                    req=req, replica=idx, t_submit=t_submit,
+                    req=req, replica=rep.idx, t_submit=t_submit,
                     attempts=attempts)
                 self.metrics.inc("fleet_requests_routed")
+                self._env_open(req.id, rep.idx)
                 if self.tel.enabled:
                     self.tel.instant(
                         "route", ROUTER_TRACK, request=req.id,
-                        replica=idx, attempt=attempts,
-                        affinity_tokens=int(
-                            rep.engine.pool.cached_prefix_tokens(
-                                req.prompt)))
+                        replica=rep.idx, attempt=attempts,
+                        affinity_tokens=int(aff))
                 return None
             last = rej
+            # (a REJECT_REPLICA_TIMEOUT copy may execute on the hung
+            # worker anyway — if the id is then accepted elsewhere,
+            # that copy's eventual finish is swallowed by the
+            # replica-aware stale guard in _on_finish, or by the ghost
+            # path once the live copy delivered; no extra state needed)
             if rej.finish_reason in TERMINAL_REJECTS:
                 # a deterministic verdict (validation, prompt too long,
                 # dead-on-arrival deadline) — another replica would say
@@ -479,20 +1114,39 @@ class Router:
             # so the live copy's own finish is never mistaken for it)
             del self._superseded[res.id]
             return None
+        fi = self._inflight.get(res.id)
+        if fi is not None and fi.replica != replica:
+            # a stale copy on a replica the ledger does NOT route this
+            # id to (a timed-out submit that executed anyway, a
+            # pre-migration straggler): the live copy is on fi.replica
+            # — swallowing here keeps its entry intact
+            self.metrics.inc("fleet_stale_finishes")
+            return None
         fi = self._inflight.pop(res.id, None)
-        if fi is not None:
-            res.total_s = now - fi.t_submit
-            if res.id in self._ttft:
-                res.ttft_s = self._ttft[res.id]
-            elif res.tokens:
-                # finished in the same step its first token committed:
-                # _observe_ttft runs after the per-replica loop and only
-                # sees ids still in flight, so the FASTEST requests would
-                # never enter the fleet_ttft_s histogram (biasing the
-                # bench p50/p99 upward) — observe them here
-                res.ttft_s = now - fi.t_submit
-                self._ttft[res.id] = res.ttft_s
-                self.metrics.observe("fleet_ttft_s", res.ttft_s)
+        if fi is None:
+            # remote-mode ghosts only: a finish for an id the router
+            # does not own (a cancelled stale-journal replay, a
+            # redelivery that slipped the proxy dedupe). In-process
+            # engines cannot produce this — they only ever finish what
+            # the router submitted.
+            if res.id not in self.results:
+                self.metrics.inc("fleet_ghost_finishes")
+            return None
+        res.total_s = now - fi.t_submit
+        if res.id in self._ttft:
+            res.ttft_s = self._ttft[res.id]
+        elif res.tokens:
+            # finished in the same step its first token committed:
+            # _observe_ttft runs after the per-replica loop and only
+            # sees ids still in flight, so the FASTEST requests would
+            # never enter the fleet_ttft_s histogram (biasing the
+            # bench p50/p99 upward) — observe them here
+            res.ttft_s = now - fi.t_submit
+            self._ttft[res.id] = res.ttft_s
+            self.metrics.observe("fleet_ttft_s", res.ttft_s)
+        self._env_close(res.id, migrated=False,
+                        reason=res.finish_reason,
+                        n_tokens=len(res.tokens))
         self.metrics.inc("fleet_requests_finished")
         self.results[res.id] = res
         return res
@@ -506,12 +1160,16 @@ class Router:
         envelope on the router track: every request id still forms
         exactly one complete span tree (tools/trace_check.py), even
         when its engine segments all ended ``migrated``.
-        ``envelope=False`` is the journaled-finish path: the engine
-        closed the terminal envelope when it journaled the finish (the
-        two happen together in ``_finish_slot``) — a second close here
-        would violate the exactly-one-terminal invariant."""
+        ``envelope=False`` is the in-process journaled-finish path: the
+        engine closed the terminal envelope when it journaled the
+        finish (the two happen together in ``_finish_slot``) — a second
+        close here would violate the exactly-one-terminal invariant.
+        (Remote workers record into their own processes, so the remote
+        paths always pass ``envelope=True`` after closing any open
+        worker-track segment as migrated.)"""
         now = self.clock()
         res.total_s = now - t_submit
+        self._env_close(res.id, migrated=True)   # remote stragglers
         if self.tel.enabled and envelope:
             ts = self.tel.ts_us(now)
             self.tel.begin("request", ROUTER_TRACK, ts_us=ts,
@@ -530,13 +1188,12 @@ class Router:
         for rid, fi in self._inflight.items():
             if rid in self._ttft or self._delivered.get(rid, 0):
                 continue
-            partial = (self.replicas[fi.replica].engine
-                       .partial_tokens(rid))
+            partial = self.replicas[fi.replica].partial_tokens(rid)
             if partial:
                 self._ttft[rid] = now - fi.t_submit
                 self.metrics.observe("fleet_ttft_s", now - fi.t_submit)
 
-    def _probe(self, rep: Replica, step_idx: int) -> None:
+    def _probe(self, rep: ReplicaBase, step_idx: int) -> None:
         """Wedge detection over per-step wall time + quarantine expiry."""
         cfg = self.rcfg
         if rep.wedged and step_idx >= rep.quarantine_until:
@@ -546,6 +1203,13 @@ class Router:
             self._event(f"step {step_idx}: replica {rep.idx} rejoined")
             self.tel.instant("replica_rejoin", ROUTER_TRACK,
                              replica=rep.idx)
+            # a remote replica that wedged (e.g. SIGSTOP) may still
+            # hold superseded copies the hedge could not cancel while
+            # it was unresponsive — clean them up now, best effort
+            if not rep.is_local:
+                for rid, sidx in list(self._superseded.items()):
+                    if sidx == rep.idx:
+                        rep.cancel(rid, migrated=True)
         if cfg.wedge_budget_s <= 0 or rep.wedged:
             return
         if rep.skip_steps > 0:        # warmup compiles are not wedges
@@ -558,11 +1222,14 @@ class Router:
         if rep.suspect_streak >= cfg.wedge_patience:
             self._wedge(rep, step_idx)
 
-    def _wedge(self, rep: Replica, step_idx: int) -> None:
+    def _wedge(self, rep: ReplicaBase, step_idx: int) -> None:
         """Quarantine a wedged replica and hedge its in-flight work onto
         healthy replicas (cancel-with-migrated on the suspect first, so
         no id is ever live on two replicas — double-decode is
-        structurally impossible)."""
+        structurally impossible; a HUNG remote worker cannot be
+        cancelled now, so its copy is marked superseded and cancelled
+        at rejoin instead — the delivery ledger never reads from it
+        either way)."""
         rep.wedged = True
         rep.suspect_streak = 0
         rep.quarantine_until = step_idx + self.rcfg.quarantine_steps
@@ -573,16 +1240,19 @@ class Router:
                     f"re-routing its in-flight work")
         self.tel.instant("replica_wedge", ROUTER_TRACK, replica=rep.idx,
                          step_ms=rep.last_step_s * 1e3)
+        now = self.clock()
         n = 0
-        for rid in rep.engine.in_flight_ids():
-            fi = self._inflight.pop(rid, None)
-            if fi is None:
-                continue
-            rep.engine.cancel(rid, migrated=True)
+        ids = [rid for rid, fi in self._inflight.items()
+               if fi.replica == rep.idx]
+        for rid in ids:
+            fi = self._inflight.pop(rid)
+            rep.cancel(rid, migrated=True)
             self._superseded[rid] = rep.idx
+            self._env_close(rid, migrated=True)
             self._requeue.append(_Requeue(
                 req=fi.req, t_submit=fi.t_submit,
-                attempts=fi.attempts, due_step=step_idx))
+                attempts=fi.attempts, due_step=step_idx,
+                t_requeued=now))
             n += 1
         if n:
             self.metrics.inc("fleet_requeued_requests", n)
@@ -590,9 +1260,10 @@ class Router:
                              n=n, cause="wedge")
 
     def _kill(self, idx: int, step_idx: int) -> None:
-        """Abandon a replica (the in-process stand-in for a process
-        death): close its telemetry envelopes as migrated segments,
-        replay its crash journal, requeue the unfinished."""
+        """Abandon a replica (a process death the supervisor gave up
+        on, or the in-process stand-in for one): close its telemetry
+        envelopes as migrated segments, replay its crash journal,
+        requeue the unfinished."""
         if not (0 <= idx < len(self.replicas)):
             return
         rep = self.replicas[idx]
@@ -605,23 +1276,26 @@ class Router:
                     f"its journal")
         self.tel.instant("replica_kill", ROUTER_TRACK, replica=idx)
         now = self.clock()
-        # close open request envelopes on the dead replica's slot
-        # tracks: the router observed the death — the segments are
-        # non-terminal (migrated), the real tree completes elsewhere
+        # close open request envelopes on the dead replica's tracks:
+        # the router observed the death — the segments are non-terminal
+        # (migrated), the real tree completes elsewhere
         if self.tel.enabled:
             for rid, fi in self._inflight.items():
                 if fi.replica != idx:
                     continue
-                slot = rep.engine.pool.slot_of(rid)
-                if slot is None:
-                    continue
-                partial = rep.engine.partial_tokens(rid) or []
-                self.tel.end("request", rep.engine.slot_track(slot),
-                             ts_us=self.tel.ts_us(now), request=rid,
-                             reason="replica_dead", migrated=True,
-                             n_tokens=len(partial))
-        if rep.journal is not None:
-            rep.journal.close()
+                if rep.is_local:
+                    slot = rep.engine.pool.slot_of(rid)
+                    if slot is None:
+                        continue
+                    partial = rep.engine.partial_tokens(rid) or []
+                    self.tel.end("request", rep.engine.slot_track(slot),
+                                 ts_us=self.tel.ts_us(now), request=rid,
+                                 reason="replica_dead", migrated=True,
+                                 n_tokens=len(partial))
+                else:
+                    self._env_close(rid, migrated=True,
+                                    reason="replica_dead")
+        rep.close()
         pending: List[Request] = []
         finished_reasons: Dict[str, str] = {}
         if rep.journal_path is not None:
@@ -649,7 +1323,7 @@ class Router:
             fi = self._inflight.pop(p.id)
             self._requeue.append(_Requeue(
                 req=p, t_submit=fi.t_submit, attempts=fi.attempts,
-                due_step=step_idx))
+                due_step=step_idx, t_requeued=now))
         if pending:
             self.metrics.inc("fleet_requeued_requests", len(pending))
             self.tel.instant("requeue", ROUTER_TRACK, replica=idx,
@@ -661,20 +1335,42 @@ class Router:
         for rid in [r for r, fi in list(self._inflight.items())
                     if fi.replica == idx and r not in pending_ids]:
             fi = self._inflight.pop(rid)
-            # a journaled finish means the engine already emitted the
-            # terminal envelope close (or the request_unstarted
-            # instant) — the router must not close it a second time
+            # a journaled finish means an IN-PROCESS engine already
+            # emitted the terminal envelope close (or the
+            # request_unstarted instant) — the router must not close it
+            # a second time. A remote worker's recorder died with its
+            # process: the router always owns the close there.
             self._record_result(RequestResult(
                 id=rid, tokens=[],
                 finish_reason=finished_reasons.get(rid, "cancelled")),
-                fi.t_submit, envelope=rid not in finished_reasons)
+                fi.t_submit,
+                envelope=(not rep.is_local
+                          or rid not in finished_reasons))
 
     def _drain_requeue(self, step_idx: int) -> None:
         """Bounded retry with exponential backoff for requests between
-        replicas (requeued after a kill/wedge, or bounced by
+        replicas (requeued after a kill/wedge/drain, or bounced by
         backpressure). Terminal results (retry exhaustion) go through
         :meth:`_record_result` onto the ``_router_finished`` ledger —
         the caller drains it into this step's return."""
+        # a fleet with zero routable replicas BECAUSE recovery is in
+        # progress (a draining replica mid-rolling-restart, a worker
+        # process respawning) holds the requeue without burning retry
+        # attempts: router steps race far ahead of wall-clock recovery
+        # (thousands of idle steps during one worker restart), and the
+        # step-denominated ladder would exhaust in milliseconds and
+        # reject requests a one-second wait would have saved. A fleet
+        # with nothing coming back (all replicas dead, no supervisor
+        # respawn pending) still exhausts honestly.
+        if not any(r.routable for r in self.replicas):
+            recovering = (
+                any(r.alive and r.draining for r in self.replicas)
+                or (self.supervisor is not None
+                    and self.supervisor.reviving))
+            if recovering and self._requeue:
+                for item in self._requeue:
+                    item.due_step = max(item.due_step, step_idx + 1)
+                return
         still: List[_Requeue] = []
         for item in self._requeue:
             if item.due_step > step_idx:
@@ -684,12 +1380,16 @@ class Router:
                                       attempts=item.attempts)
             if rej is None:
                 self.metrics.inc("fleet_requeue_submits")
+                if item.t_requeued:
+                    self.metrics.observe(
+                        "fleet_requeue_latency_s",
+                        max(self.clock() - item.t_requeued, 0.0))
                 continue
             item.attempts += 1
             if (item.attempts > self.rcfg.retry_max
                     or rej.finish_reason in TERMINAL_REJECTS):
                 reason = (REJECT_FLEET_CAPACITY
-                          if rej.finish_reason == REJECT_QUEUE_FULL
+                          if rej.finish_reason in RETRYABLE_REJECTS
                           else rej.finish_reason)
                 self._record_result(RequestResult(
                     id=item.req.id, tokens=[], finish_reason=reason),
@@ -707,14 +1407,13 @@ class Router:
             i = rep.idx
             self.metrics.gauge(f"replica{i}_alive", int(rep.alive))
             self.metrics.gauge(f"replica{i}_wedged", int(rep.wedged))
+            self.metrics.gauge(f"replica{i}_draining",
+                               int(rep.draining))
             self.metrics.gauge(f"replica{i}_queue_depth",
-                               rep.engine.scheduler.depth
-                               if rep.alive else 0)
+                               rep.queue_depth if rep.alive else 0)
             self.metrics.gauge(f"replica{i}_slots_active",
-                               int(rep.engine._active.sum())
-                               if rep.alive else 0)
+                               rep.slots_active if rep.alive else 0)
             self.metrics.gauge(f"replica{i}_pages_in_use",
-                               rep.engine.pool.alloc.pages_in_use
-                               if rep.alive else 0)
+                               rep.pages_in_use if rep.alive else 0)
         self.metrics.gauge("fleet_requeue_depth", len(self._requeue))
         self.metrics.gauge("fleet_inflight", len(self._inflight))

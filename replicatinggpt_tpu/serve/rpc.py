@@ -1,0 +1,268 @@
+"""Length-prefixed JSON RPC: the wire between the router and worker
+processes.
+
+PR 8's fleet lives in one interpreter — every "replica death" it
+survives is simulated. This module is the seam that makes the fleet
+real: a worker process (serve/worker.py) owns one Engine and speaks
+this protocol over a loopback socket; the router holds one
+:class:`RpcClient` per worker and drives it with the same verbs the
+in-process host API has.
+
+Framing: one message = a 4-byte big-endian unsigned length + that many
+bytes of UTF-8 JSON. Requests are ``{"op": <verb>, ...args}``;
+responses are ``{"ok": true, ...result}`` or ``{"ok": false,
+"error": msg}``. Stdlib only (socket/asyncio/json) — the zero-egress
+image adds no dependency for its own fleet.
+
+Verbs (dispatched in serve/worker.py):
+
+- ``submit``   — route one request into the worker's engine;
+- ``step``     — run ONE engine scheduling iteration; the response
+  carries every not-yet-acknowledged finished result (redelivered
+  until the router acks it in a later ``step``/``ack`` — a response
+  lost to a timeout or a router crash must not lose a finish), the
+  committed-token lists for every active slot (the stream-drain
+  piggyback the delivery ledger reads), and the health gauges;
+- ``stream_drain`` — just the committed-token lists (reconciliation
+  after a reconnect, without forcing a step);
+- ``cancel``   — cancel one request (``migrated`` closes it as a
+  non-terminal segment and journals a finish so the worker's own
+  journal replay never resurrects it);
+- ``drain``    — stop admitting (submits now refuse) and cancel every
+  in-flight request ``migrated`` — the rolling-restart drain;
+- ``health``   — liveness/readiness probe: pid, warmed, idle, queue
+  depth, slots, pages, prefix-hit counters, in-flight ids;
+- ``summary``  — the engine ``metrics_summary()`` block the fleet
+  summary aggregates;
+- ``shutdown`` — close the journal and exit 0 (the graceful half of a
+  rolling restart; SIGKILL is the other half).
+
+Failure model on the client: a socket timeout raises
+:class:`RpcTimeout` (the worker may still execute the call — SIGSTOP
+looks exactly like this), any other socket failure raises
+:class:`RpcDown` (connection refused/reset — the process is gone or
+restarting). Both close the connection; the next call reconnects.
+The caller decides what each means: the router's wedge probe treats
+timeouts as slow steps, the supervisor treats refused connections as
+a death to restart.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Optional
+
+import numpy as np
+
+from .requests import Request, RequestResult, SamplingParams
+
+#: frame-size sanity bound (a corrupt length prefix must not allocate
+#: gigabytes); generous for block_size-scale prompt lists
+MAX_FRAME = 16 << 20
+
+
+class RpcError(Exception):
+    """The worker answered with ok=false (an application error)."""
+
+
+class RpcTimeout(RpcError):
+    """No response within the timeout — the worker may be hung
+    (SIGSTOP, wedged device) and may still execute the call."""
+
+
+class RpcDown(RpcError):
+    """Connection refused/reset/closed — the worker process is gone."""
+
+
+# --------------------------------------------------------------- framing
+
+def encode_frame(obj: dict) -> bytes:
+    data = json.dumps(obj).encode()
+    if len(data) > MAX_FRAME:
+        raise ValueError(f"frame too large: {len(data)} bytes")
+    return len(data).to_bytes(4, "big") + data
+
+
+def decode_length(header: bytes) -> int:
+    n = int.from_bytes(header, "big")
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n} bytes")
+    return n
+
+
+# ---------------------------------------------------------- wire codecs
+
+def request_to_wire(req: Request, now: float) -> dict:
+    """Request -> JSON-safe dict. Deadlines cross the process boundary
+    as *remaining seconds* (an absolute timestamp on the router's
+    monotonic clock is meaningless on the worker's)."""
+    sp = req.sampling
+    return {
+        "id": req.id,
+        "prompt": np.asarray(req.prompt).tolist(),
+        "max_new_tokens": int(req.max_new_tokens),
+        "rng_seed": int(req.rng_seed),
+        "temperature": float(sp.temperature), "top_k": int(sp.top_k),
+        "top_p": float(sp.top_p), "greedy": bool(sp.greedy),
+        "deadline_rel": (None if req.deadline is None
+                         else max(req.deadline - now, 0.0)),
+    }
+
+
+def request_from_wire(doc: dict, now: float) -> Request:
+    deadline = None
+    if doc.get("deadline_rel") is not None:
+        deadline = now + float(doc["deadline_rel"])
+    # host JSON list -> host array; no device involved
+    prompt = np.asarray(doc["prompt"],
+                        np.int32)
+    return Request(
+        id=doc["id"], prompt=prompt,
+        max_new_tokens=int(doc["max_new_tokens"]),
+        sampling=SamplingParams(
+            temperature=float(doc["temperature"]),
+            top_k=int(doc["top_k"]), top_p=float(doc["top_p"]),
+            greedy=bool(doc["greedy"])),
+        deadline=deadline, rng_seed=int(doc["rng_seed"]))
+
+
+def result_to_wire(res: RequestResult) -> dict:
+    return {
+        "id": res.id, "tokens": list(res.tokens),
+        "finish_reason": res.finish_reason,
+        "queue_wait_s": res.queue_wait_s, "ttft_s": res.ttft_s,
+        "decode_tokens_per_s": res.decode_tokens_per_s,
+        "total_s": res.total_s,
+    }
+
+
+def result_from_wire(doc: dict) -> RequestResult:
+    return RequestResult(
+        id=doc["id"], tokens=list(doc["tokens"]),
+        finish_reason=doc["finish_reason"],
+        queue_wait_s=float(doc.get("queue_wait_s", 0.0)),
+        ttft_s=float(doc.get("ttft_s", 0.0)),
+        decode_tokens_per_s=float(doc.get("decode_tokens_per_s", 0.0)),
+        total_s=float(doc.get("total_s", 0.0)))
+
+
+# ---------------------------------------------------------- sync client
+
+class RpcClient:
+    """Blocking single-connection client (the router and supervisor are
+    single-threaded loops — one in-flight call at a time by design).
+    Connects lazily; a timeout or connection failure closes the socket
+    so the next call reconnects from a clean state (a half-read
+    response from a timed-out call can never be mistaken for the next
+    call's)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+
+    def connect(self) -> None:
+        if self._sock is not None:
+            return
+        try:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout_s)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                  1)
+        except OSError as e:
+            self._sock = None
+            raise RpcDown(f"connect {self.host}:{self.port}: {e}") from e
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise RpcDown("connection closed mid-frame")
+            buf += chunk
+        return buf
+
+    def call(self, op: str, timeout_s: Optional[float] = None,
+             **kwargs) -> dict:
+        """One request/response exchange; returns the response dict
+        (``ok`` stripped). Raises RpcTimeout / RpcDown / RpcError."""
+        self.connect()
+        self._sock.settimeout(timeout_s if timeout_s is not None
+                              else self.timeout_s)
+        try:
+            self._sock.sendall(encode_frame({"op": op, **kwargs}))
+            n = decode_length(self._recv_exact(4))
+            body = self._recv_exact(n)
+        except socket.timeout as e:
+            self.close()
+            raise RpcTimeout(f"{op}: no response") from e
+        except RpcDown:
+            self.close()
+            raise
+        except OSError as e:
+            self.close()
+            raise RpcDown(f"{op}: {e}") from e
+        try:
+            doc = json.loads(body)
+        except ValueError as e:
+            self.close()
+            raise RpcDown(f"{op}: undecodable response: {e}") from e
+        if not doc.get("ok"):
+            raise RpcError(doc.get("error", "unknown worker error"))
+        return doc
+
+
+# --------------------------------------------------------- async server
+
+async def serve_connection(reader, writer, dispatch) -> None:
+    """One worker-side connection loop: read frame -> dispatch -> write
+    response, until the peer goes away. ``dispatch`` is a synchronous
+    callable ``(doc) -> dict`` running in the event loop — the engine
+    host API is single-threaded by design, and the loop IS that one
+    thread. Dispatch exceptions become ok=false responses; transport
+    errors end the connection quietly (the router reconnects)."""
+    import asyncio
+    try:
+        while True:
+            try:
+                header = await reader.readexactly(4)
+                body = await reader.readexactly(decode_length(header))
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            try:
+                doc = json.loads(body)
+                resp = {"ok": True, **(dispatch(doc) or {})}
+            except SystemExit:
+                raise
+            except Exception as e:  # noqa: BLE001 — the one process
+                # boundary: any dispatch failure must become a framed
+                # error, not a dropped socket the router misreads as a
+                # death
+                resp = {"ok": False,
+                        "error": f"{type(e).__name__}: {e}"}
+            try:
+                writer.write(encode_frame(resp))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                return
+    finally:
+        try:
+            writer.close()
+        except (ConnectionError, OSError):
+            pass
+
+
+#: a submit refused because the worker is unreachable or draining —
+#: NOT deterministic across replicas (another replica may accept), so
+#: the router's candidate loop falls through to the next one
+REJECT_REPLICA_DOWN = "rejected_replica_down"

@@ -1,0 +1,317 @@
+"""Worker process: one Engine behind the serve/rpc.py socket protocol.
+
+``python -m replicatinggpt_tpu serve-worker`` is the unit a real
+deployment schedules: it owns one engine (its own params, KV pool,
+compile caches — a whole interpreter whose death takes nothing else
+with it), an exclusively-locked crash journal on shared storage, and a
+loopback RPC socket the router drives. The router process
+(serve/router.py, :class:`~.router.RemoteReplica`) holds the in-flight
+ledger; the supervisor (faults/procsup.py) holds the restart policy;
+this process holds the only thing that is actually expensive — the
+compiled model — and the journal that makes losing it survivable.
+
+Startup sequence (the order is the crash-recovery contract):
+
+1. build + **warm** the engine (one throwaway greedy token through the
+   decode path, un-journaled) — readiness means "the next request pays
+   no compile";
+2. open the journal with ``lock=True`` (flock: a not-quite-dead
+   previous incarnation still holding it fails THIS process loudly
+   rather than interleaving two writers) and ``fsync_finish`` on;
+3. **replay** the journal: every accepted-but-unfinished request from
+   the previous incarnation is resubmitted into the fresh engine — it
+   regenerates deterministically from token 0, and the router's
+   delivery ledger suppresses the prefix the client already saw
+   (exactly-once across ``kill -9``, pinned in
+   tests/test_fleet_multiproc.py). Requests the admission queue cannot
+   hold yet stay in a pending list retried before every step;
+4. bind the RPC server (port 0 = ephemeral) and atomically write the
+   **ready file** (`{"port", "pid", "gen", "replayed"}`) the
+   supervisor polls — only now is the worker routable.
+
+The worker never steps itself: the router's ``step`` RPC is the one
+driver, so fleet scheduling stays single-threaded and deterministic
+across the process boundary exactly as it is within one. Finished
+results are buffered until the router acks them (serve/rpc.py's
+redelivery contract); committed tokens for active slots piggyback on
+every step response (the stream-drain the delivery ledger reads).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import sys
+import time
+from typing import Dict, List, Optional
+
+from .engine import Engine, EngineConfig
+from .journal import RequestJournal
+from .requests import FINISH_CANCELLED, Request, RequestResult
+from .rpc import (REJECT_REPLICA_DOWN, request_from_wire,
+                  result_to_wire, serve_connection)
+
+
+class WorkerServer:
+    """Dispatch table around one engine (single-threaded: runs inside
+    the asyncio loop, which is the worker's only thread of control)."""
+
+    def __init__(self, engine: Engine,
+                 journal: Optional[RequestJournal],
+                 clock=time.monotonic):
+        self.engine = engine
+        self.journal = journal
+        self.clock = clock
+        self.draining = False
+        self.warmed = False
+        self.stop_event = asyncio.Event()
+        #: finished results not yet acked by the router — redelivered
+        #: in every step response until an ack prunes them (a response
+        #: lost to a timeout/reconnect must not lose a finish)
+        self._finished: Dict[str, RequestResult] = {}
+        #: journal-replayed requests the admission queue could not hold
+        #: yet (retried before every step)
+        self._replay_pending: List[Request] = []
+        self.n_replayed = 0
+
+    # ------------------------------------------------------------ replay
+
+    def replay_journal(self, path: str) -> int:
+        """Resubmit the previous incarnation's unfinished requests."""
+        pending = RequestJournal.unfinished(path)
+        self.n_replayed = len(pending)
+        for req in pending:
+            rej = self.engine.submit(req)
+            if rej is not None:
+                self._replay_pending.append(req)
+        return self.n_replayed
+
+    def _retry_replays(self) -> None:
+        still: List[Request] = []
+        for req in self._replay_pending:
+            if self.engine.submit(req) is not None:
+                still.append(req)
+        self._replay_pending = still
+
+    # ---------------------------------------------------------- dispatch
+
+    def dispatch(self, doc: dict) -> dict:
+        op = doc.get("op")
+        fn = getattr(self, f"op_{op}", None)
+        if fn is None:
+            raise ValueError(f"unknown op {op!r}")
+        return fn(doc)
+
+    def _in_flight_ids(self) -> List[str]:
+        return (self.engine.in_flight_ids()
+                + [r.id for r in self._replay_pending])
+
+    def _gauges(self) -> dict:
+        eng = self.engine
+        a = eng.pool.alloc
+        return {
+            "queue_depth": eng.scheduler.depth,
+            "slots_active": int(eng._active.sum()),
+            "pages_in_use": a.pages_in_use,
+            "prefix_hit_tokens": a.prefix_hit_tokens,
+            "prompt_tokens": a.prompt_tokens,
+            "n_steps": eng.n_steps,
+            "idle": (eng.idle and not self._replay_pending
+                     and not self._finished),
+            "warmed": self.warmed,
+        }
+
+    def _partials(self) -> Dict[str, List[int]]:
+        out: Dict[str, List[int]] = {}
+        for rid in self.engine.in_flight_ids():
+            toks = self.engine.partial_tokens(rid)
+            if toks is not None:
+                out[rid] = toks
+        return out
+
+    def op_submit(self, doc: dict) -> dict:
+        if self.draining:
+            return {"accepted": False,
+                    "rejection": result_to_wire(RequestResult(
+                        id=doc["req"]["id"], tokens=[],
+                        finish_reason=REJECT_REPLICA_DOWN))}
+        req = request_from_wire(doc["req"], self.clock())
+        rej = self.engine.submit(req)
+        if rej is None:
+            return {"accepted": True}
+        return {"accepted": False, "rejection": result_to_wire(rej)}
+
+    def op_step(self, doc: dict) -> dict:
+        for rid in doc.get("acks", []):
+            self._finished.pop(rid, None)
+        self._retry_replays()
+        for res in self.engine.step():
+            self._finished[res.id] = res
+        return {
+            "finished": [result_to_wire(r)
+                         for r in self._finished.values()],
+            "partials": self._partials(),
+            **self._gauges(),
+        }
+
+    def op_stream_drain(self, doc: dict) -> dict:
+        return {"partials": self._partials(), **self._gauges()}
+
+    def op_cancel(self, doc: dict) -> dict:
+        rid = doc["id"]
+        migrated = bool(doc.get("migrated"))
+        found = self.engine.cancel(rid, migrated=migrated)
+        if not found:
+            # a replay-pending id is in flight too (journal says so):
+            # cancelling it must journal a finish or a future restart
+            # would resurrect it
+            for i, req in enumerate(self._replay_pending):
+                if req.id == rid:
+                    del self._replay_pending[i]
+                    if self.journal is not None:
+                        self.journal.record_finish(rid, FINISH_CANCELLED)
+                    found = True
+                    break
+        return {"found": found}
+
+    def op_prefix(self, doc: dict) -> dict:
+        import numpy as np
+        prompt = np.asarray(doc["prompt"],
+                            np.int32)
+        return {"tokens": int(
+            self.engine.pool.cached_prefix_tokens(prompt))}
+
+    def op_health(self, doc: dict) -> dict:
+        return {
+            "pid": os.getpid(),
+            "vocab_size": int(self.engine.cfg.vocab_size),
+            "in_flight": self._in_flight_ids(),
+            "replayed": self.n_replayed,
+            "draining": self.draining,
+            "counters": {k: int(v) for k, v in
+                         self.engine.metrics.counters.items()},
+            **self._gauges(),
+        }
+
+    def op_summary(self, doc: dict) -> dict:
+        from .engine import engine_summary_block
+        return {"block": engine_summary_block(self.engine)}
+
+    def op_drain(self, doc: dict) -> dict:
+        """Rolling-restart drain: refuse new submits, cancel everything
+        in flight as migrated (the journal records the finishes, so the
+        NEXT incarnation's replay resurrects none of it)."""
+        self.draining = True
+        ids = self._in_flight_ids()
+        for rid in list(self.engine.in_flight_ids()):
+            self.engine.cancel(rid, migrated=True)
+        for req in self._replay_pending:
+            if self.journal is not None:
+                self.journal.record_finish(req.id, FINISH_CANCELLED)
+        self._replay_pending = []
+        return {"cancelled": ids}
+
+    def op_shutdown(self, doc: dict) -> dict:
+        asyncio.get_running_loop().call_soon(self.stop_event.set)
+        return {"stopping": True}
+
+
+def _write_ready_file(path: str, doc: dict) -> None:
+    """Atomic (tmp + rename): the supervisor polling this file must
+    never read a torn JSON."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def warm_engine(engine: Engine) -> None:
+    """One throwaway greedy token through prefill + decode, so
+    readiness implies compiled programs (no journal attached yet — a
+    warmup request must never appear in a crash journal)."""
+    import numpy as np
+
+    from .requests import SamplingParams
+    engine.submit(Request(id="__warmup__",
+                          prompt=np.zeros((1,), np.int32),
+                          max_new_tokens=1,
+                          sampling=SamplingParams(greedy=True)))
+    engine.drain()
+
+
+async def _run_async(worker: WorkerServer, host: str, port: int,
+                     ready_file: Optional[str], gen: int) -> int:
+    server = await asyncio.start_server(
+        lambda r, w: serve_connection(r, w, worker.dispatch),
+        host, port)
+    bound = server.sockets[0].getsockname()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, worker.stop_event.set)
+        except NotImplementedError:   # non-Unix event loops
+            pass
+    print(f"worker listening on {bound[0]}:{bound[1]} "
+          f"pid={os.getpid()} gen={gen} "
+          f"replayed={worker.n_replayed}", file=sys.stderr)
+    if ready_file:
+        _write_ready_file(ready_file, {
+            "port": bound[1], "pid": os.getpid(), "gen": gen,
+            "replayed": worker.n_replayed})
+    await worker.stop_event.wait()
+    server.close()
+    await server.wait_closed()
+    # let an in-flight shutdown response flush before the process exits
+    await asyncio.sleep(0.05)
+    return 0
+
+
+def run_worker(args) -> int:
+    """The serve-worker subcommand body (see cli.py for the flags)."""
+    from ..config import config_from_args
+    from ..train.state import create_train_state
+    import jax
+
+    cfg = config_from_args(args)
+    state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
+                               cfg.model, cfg.train)
+    if args.checkpoint_dir:
+        from ..train.checkpoint import CheckpointManager
+        restored = (CheckpointManager(args.checkpoint_dir)
+                    .restore_latest(state))
+        if restored is None:
+            print("no checkpoint found; serving random init",
+                  file=sys.stderr)
+        else:
+            state = restored
+    ecfg = EngineConfig(pool_size=args.pool_size,
+                        max_queue=args.max_queue,
+                        prefill_chunk=args.prefill_chunk,
+                        page_size=args.page_size, n_pages=args.n_pages,
+                        prefix_cache=not args.no_prefix_cache)
+    engine = Engine(state.params, cfg.model, ecfg)
+    warm_engine(engine)
+
+    journal = None
+    if args.journal:
+        journal = RequestJournal(args.journal,
+                                 fsync_finish=not args.no_fsync,
+                                 lock=True)
+        engine.journal = journal
+    worker = WorkerServer(engine, journal)
+    worker.warmed = True
+    if args.journal:
+        n = worker.replay_journal(args.journal)
+        if n:
+            print(f"journal replay: {n} unfinished request(s) "
+                  f"resubmitted", file=sys.stderr)
+    try:
+        return asyncio.run(_run_async(worker, args.host, args.port,
+                                      args.ready_file, args.gen))
+    finally:
+        if journal is not None:
+            journal.close()

@@ -433,6 +433,7 @@ def test_stream_drains_ledger_before_done_event():
     app.router = router
     app.idle_sleep_s = 0.0
     app.step_wait_s = 0.0
+    app.idle_timeout_s = 0.0
     app._step_fut = None
     w = FakeWriter()
     asyncio.run(app._stream("r", w))
@@ -516,17 +517,95 @@ def test_serve_cli_subprocess_smoke(tmp_path):
         proc.stderr.close()
 
 
-def test_healthz_and_metrics(params):
+@pytest.mark.multiproc
+@pytest.mark.slow
+def test_serve_cli_multiproc_subprocess_smoke(tmp_path):
+    """`python -m replicatinggpt_tpu serve --multiproc` end to end:
+    the serve process spawns a real worker subprocess, /readyz gates
+    on the warmed worker, a /v1/generate SSE round trip decodes
+    through the RPC protocol, and SIGINT shuts the whole tree down
+    (worker journal lock freed, records flushed)."""
+    import http.client
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    jdir = tmp_path / "journals"
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "replicatinggpt_tpu", "serve",
+         "--preset", "test-tiny", "--replicas", "1", "--port", "0",
+         "--pool-size", "2", "--multiproc",
+         "--journal-dir", str(jdir)],
+        stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        port = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            line = proc.stderr.readline()
+            if not line and proc.poll() is not None:
+                raise AssertionError("serve exited before binding")
+            m = re.search(r"serving on http://[\d.]+:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port is not None, "never saw the serving banner"
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/readyz")
+        r = conn.getresponse()
+        ready = json.loads(r.read())
+        assert r.status == 200 and ready["ok"], ready
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/v1/generate", json.dumps(
+            {"prompt": [1, 2, 3], "max_new_tokens": 4, "greedy": True}))
+        r = conn.getresponse()
+        assert r.status == 200
+        events = _sse_events(r.read())
+        toks = [d["token"] for ev, d in events if ev == "message"]
+        done = [d for ev, d in events if ev == "done"]
+        assert len(toks) == 4
+        assert len(done) == 1 and done[0]["finish_reason"] == "max_tokens"
+
+        proc.send_signal(signal.SIGINT)
+        rc = proc.wait(timeout=60)
+        assert rc == 0
+        recs = (jdir / "worker0.jsonl").read_text()
+        assert '"ev": "submit"' in recs and '"ev": "finish"' in recs
+        # the worker process died with the tree: its flock is free
+        from replicatinggpt_tpu.serve import RequestJournal
+        RequestJournal(str(jdir / "worker0.jsonl"), lock=True).close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        proc.stderr.close()
+
+
+def test_healthz_readyz_and_metrics(params):
+    """Liveness vs readiness: /healthz answers 200 whenever the server
+    process is up (external supervisors RESTART on its failure);
+    /readyz answers 200 iff >= 1 routable warmed replica can take
+    traffic (load balancers GATE on it) — 503 through a drain of every
+    replica, 200 again on undrain, and still 503-from-readyz (but
+    200-from-healthz) once every replica is dead."""
     async def main():
         app = _app(params, n_replicas=2)
         host, port = await app.start()
         router = app.router
         try:
             st, body = await _request(host, port, "GET", "/healthz")
-            assert st == 200 and body["ok"]
+            assert st == 200 and body["ok"] and body["live"]
             assert len(body["replicas"]) == 2
             assert {"alive", "wedged", "queue_depth", "slots_active",
                     "pages_in_use"} <= set(body["replicas"][0])
+            st, body = await _request(host, port, "GET", "/readyz")
+            assert st == 200 and body["ok"]
+            assert body["ready_replicas"] == 2
             st, _ = await _request(host, port, "POST", "/v1/submit",
                                    {"id": "m", "prompt": [4],
                                     "max_new_tokens": 2,
@@ -537,11 +616,67 @@ def test_healthz_and_metrics(params):
             text = raw.decode()
             assert "tpu_gpt_fleet_fleet_requests_routed" in text
             assert "tpu_gpt_fleet_replica0_queue_depth" in text
-            # no routable replica -> 503 (kill both in-process)
+            # drain every replica (the single-survivor rolling-restart
+            # window): NOT ready, but still very much alive
+            router.drain_replica(0)
+            router.drain_replica(1)
+            st, body = await _request(host, port, "GET", "/readyz")
+            assert st == 503 and not body["ok"]
+            assert body["draining"] == [0, 1]
+            st, body = await _request(host, port, "GET", "/healthz")
+            assert st == 200 and body["live"]
+            router.undrain_replica(0)
+            st, body = await _request(host, port, "GET", "/readyz")
+            assert st == 200 and body["ready_replicas"] == 1
+            # both replicas dead: readiness 503, liveness still 200 —
+            # restarting the ROUTER would not help a dead fleet
+            router.undrain_replica(1)
             router._kill(0, router.n_steps)
             router._kill(1, router.n_steps)
+            st, body = await _request(host, port, "GET", "/readyz")
+            assert st == 503 and body["n_alive"] == 0
             st, body = await _request(host, port, "GET", "/healthz")
-            assert st == 503 and not body["ok"]
+            assert st == 200 and body["live"]
+        finally:
+            await app.stop()
+
+    asyncio.run(main())
+
+
+def test_slow_loris_connections_are_dropped(params):
+    """The idle-socket satellite: a peer that never completes its
+    headers, or promises a body it never sends, is answered 408 and
+    dropped after idle_timeout_s instead of pinning a handler task
+    forever. A fast client on the same server is unaffected."""
+    async def main():
+        app = _app(params)
+        app.idle_timeout_s = 0.3
+        host, port = await app.start()
+        try:
+            # stall mid-headers
+            r, w = await asyncio.open_connection(host, port)
+            w.write(b"POST /v1/submit HTTP/1.1\r\nHost:")   # never \r\n\r\n
+            await w.drain()
+            t0 = asyncio.get_event_loop().time()
+            data = await asyncio.wait_for(r.read(), timeout=10)
+            took = asyncio.get_event_loop().time() - t0
+            assert b" 408 " in data.split(b"\r\n", 1)[0]
+            assert b"request idle timeout" in data
+            assert took < 5, f"loris held the handler {took:.1f}s"
+            w.close()
+            await w.wait_closed()
+            # stall mid-body (Content-Length promised, body withheld)
+            r, w = await asyncio.open_connection(host, port)
+            w.write(b"POST /v1/submit HTTP/1.1\r\nHost: t\r\n"
+                    b"Content-Length: 64\r\n\r\n{\"pro")
+            await w.drain()
+            data = await asyncio.wait_for(r.read(), timeout=10)
+            assert b" 408 " in data.split(b"\r\n", 1)[0]
+            w.close()
+            await w.wait_closed()
+            # an honest client still gets served
+            st, body = await _request(host, port, "GET", "/healthz")
+            assert st == 200 and body["ok"]
         finally:
             await app.stop()
 
