@@ -388,7 +388,8 @@ def bench_serve(args) -> None:
     ecfg = EngineConfig(pool_size=args.serve_pool,
                         max_queue=2 * args.serve_requests,
                         page_size=args.serve_page_size,
-                        n_pages=args.serve_n_pages)
+                        n_pages=args.serve_n_pages,
+                        decode_window=args.decode_window)
     summary = run_replay(state.params, cfg.model, rcfg, ecfg,
                          draft_params=draft_params, draft_cfg=draft_cfg,
                          resilience=DEFAULT_SERVE_RESILIENCE,
@@ -400,6 +401,49 @@ def bench_serve(args) -> None:
     h = summary["histograms"]
     sp = summary.get("speculative") or {}
     pg = summary["pages"]
+    dp = summary.get("dispatch", {})
+    dispatch_split: dict = {}
+    # spec mode keeps the verify program as the steady-state dispatch
+    # (windows only engage while speculation is degraded), so the
+    # blocked-vs-amortized A/B is only meaningful without a drafter
+    if args.decode_window > 1 and spec_mode == "off":
+        # the serve-side dispatch split the train bench has had since
+        # BENCH_r03 (77.4 ms blocked vs 12.1 ms/step amortized at k=25):
+        # replay the SAME request set at BOTH window sizes and compare
+        # host-overhead per decoded token. Both arms run at a
+        # saturating arrival rate — the split measures steady-state
+        # dispatch amortization, and a trickling trace would instead
+        # measure how often admissions break windows (a workload
+        # property the headline replay above already reflects)
+        import dataclasses
+        dense = dataclasses.replace(rcfg,
+                                    rate=max(rcfg.rate, 10_000.0))
+        windowed = run_replay(state.params, cfg.model, dense, ecfg,
+                              resilience=DEFAULT_SERVE_RESILIENCE)
+        blocked = run_replay(state.params, cfg.model, dense,
+                             dataclasses.replace(ecfg, decode_window=1),
+                             resilience=DEFAULT_SERVE_RESILIENCE)
+        wdp = windowed.get("dispatch", {})
+        bdp = blocked.get("dispatch", {})
+        amortized = wdp.get("host_dispatch_ms_per_token", 0.0)
+        per_tok_blocked = bdp.get("host_dispatch_ms_per_token", 0.0)
+        # the headline replay's numbers stay the top-level
+        # decode_window_k / decode_dispatch_ms /
+        # host_dispatch_ms_per_token keys; this block is the dense A/B
+        dispatch_split = {
+            "host_ms_per_token": amortized,
+            "host_ms_per_token_blocked": per_tok_blocked,
+            "host_overhead_speedup": (
+                round(per_tok_blocked / amortized, 3)
+                if amortized > 0 else 0.0),
+            "recompiles_after_warmup_blocked":
+                blocked["recompiles_after_warmup"],
+        }
+        log(f"dispatch split (saturating-rate A/B): host "
+            f"{per_tok_blocked:.3f} ms/token blocked (k=1) vs "
+            f"{amortized:.3f} ms/token amortized "
+            f"(k={args.decode_window}) -> "
+            f"{dispatch_split['host_overhead_speedup']}x")
     prefix_ab: dict = {}
     if args.serve_prefix_trace:
         # same trace, radix prefix cache OFF: the TTFT delta isolates
@@ -444,6 +488,12 @@ def bench_serve(args) -> None:
         "batch_fill_mean": round(
             h.get("batch_fill_ratio", {}).get("mean", 0), 3),
         "recompiles_after_warmup": summary["recompiles_after_warmup"],
+        # async-engine dispatch amortization (the BENCH_r03 gap's serve
+        # proxy): mean host ms per decode dispatch + the chosen window
+        "decode_window_k": dp.get("window_k", 1),
+        "decode_dispatch_ms": dp.get("mean_dispatch_ms", 0.0),
+        "host_dispatch_ms_per_token": dp.get("host_dispatch_ms_per_token",
+                                             0.0),
         "device_kind": dev.device_kind,
         # paged KV pool health (serve/pages.py) — the dashboard keys the
         # acceptance criteria name explicitly
@@ -460,6 +510,7 @@ def bench_serve(args) -> None:
                      for k in ("watchdog_stalls", "spec_disables",
                                "spec_reprobes", "shed_requests")},
         **({"speculative": sp} if sp else {}),
+        **({"dispatch_split": dispatch_split} if dispatch_split else {}),
         **({"prefix_ab": prefix_ab} if prefix_ab else {}),
         # observability artifacts (utils.telemetry): paths + counts of
         # the Perfetto trace / metrics timeline / Prometheus text this
@@ -1053,6 +1104,13 @@ def main() -> None:
     p.add_argument("--serve-n-pages", type=int, default=0,
                    help="--mode serve: physical KV pages (0 = "
                         "pool * pages-per-slot, the contiguous pool's HBM)")
+    p.add_argument("--decode-window", type=int, default=8,
+                   help="--mode serve: decode steps rolled into one "
+                        "jitted dispatch at steady state (the async "
+                        "engine window; 1 = the blocked per-token "
+                        "loop). When > 1 the artifact carries the "
+                        "dispatch split: blocked (k=1) vs amortized "
+                        "host-overhead per token on the same trace")
     p.add_argument("--trace-out", default=None,
                    help="--mode serve: write a Perfetto-loadable Chrome "
                         "trace of the replay (one span tree per request "

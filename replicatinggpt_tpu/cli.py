@@ -256,7 +256,8 @@ def cmd_serve_replay(args) -> int:
     ecfg = EngineConfig(pool_size=args.pool_size, max_queue=args.max_queue,
                         prefill_chunk=args.prefill_chunk,
                         page_size=args.page_size, n_pages=args.n_pages,
-                        prefix_cache=not args.no_prefix_cache)
+                        prefix_cache=not args.no_prefix_cache,
+                        decode_window=args.decode_window)
     draft_params = draft_cfg = None
     if rcfg.spec == "model":
         from .models.gpt import init_params, param_count
@@ -349,7 +350,8 @@ def cmd_serve(args) -> int:
                        "--max-queue", str(args.max_queue),
                        "--prefill-chunk", str(args.prefill_chunk),
                        "--page-size", str(args.page_size),
-                       "--n-pages", str(args.n_pages)]
+                       "--n-pages", str(args.n_pages),
+                       "--decode-window", str(args.decode_window)]
         if args.no_prefix_cache:
             engine_args.append("--no-prefix-cache")
         if args.no_fsync:
@@ -389,7 +391,8 @@ def cmd_serve(args) -> int:
                          max_queue=args.max_queue,
                          prefill_chunk=args.prefill_chunk,
                          page_size=args.page_size, n_pages=args.n_pages,
-                         prefix_cache=not args.no_prefix_cache),
+                         prefix_cache=not args.no_prefix_cache,
+                         decode_window=args.decode_window),
             telemetry=telemetry)
     app = ServeApp(router, idle_timeout_s=args.idle_timeout_s,
                    supervisor=supervisor)
@@ -555,6 +558,13 @@ def main(argv=None) -> int:
     ps.add_argument("--no-prefix-cache", action="store_true",
                     help="disable radix prefix reuse (pages only) — "
                          "the A/B arm for prefix-hit TTFT claims")
+    ps.add_argument("--decode-window", type=int, default=1,
+                    help="decode steps rolled into ONE jitted dispatch "
+                         "at steady state (async engine; 1 = blocked "
+                         "step-per-dispatch loop). The engine falls "
+                         "back to k=1 around admissions, deadlines, "
+                         "cancels and speculative verify/re-probe — "
+                         "see docs/serving.md#async-engine")
     ps.add_argument("--shared-prefix-len", type=int, default=0,
                     help="--prompt-mode shared_prefix: common prefix "
                          "length (0 = prompt-len-max // 2)")
@@ -651,6 +661,9 @@ def main(argv=None) -> int:
     pv.add_argument("--page-size", type=int, default=0)
     pv.add_argument("--n-pages", type=int, default=0)
     pv.add_argument("--no-prefix-cache", action="store_true")
+    pv.add_argument("--decode-window", type=int, default=1,
+                    help="decode steps per dispatch at steady state "
+                         "(per replica; see docs/serving.md#async-engine)")
     pv.add_argument("--multiproc", action="store_true",
                     help="run replicas as real worker PROCESSES "
                          "(serve-worker) under the process supervisor: "
@@ -714,6 +727,7 @@ def main(argv=None) -> int:
     pw.add_argument("--page-size", type=int, default=0)
     pw.add_argument("--n-pages", type=int, default=0)
     pw.add_argument("--no-prefix-cache", action="store_true")
+    pw.add_argument("--decode-window", type=int, default=1)
     pw.set_defaults(fn=cmd_serve_worker)
 
     pe = sub.add_parser("eval", help="estimate train/val loss")

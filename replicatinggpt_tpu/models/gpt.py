@@ -916,7 +916,7 @@ def _gather_pages(c_layer: jnp.ndarray, tables: jnp.ndarray,
 def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
                       active: jnp.ndarray, tables: jnp.ndarray,
                       cache: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
-                      use_pallas: bool = False
+                      use_pallas: bool = False, use_fused: bool = False
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """``decode_step_multi`` over a PAGED pool: per-slot positions are
     logical, and each slot's K/V is gathered through its page table.
@@ -948,6 +948,25 @@ def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     x = x[:, None, :]  # (B, 1, C)
     phys = tables[bidx, jnp.minimum(pos_eff // psz, mp - 1)]
     woff = jnp.where(active, pos_eff % psz, psz)   # inactive -> dropped
+
+    if use_fused:
+        # ONE Pallas launch for the whole layer stack: the page table
+        # rides scalar-prefetch SMEM so each (layer, slot) grid step
+        # streams only the slot's LIVE pages (ops/decode_pallas.py,
+        # fused_paged_decode_layers). Packed layout only; the caller
+        # gates on fused_paged_decode_supported. The kernel attends the
+        # STALE pool + fresh column (bit-equivalent to write-then-
+        # attend), so every layer's fresh K/V row scatters afterwards —
+        # drop-routed exactly like the XLA path's per-layer writes.
+        from ..ops.decode_pallas import fused_paged_decode_layers
+        x_row, newk, newv = fused_paged_decode_layers(
+            x[:, 0, :], params["blocks"], pos_eff, tables, cache, cfg)
+        ck = cache["k"].at[:, phys, woff, :].set(
+            newk.astype(cache["k"].dtype), mode="drop")
+        cv = cache["v"].at[:, phys, woff, :].set(
+            newv.astype(cache["v"].dtype), mode="drop")
+        return (_decode_head(x_row[:, None, :], params, cfg, cd),
+                {"k": ck, "v": cv})
 
     def body(carry, inputs):
         h_in, ck, cv = carry
@@ -1011,6 +1030,64 @@ def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
             carry, _ = body(carry, (lp, i))
         x, new_k, new_v = carry
     return _decode_head(x, params, cfg, cd), {"k": new_k, "v": new_v}
+
+
+def decode_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
+                        active: jnp.ndarray, budget: jnp.ndarray,
+                        eos: jnp.ndarray, tables: jnp.ndarray,
+                        cache: Dict[str, jnp.ndarray], rngs: jnp.ndarray,
+                        cfg: ModelConfig, *, sample_fn, length: int,
+                        use_pallas: bool = False, use_fused: bool = False):
+    """``length`` decode steps over the paged pool in ONE traced program
+    — the device-resident loop the async serving engine dispatches once
+    per WINDOW instead of once per token (the lax.scan analogue of the
+    training loop's steps-per-dispatch amortization; BENCH_r03 measured
+    the per-dispatch host tax this removes at 65 ms/step on TPU).
+
+    tok/pos/active: the per-slot step state ``decode_step_paged`` takes;
+    budget: (B,) int32 tokens each slot may still emit; eos: (B,) int32
+    per-slot stop token (< 0 = disabled); rngs: (B, key) sampling
+    streams; ``sample_fn(rngs, logits) -> (tokens, new_rngs)`` is the
+    caller's sampler (injected so this module does not depend on
+    sample.generate). Per step every ACTIVE slot decodes exactly as a
+    standalone ``decode_step_paged`` + sample would — per-row math,
+    masking and RNG stream advance are identical, which is what keeps a
+    windowed greedy stream byte-identical to the step-at-a-time one —
+    then the slot's budget decrements and its on-device active flag
+    drops when the budget hits zero or the sampled token == eos. A slot
+    that finishes mid-window therefore IDLES inside the window (writes
+    dropped, emissions masked off) instead of forcing an early exit: the
+    window width is static, so partial windows never compile a second
+    program. The window's last real write position is bounded host-side
+    by the caller (pos + budget <= logical capacity — the admission
+    cap's invariant).
+
+    Returns ``(toks, emitted, tok, pos, active, budget, cache, rngs)``:
+    toks/emitted are (length, B) — the sampled token and whether the
+    slot was live at each step (``emitted[:, b]`` is a prefix mask: a
+    slot deactivates once and never re-arms inside a window); the rest
+    is the advanced step state the caller feeds to the NEXT window
+    (donated end to end by the engine's jit wrapper).
+    """
+    def body(carry, _):
+        tok, pos, active, budget, cache, rngs = carry
+        logits, cache = decode_step_paged(
+            params, tok, pos, active, tables, cache, cfg,
+            use_pallas=use_pallas, use_fused=use_fused)
+        nxt, rngs = sample_fn(rngs, logits)
+        nxt = jnp.where(active, nxt, 0)
+        emitted = active
+        budget = jnp.where(active, budget - 1, budget)
+        hit_eos = active & (eos >= 0) & (nxt == eos)
+        pos = jnp.where(emitted, pos + 1, pos)
+        tok = jnp.where(emitted, nxt, tok)
+        active = active & (budget > 0) & ~hit_eos
+        return (tok, pos, active, budget, cache, rngs), (nxt, emitted)
+
+    carry = (tok, pos, active, budget, cache, rngs)
+    (tok, pos, active, budget, cache, rngs), (toks, emitted) = jax.lax.scan(
+        body, carry, None, length=length)
+    return toks, emitted, tok, pos, active, budget, cache, rngs
 
 
 def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,

@@ -38,8 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .flash_pallas import (NEG_INF, _compiler_params, _interpret_mode,
-                           _smem_spec, _vmem_spec, pltpu)
+from .flash_pallas import (LANES, NEG_INF, _compiler_params,
+                           _interpret_mode, _smem_spec, _vmem_spec, pltpu)
 
 # Per-layer VMEM budget for the fused kernel: weights (qkv C*3C + proj
 # C*C + mlp 2*C*4C), the (H, S, D) k/v cache blocks, and the (S, lanes)
@@ -343,3 +343,210 @@ def fused_decode_layers(x0: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
     cv = jax.lax.dynamic_update_slice(
         cache["v"], newv_u.astype(cache["v"].dtype), start)
     return xout, {"k": ck, "v": cv}
+
+
+# ---------------------------------------------------------------------------
+# Fused PAGED decode: the all-layers kernel above, page-table-aware
+# ---------------------------------------------------------------------------
+
+def fused_paged_decode_supported(cfg, n_slots: int, page_size: int,
+                                 itemsize: int = 2) -> bool:
+    """Envelope for ``fused_paged_decode_layers``: packed cache layout,
+    lane-sliceable heads, sublane-aligned pages, per-head accumulator
+    lanes available, and one layer's weights + a double-buffered page
+    pair + the (n_slots, C) residual scratch within FUSED_LAYER_BYTES.
+    The serve engine prefers this route over the per-layer paged kernel
+    (ops/paged_pallas.py) whenever it fits — one launch per decode step
+    instead of one per layer."""
+    if cfg.decode_cache_layout != "packed":
+        return False
+    C, H = cfg.n_embd, cfg.n_head
+    if C % H != 0:
+        return False
+    D = C // H
+    if D not in (32, 64, 128, 256) or H > LANES:
+        return False
+    if page_size % 8 != 0:
+        return False
+    if pltpu is None:
+        return False
+    weights = (C * 3 * C + C * C + 2 * C * 4 * C) * itemsize
+    pages = 2 * page_size * C * itemsize
+    scratch = (n_slots + 3) * C * itemsize + C * 4 + 2 * LANES * 4
+    return weights + pages + scratch <= FUSED_LAYER_BYTES
+
+
+def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
+                        wqkv_ref, bqkv_ref, wproj_ref, bproj_ref, ln2s_ref,
+                        ln2b_ref, wup_ref, bup_ref, wdown_ref, bdown_ref,
+                        kp_ref, vp_ref, xout_ref, newk_ref, newv_ref,
+                        x_scr, q_scr, knew_scr, vnew_scr, acc_ref, m_ref,
+                        l_ref, *, n_layer, n_head, head_dim, page_size,
+                        n_pages_per_slot, eps, scale, activation):
+    """Grid (layer, slot, logical page), all sequential: the residual
+    row of every slot is carried across layer steps in VMEM scratch
+    (exactly ``_decode_kernel``'s trick, widened to B rows), each
+    slot's QKV projection runs once at its first page step, attention
+    accumulates online-softmax across its LIVE pages (the block index
+    map repeats the previous physical page past the frontier, skipping
+    the DMA — ops/paged_pallas.clamped_live_page), and the block tail
+    (proj/ln2/MLP/residual) lands at the last page step. Layer weights
+    keep a constant block index across the whole (slot, page) subgrid,
+    so they stream exactly once per layer."""
+    l = pl.program_id(0)
+    b = pl.program_id(1)
+    p = pl.program_id(2)
+    H, D, psz = n_head, head_dim, page_size
+    C = H * D
+    pos = pos_ref[b]
+    live = (pos + psz - 1) // psz        # pages holding positions < pos
+
+    @pl.when((l == 0) & (p == 0))
+    def _seed():
+        x_scr[pl.ds(b, 1), :] = x0_ref[...]
+
+    @pl.when(p == 0)
+    def _project():
+        x = x_scr[pl.ds(b, 1), :]
+        h = _ln_row(x, ln1s_ref[...], ln1b_ref[...], eps)
+        qkv = _row_matmul(h, wqkv_ref[...], bqkv_ref[...])   # (1, 3C)
+        q_scr[...] = qkv[:, :C]
+        knew_scr[...] = qkv[:, C:2 * C]
+        vnew_scr[...] = qkv[:, 2 * C:]
+        newk_ref[...] = qkv[:, C:2 * C]
+        newv_ref[...] = qkv[:, 2 * C:]
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(p < live)
+    def _accumulate():
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (psz, 1), 0) + p * psz
+        for i in range(H):
+            sl = slice(i * D, (i + 1) * D)
+            q = q_scr[:, sl].astype(jnp.float32)                 # (1, D)
+            kc = kp_ref[:, sl]                                   # (psz, D)
+            vc = vp_ref[:, sl]
+            s = jnp.sum(kc.astype(jnp.float32) * q, axis=-1,
+                        keepdims=True) * scale                   # (psz, 1)
+            s = jnp.where(kpos < pos, s, NEG_INF)
+            m_prev = m_ref[0, i]
+            m_new = jnp.maximum(m_prev, jnp.max(s))
+            alpha = jnp.exp(m_prev - m_new)
+            # masked rows contribute EXACTLY zero (not exp(0)): with a
+            # fully-masked page m_new stays NEG_INF and s - m_new == 0
+            pexp = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+            l_ref[0, i] = l_ref[0, i] * alpha + jnp.sum(pexp)
+            acc_ref[:, sl] = (acc_ref[:, sl] * alpha
+                              + jnp.sum(pexp * vc.astype(jnp.float32),
+                                        axis=0, keepdims=True))
+            m_ref[0, i] = m_new
+
+    @pl.when(p == n_pages_per_slot - 1)
+    def _finalize():
+        outs = []
+        for i in range(H):
+            sl = slice(i * D, (i + 1) * D)
+            q = q_scr[:, sl].astype(jnp.float32)
+            s_new = jnp.sum(knew_scr[:, sl].astype(jnp.float32)
+                            * q) * scale                         # scalar
+            m2 = jnp.maximum(m_ref[0, i], s_new)
+            alpha = jnp.exp(m_ref[0, i] - m2)
+            p_new = jnp.exp(s_new - m2)
+            denom = l_ref[0, i] * alpha + p_new   # >= p_new > 0 always
+            outs.append((acc_ref[:, sl] * alpha
+                         + p_new * vnew_scr[:, sl].astype(jnp.float32))
+                        / denom)
+        x = x_scr[pl.ds(b, 1), :]
+        attn = jnp.concatenate(outs, axis=1).astype(x.dtype)
+        attn = _row_matmul(attn, wproj_ref[...], bproj_ref[...])
+        x_mid = x + attn
+        h = _ln_row(x_mid, ln2s_ref[...], ln2b_ref[...], eps)
+        h = _row_matmul(h, wup_ref[...], bup_ref[...])
+        h = (jax.nn.gelu(h) if activation == "gelu" else jax.nn.relu(h))
+        h = _row_matmul(h.astype(x.dtype), wdown_ref[...], bdown_ref[...])
+        x_new = x_mid + h
+        x_scr[pl.ds(b, 1), :] = x_new
+        xout_ref[...] = x_new
+
+
+def fused_paged_decode_layers(x0: jnp.ndarray,
+                              blocks: Dict[str, jnp.ndarray],
+                              pos: jnp.ndarray, tables: jnp.ndarray,
+                              cache: Dict[str, jnp.ndarray], cfg
+                              ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                         jnp.ndarray]:
+    """Every transformer layer of one multi-slot PAGED decode step in
+    ONE Pallas call. x0: (B, C) embedded rows (compute dtype); pos:
+    (B,) int32 effective logical positions (inactive slots at 0);
+    tables: (B, max_pages) int32; cache: packed ``init_paged_kv_pool``
+    arrays (L, n_pages, page, C), STALE at ``pos``. Returns
+    ``(x (B, C), newk (L, B, C), newv (L, B, C))`` — the caller
+    scatters the fresh K/V rows through the page tables (drop-routed
+    for inactive slots), mirroring ``fused_decode_layers``'s
+    attend-stale-then-write contract."""
+    from .paged_pallas import clamped_live_page
+    L, N, psz, C = cache["k"].shape
+    H = cfg.n_head
+    D = C // H
+    B, mp = tables.shape
+    cd = x0.dtype
+    w = {k: v.astype(cd) for k, v in blocks.items()}
+    vec = lambda name: w[name].reshape(L, 1, -1)
+    kernel = functools.partial(
+        _paged_fused_kernel, n_layer=L, n_head=H, head_dim=D,
+        page_size=psz, n_pages_per_slot=mp, eps=cfg.layernorm_eps,
+        scale=D ** -0.5, activation=cfg.activation)
+    lrow = lambda width: _vmem_spec((None, 1, width),
+                                    lambda l, b, p, t, q: (l, 0, 0))
+    lmat = lambda a, c: _vmem_spec((None, a, c),
+                                   lambda l, b, p, t, q: (l, 0, 0))
+    brow = _vmem_spec((None, 1, C), lambda l, b, p, t, q: (b, 0, 0))
+
+    def page_map(l, b, p, tables, pos):
+        return (l, tables[b, clamped_live_page(p, pos[b], psz)], 0, 0)
+
+    page_spec = _vmem_spec((None, None, psz, C), page_map)
+    if pltpu is None:  # pragma: no cover — gated by
+        # fused_paged_decode_supported; explicit error over a pallas
+        # internals traceback
+        raise RuntimeError("fused_paged_decode_layers needs pallas TPU "
+                           "memory spaces "
+                           "(jax.experimental.pallas.tpu)")
+    scratch = [pltpu.VMEM((B, C), cd), pltpu.VMEM((1, C), cd),
+               pltpu.VMEM((1, C), cd), pltpu.VMEM((1, C), cd),
+               pltpu.VMEM((1, C), jnp.float32),
+               pltpu.VMEM((1, LANES), jnp.float32),
+               pltpu.VMEM((1, LANES), jnp.float32)]
+    kw = {}
+    cp = _compiler_params(0, 3)
+    if cp is not None:
+        kw["compiler_params"] = cp
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(L, B, mp),
+        in_specs=[brow,
+                  lrow(C), lrow(C), lmat(C, 3 * C), lrow(3 * C),
+                  lmat(C, C), lrow(C), lrow(C), lrow(C),
+                  lmat(C, 4 * C), lrow(4 * C), lmat(4 * C, C), lrow(C),
+                  page_spec, page_spec],
+        out_specs=[brow,
+                   _vmem_spec((None, None, 1, C),
+                              lambda l, b, p, t, q: (l, b, 0, 0)),
+                   _vmem_spec((None, None, 1, C),
+                              lambda l, b, p, t, q: (l, b, 0, 0))],
+        scratch_shapes=scratch,
+    )
+    xout, newk, newv = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((B, 1, C), cd),
+                   jax.ShapeDtypeStruct((L, B, 1, C), cd),
+                   jax.ShapeDtypeStruct((L, B, 1, C), cd)],
+        interpret=_interpret_mode(), **kw,
+    )(jnp.asarray(tables, jnp.int32), jnp.asarray(pos, jnp.int32),
+      x0[:, None, :],
+      vec("ln1_scale"), vec("ln1_bias"), w["qkv_kernel"], vec("qkv_bias"),
+      w["attn_out_kernel"], vec("attn_out_bias"), vec("ln2_scale"),
+      vec("ln2_bias"), w["mlp_up_kernel"], vec("mlp_up_bias"),
+      w["mlp_down_kernel"], vec("mlp_down_bias"), cache["k"], cache["v"])
+    return xout[:, 0, :], newk[:, :, 0, :], newv[:, :, 0, :]

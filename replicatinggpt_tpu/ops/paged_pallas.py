@@ -49,6 +49,19 @@ def _paged_attn_backend_ok() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def clamped_live_page(p, pos, page_size: int):
+    """The fetch-skip trick, shared by every paged block index map
+    (this file's per-layer kernel and the fused all-layers kernel in
+    ops/decode_pallas.py): logical pages past a slot's live frontier
+    map to the SAME logical page as the previous grid step, and Pallas
+    skips the DMA for a repeated block index — so a slot at position
+    ``pos`` streams ceil(pos/page) pages regardless of max_pages. An
+    idle slot (pos == 0) clamps to page 0; its zero live pages are
+    never read (the accumulation loop is gated on ``p < live``)."""
+    live = (pos + page_size - 1) // page_size
+    return jnp.where(p < live, p, jnp.maximum(live - 1, 0))
+
+
 def paged_decode_supported(n_head: int, head_dim: int, page_size: int,
                            itemsize: int = 2) -> bool:
     """Envelope: lane-sliceable heads, sublane-aligned page length,
@@ -141,11 +154,9 @@ def paged_decode_attention(q: jnp.ndarray, k_new: jnp.ndarray,
         return (b, 0, 0)
 
     def page_map(b, p, tables, pos):
-        live = (pos[b] + psz - 1) // psz
         # past the frontier: repeat the previous step's physical page —
         # a repeated block index skips the DMA (the fetch-skip trick)
-        pm = jnp.where(p < live, p, jnp.maximum(live - 1, 0))
-        return (tables[b, pm], 0, 0)
+        return (tables[b, clamped_live_page(p, pos[b], psz)], 0, 0)
 
     row = _vmem_spec((None, 1, C), row_map)
     kw = {}

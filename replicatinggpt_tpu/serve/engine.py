@@ -17,15 +17,30 @@ Per step the engine:
    (``models.gpt.prefill_chunk_paged``) writes only the UNCACHED tail's
    K/V through the slot's page table, under ONE compiled program
    regardless of prompt length or prefix-hit length,
-3. runs ONE jitted ``decode_step_paged`` over ALL slots — per-slot
-   page tables, positions, active mask, RNG streams and sampling
-   params (``sample.generate.sample_tokens_batched``) — and
-   fetches the (n_slots,) sampled tokens. With a drafter attached
+3. runs ONE jitted decode dispatch over ALL slots — per-slot page
+   tables, positions, active mask, RNG streams and sampling params
+   (``sample.generate.sample_tokens_batched``). At steady state (no
+   admission, finish bookkeeping, or speculative re-probe pending) the
+   dispatch is a WINDOW of ``EngineConfig.decode_window`` decode steps
+   rolled into one program (``models.gpt.decode_window_paged``: a
+   lax.scan over the step body with per-slot budget/EOS masks computed
+   ON DEVICE, so a slot finishing mid-window idles inside it instead of
+   forcing an early exit), the step state ``(tok, pos, active, budget,
+   rngs)`` lives on the device and is DONATED from window to window
+   alongside the cache, and the host runs AHEAD of the device: window
+   N+1 is dispatched before window N's token block is fetched
+   (one async ``copy_to_host_async`` + ``np.asarray`` per window, not
+   one blocking snapshot per token — the BENCH_r03 dispatch-tax fix,
+   ROADMAP item 2). Anything that must mutate per-slot state host-side
+   (an admission, an active-deadline expiry, a cancel, a speculative
+   mode flip) first drains the in-flight window and falls back to a
+   blocked k=1 dispatch for that step. With a drafter attached
    (serve/speculative.py) the decode phase is instead ONE jitted
    ``_engine_verify``: score a static (k+1)-token drafted window per
    slot against the pooled cache and commit 1..k+1 accepted tokens —
    up to k+1 tokens per slot per full-model forward, interleaved with
-   chunked prefill admissions exactly like plain decode.
+   chunked prefill admissions exactly like plain decode (and with
+   multi-token decode windows while speculation is degraded).
 
 Zero recompiles at steady state: the decode/verify programs are keyed
 only on the (static) model config, pool/page shapes and draft width,
@@ -59,7 +74,7 @@ from ..config import ModelConfig
 from ..faults.inject import fire as fault_fire
 from ..faults.watchdog import (LoadShedder, ResilienceConfig, SpecHealth,
                                StepWatchdog)
-from ..models.gpt import (decode_step_paged, prefill_chunk_paged,
+from ..models.gpt import (decode_window_paged, prefill_chunk_paged,
                           verify_step_paged)
 from ..sample.generate import sample_tokens_batched
 from ..utils.logging import Metrics
@@ -67,9 +82,9 @@ from ..utils.profiling import StepTimer, annotate
 from ..utils.sanitize import CompileGuard, check_in_bounds, sanitize_enabled
 from ..utils.telemetry import ENGINE_TRACK, NULL, SLOT_TRACK_BASE
 from .pages import PagedCachePool
-from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_LENGTH_CAP,
-                       FINISH_MAX_TOKENS, FINISH_SHED, REJECT_BAD_REQUEST,
-                       Request, RequestResult)
+from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_EOS,
+                       FINISH_LENGTH_CAP, FINISH_MAX_TOKENS, FINISH_SHED,
+                       REJECT_BAD_REQUEST, Request, RequestResult)
 from .scheduler import Scheduler
 from .speculative import (DraftContext, Drafter, spec_accept_and_sample,
                           timed_draft)
@@ -93,13 +108,37 @@ class EngineConfig:
                               # pages shrinks HBM and admission gates on it
     prefix_cache: bool = True  # radix prefix reuse (False: pages only)
     paged_kernel: bool = False  # opt-in Pallas paged decode fast path
-                                # (TPU, packed cache layout only)
+                                # (TPU, packed cache layout only):
+                                # prefers the fused all-layers kernel
+                                # (ops/decode_pallas.py), falls back to
+                                # the per-layer one (ops/paged_pallas)
+    decode_window: int = 1      # decode steps rolled into one dispatch
+                                # at steady state (the --decode-window
+                                # knob): 1 = the blocked step-per-
+                                # dispatch loop; >1 enables the async
+                                # double-buffered window path — the
+                                # engine still falls back to k=1 for
+                                # any step with an admission, active-
+                                # deadline expiry, cancel, or
+                                # speculative verify/re-probe pending
 
     def chunk(self, block_size: int) -> int:
         """Effective prefill chunk — see ``cache_pool.prefill_chunk_size``
         for the divisor-rounding rule and why it is load-bearing."""
         from .cache_pool import prefill_chunk_size
         return prefill_chunk_size(self.prefill_chunk, block_size)
+
+    def warmup_tokens(self) -> int:
+        """Tokens a warmup request must generate so that warmup compiles
+        EVERY steady-state decode program: the admission step runs the
+        k=1 fallback, every later step a full window — so a windowed
+        engine needs the request to outlive the admission step by at
+        least one whole window (two, for slack against scheduling
+        details). ONE definition, shared by the replay warmup and the
+        worker's readiness warmup: they must never disagree, or one
+        deployment path compiles the window program mid-traffic and
+        breaks the recompiles_after_warmup == 0 invariant."""
+        return 1 if self.decode_window <= 1 else 2 * self.decode_window + 2
 
 
 @dataclass
@@ -116,28 +155,58 @@ class _Active:
     t_last_token: float = 0.0
 
 
-@partial(jax.jit, static_argnames=("cfg", "use_pallas"),
-         donate_argnames=("cache",))
-def _engine_decode(params, tok, pos, active, tables, cache, rngs, temp,
-                   top_k, top_p, greedy, cfg: ModelConfig,
-                   use_pallas: bool = False):
-    """The steady-state program: one multi-slot PAGED decode + batched
-    sample.
+@dataclass
+class _InFlight:
+    """One dispatched-but-not-yet-fetched decode window. ``toks`` and
+    ``emitted`` are the dispatch's (k, n_slots) device outputs; their
+    host copy starts the moment the dispatch launches
+    (``copy_to_host_async``) so the drain's ``np.asarray`` overlaps
+    device compute instead of stalling on it."""
+
+    toks: jax.Array               # (k, n_slots) sampled tokens
+    emitted: jax.Array            # (k, n_slots) bool live-at-step mask
+    k: int                        # static window width of the dispatch
+    t0_us: float                  # launch timestamp (telemetry clock)
+    t_wall: float                 # launch timestamp (perf_counter)
+    n_active: int                 # live slots at launch
+
+
+@partial(jax.jit, static_argnames=("cfg", "k", "use_pallas", "use_fused"),
+         donate_argnames=("tok", "pos", "active", "budget", "cache",
+                          "rngs"))
+def _engine_decode_window(params, tok, pos, active, budget, eos, tables,
+                          cache, rngs, temp, top_k, top_p, greedy,
+                          cfg: ModelConfig, k: int,
+                          use_pallas: bool = False,
+                          use_fused: bool = False):
+    """The steady-state program: ``k`` multi-slot PAGED decode + batched
+    sample steps in ONE dispatch (``models.gpt.decode_window_paged``),
+    with the whole per-slot step state ``(tok, pos, active, budget,
+    rngs)`` donated alongside the cache — at k > 1 the engine feeds each
+    window the previous window's returned state without ever touching
+    the host, so the old buffers alias the new in place.
 
     All request-level inputs are small traced arrays — the (n_slots,)
     step vectors plus the (n_slots, max_pages) page tables — so
     admissions/completions/prefix-hits/evictions/COW remaps never
-    retrace. Inactive slots run at position 0 with their cache writes
-    DROPPED inside ``decode_step_paged`` (a released slot's stale table
-    may reference pages another request now owns) and their sampled
-    token is masked to 0.
+    retrace, and the window width is static: a slot that exhausts its
+    budget or samples its eos token mid-window goes inactive ON DEVICE
+    and idles for the window's remainder (partial windows are a masked
+    tail, never a second program). Inactive slots run at position 0
+    with their cache writes DROPPED inside ``decode_step_paged`` (a
+    released slot's stale table may reference pages another request now
+    owns) and their sampled token is masked to 0.
     """
-    logits, cache = decode_step_paged(params, tok, pos, active, tables,
-                                      cache, cfg, use_pallas=use_pallas)
-    splits = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
-    nxt = sample_tokens_batched(splits[:, 0], logits, temp, top_k, top_p,
-                                greedy)
-    return jnp.where(active, nxt, 0), cache, splits[:, 1]
+    def sample_fn(rngs, logits):
+        splits = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
+        nxt = sample_tokens_batched(splits[:, 0], logits, temp, top_k,
+                                    top_p, greedy)
+        return nxt, splits[:, 1]
+
+    return decode_window_paged(params, tok, pos, active, budget, eos,
+                               tables, cache, rngs, cfg,
+                               sample_fn=sample_fn, length=k,
+                               use_pallas=use_pallas, use_fused=use_fused)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -147,7 +216,8 @@ def _engine_prefill(params, chunk, offset, limit, table_row, cache,
                                cache, cfg)
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("cache", "rngs"))
 def _engine_verify(params, window, pos, m, active, tables, cache, rngs,
                    temp, top_k, top_p, greedy, cfg: ModelConfig):
     """The speculative steady-state program: ONE target forward over a
@@ -213,7 +283,7 @@ def compile_counts() -> Dict[str, int]:
     per-engine via :class:`CompileGuard` (utils.sanitize), which raises
     from the offending step instead of reporting after the fact."""
     from .speculative import _draft_decode_k, _draft_prefill
-    return {"decode": _engine_decode._cache_size(),
+    return {"decode": _engine_decode_window._cache_size(),
             "prefill": _engine_prefill._cache_size(),
             "verify": _engine_verify._cache_size(),
             "page_copy": _engine_page_copy._cache_size(),
@@ -290,25 +360,44 @@ class Engine:
         self.step_timer = StepTimer()
         P = ecfg.pool_size
         self._chunk = ecfg.chunk(cfg.block_size)
+        self._window = max(int(ecfg.decode_window), 1)
         # Pallas paged-decode route: static per engine (one compiled
-        # program either way); packed layout + TPU backend + envelope
-        from ..ops import paged_pallas
+        # program either way); packed layout + TPU backend + envelope.
+        # The FUSED all-layers kernel (one launch per decode step,
+        # page-table scalar-prefetch inside the layer loop) is
+        # preferred; the per-layer paged-attention kernel is the
+        # fallback when the layer weights don't fit its VMEM envelope.
+        from ..ops import decode_pallas, paged_pallas
+        itemsize = jnp.dtype(self.pool.cache["k"].dtype).itemsize
+        kernel_ok = (ecfg.paged_kernel
+                     and cfg.decode_cache_layout == "packed"
+                     and paged_pallas._paged_attn_backend_ok())
+        self._use_fused = bool(
+            kernel_ok and decode_pallas.fused_paged_decode_supported(
+                cfg, P, self.pool.page_size, itemsize))
         self._use_pallas = bool(
-            ecfg.paged_kernel
-            and cfg.decode_cache_layout == "packed"
-            and paged_pallas._paged_attn_backend_ok()
+            kernel_ok and not self._use_fused
             and paged_pallas.paged_decode_supported(
-                cfg.n_head, cfg.head_dim, self.pool.page_size,
-                jnp.dtype(self.pool.cache["k"].dtype).itemsize))
+                cfg.n_head, cfg.head_dim, self.pool.page_size, itemsize))
         self._tok = np.zeros((P,), np.int32)
         # ALIAS of pool.positions (one host buffer): the pool exposes the
         # committed frontier to drafters, the engine advances it in place
         self._pos = self.pool.positions
         self._active = np.zeros((P,), bool)
+        self._budget = np.zeros((P,), np.int32)   # tokens still allowed
+        self._eos = np.full((P,), -1, np.int32)   # per-slot stop token
         self._temp = np.ones((P,), np.float32)
         self._top_k = np.zeros((P,), np.int32)
         self._top_p = np.zeros((P,), np.float32)
         self._greedy = np.zeros((P,), bool)
+        # async window machinery: the device-resident donated step state
+        # (tok, pos, active, budget) between window dispatches — None
+        # means "host mirrors are authoritative, re-upload at the next
+        # launch" — and the in-flight dispatch whose token block has
+        # not been fetched yet (double buffering: window N+1 launches
+        # before window N's block is read)
+        self._dev_state = None
+        self._inflight: Optional[_InFlight] = None
         # committed up front for the same jit-key stability reason as
         # CachePool.cache (the array becomes a committed jit output
         # after the first step)
@@ -325,7 +414,12 @@ class Engine:
         # the step that caused it. Replaces the ad-hoc two-program
         # bookkeeping the first serving PR shipped (compile_counts()
         # remains for offline summaries).
-        self._decode_guard = CompileGuard(_engine_decode, "serve/decode")
+        # a windowed engine legitimately owns TWO decode programs: the
+        # k=decode_window steady-state window and the k=1 fallback it
+        # drops to around admissions/finishes/spec transitions
+        self._decode_guard = CompileGuard(
+            _engine_decode_window, "serve/decode",
+            max_programs=2 if self._window > 1 else 1)
         self._prefill_guard = CompileGuard(_engine_prefill, "serve/prefill")
         self._verify_guard = CompileGuard(_engine_verify, "serve/verify")
         self._copy_guard = CompileGuard(_engine_page_copy, "serve/page-copy")
@@ -367,6 +461,14 @@ class Engine:
             self.metrics.inc(REJECT_BAD_REQUEST)
             return RequestResult(id=req.id, tokens=[],
                                  finish_reason=REJECT_BAD_REQUEST)
+        eos = req.eos_token_id
+        if eos is not None and not (0 <= int(eos) < self.cfg.vocab_size):
+            # the device-side stop mask compares sampled ids against
+            # this value; an out-of-vocab eos can never match and is a
+            # caller bug — reject it loudly
+            self.metrics.inc(REJECT_BAD_REQUEST)
+            return RequestResult(id=req.id, tokens=[],
+                                 finish_reason=REJECT_BAD_REQUEST)
         reason = self.scheduler.submit(req)
         if reason is not None:
             # an expired-at-submit deadline is a terminal finish, not a
@@ -401,6 +503,16 @@ class Engine:
         slot = self.pool.slot_of(request_id)
         if slot is None:
             return False
+        # cancel-during-window: fetch the in-flight dispatch first so
+        # the tokens it already committed ride the terminal result, and
+        # the slot + pages release at the window boundary — never while
+        # a dispatch that writes through the slot's table is in flight
+        self._pending.extend(self._drain_pending())
+        slot = self.pool.slot_of(request_id)
+        if slot is None:
+            # the drained window finished it naturally; its terminal
+            # result is already pending
+            return True
         self._pending.append(self._finish_slot(slot, FINISH_CANCELLED, now,
                                                migrated=migrated))
         return True
@@ -433,12 +545,22 @@ class Engine:
     @property
     def idle(self) -> bool:
         return (not self._active.any() and len(self.scheduler) == 0
-                and not self._pending)
+                and not self._pending and self._inflight is None)
 
     def step(self) -> List[RequestResult]:
         """One scheduling iteration: expire -> shed -> admit -> decode,
         with the self-healing policies (watchdog / speculative health /
-        shedding) folded around the decode phase when configured."""
+        shedding) folded around the decode phase when configured.
+
+        With ``decode_window > 1`` the steady-state decode phase is the
+        double-buffered window path: dispatch the NEXT k-step window,
+        then fetch the previous one's token block — the host stays one
+        window ahead of the device. Any step that must mutate per-slot
+        state host-side (admission possible, an active deadline
+        expired, a speculative verify or re-probe due) first drains the
+        in-flight window and runs the blocked k=1 (or verify) dispatch
+        instead; queued-deadline expiry and overload shedding are
+        host-only and never break a window."""
         finished: List[RequestResult] = self._pending
         self._pending = []
         now = self.clock()
@@ -448,12 +570,6 @@ class Engine:
         for req, t_submit, reason in self.scheduler.drain_expired(now):
             finished.append(self._finish_unstarted(req, t_submit, reason,
                                                    now))
-        for slot in list(self._slots):
-            dl = self._slots[slot].req.deadline
-            if dl is not None and now >= dl:
-                finished.append(self._finish_slot(slot, FINISH_DEADLINE,
-                                                  now))
-
         if self._shedder is not None:
             n_shed = self._shedder.observe(self.scheduler.depth,
                                            self.ecfg.max_queue)
@@ -466,38 +582,64 @@ class Engine:
                                    f"queued request(s) under sustained "
                                    f"overload")
 
-        # one-at-a-time admission: each _admit changes page availability,
-        # so the fits check must see fresh allocator state per request
-        # (FIFO preserved — a head that does not fit blocks the queue
-        # rather than being skipped, so big requests cannot starve)
-        while self.pool.n_free > 0:
-            admitted, dropped = self.scheduler.admit(1, now,
-                                                     fits=self._fits)
-            for req, t_submit, reason in dropped:
-                finished.append(self._finish_unstarted(req, t_submit,
-                                                       reason, now))
-            if not admitted:
-                break
-            req, t_submit = admitted[0]
-            self._admit(req, t_submit, now)
-
-        self.metrics.gauge("queue_depth", self.scheduler.depth)
-        self.metrics.gauge("slots_active", int(self._active.sum()))
-        self.metrics.gauge("slot_occupancy", self.pool.occupancy)
-        self.metrics.gauge("pages_in_use", self.pool.alloc.pages_in_use)
+        expired = [slot for slot in list(self._slots)
+                   if self._slots[slot].req.deadline is not None
+                   and now >= self._slots[slot].req.deadline]
 
         # speculative re-probe countdown while degraded (auto-disabled
         # only: an operator pin via set_spec_active(False) must stick)
+        reprobe = False
         if (self.drafter is not None and not self._spec_active
                 and not self._spec_pinned
                 and self._spec_health is not None
                 and self._active.any()):
-            if self._spec_health.tick_disabled():
+            reprobe = self._spec_health.tick_disabled()
+
+        use_spec = (self.drafter is not None
+                    and (self._spec_active or reprobe))
+        # steady state = nothing needs the host to touch per-slot state
+        # before the next dispatch. A deep backlog whose head cannot
+        # admit (pool full / not enough pages) does NOT break windows:
+        # arrivals batch up and admit at the next window boundary.
+        windowed = (self._window > 1 and not use_spec and not expired
+                    and not self._head_admissible()
+                    and bool(self._active.any()))
+
+        if not windowed:
+            # a host mutation is coming: fetch the in-flight window
+            # first — its tokens commit now, finished slots' pages and
+            # slots free at this window boundary
+            finished.extend(self._drain_pending())
+            for slot in expired:
+                if slot in self._slots:   # may have finished in the drain
+                    finished.append(self._finish_slot(
+                        slot, FINISH_DEADLINE, now))
+            if reprobe:
                 self.set_spec_active(True)
                 self._probe_pending = True
                 self.metrics.inc("spec_reprobes")
                 self._event(f"step {self.n_steps}: re-probing "
                                    f"speculative decoding")
+            # one-at-a-time admission: each _admit changes page
+            # availability, so the fits check must see fresh allocator
+            # state per request (FIFO preserved — a head that does not
+            # fit blocks the queue rather than being skipped, so big
+            # requests cannot starve)
+            while self.pool.n_free > 0:
+                admitted, dropped = self.scheduler.admit(1, now,
+                                                         fits=self._fits)
+                for req, t_submit, reason in dropped:
+                    finished.append(self._finish_unstarted(req, t_submit,
+                                                           reason, now))
+                if not admitted:
+                    break
+                req, t_submit = admitted[0]
+                self._admit(req, t_submit, now)
+
+        self.metrics.gauge("queue_depth", self.scheduler.depth)
+        self.metrics.gauge("slots_active", int(self._active.sum()))
+        self.metrics.gauge("slot_occupancy", self.pool.occupancy)
+        self.metrics.gauge("pages_in_use", self.pool.alloc.pages_in_use)
 
         # chaos seam: an artificially slow/stuck step (no-op without an
         # installed FaultPlan) — what the watchdog must catch
@@ -506,12 +648,31 @@ class Engine:
             time.sleep(flt.arg)
 
         if self._active.any():
-            use_spec = self.drafter is not None and self._spec_active
-            finished.extend(self._verify_once() if use_spec
-                            else self._decode_once())
-            # deferred radix registration: the full prompt page holding
-            # position P-1 becomes shareable once the frontier passed it
-            self.pool.flush_pending()
+            if windowed:
+                with annotate("serve/decode"):
+                    # every live slot's remaining budget fits one more
+                    # window => that window is the LAST (barring eos,
+                    # which only ends sooner): no point dispatching
+                    # blind past it
+                    last = int(self._budget[self._active].max()
+                               ) <= self._window
+                    if self._inflight is not None and last:
+                        # the in-flight window already finishes
+                        # everything — just fetch it
+                        finished.extend(self._drain_pending())
+                    elif last:
+                        finished.extend(self._drain_window(
+                            self._launch(self._window)))
+                    else:
+                        # double buffering: launch window N+1 BEFORE
+                        # fetching window N's token block
+                        nxt = self._launch(self._window)
+                        finished.extend(self._drain_pending())
+                        self._inflight = nxt
+            else:
+                spec_now = self.drafter is not None and self._spec_active
+                finished.extend(self._verify_once() if spec_now
+                                else self._decode_once())
             if self._watchdog is not None:
                 dur = time.perf_counter() - t_wall
                 if self._watchdog.observe(dur):
@@ -520,6 +681,10 @@ class Engine:
                     self._event(f"step {self.n_steps}: stall — "
                                        f"{dur * 1e3:.1f} ms step against "
                                        f"a p99-derived budget")
+        elif self._inflight is not None:
+            # endgame: every slot finished while a window was in flight
+            # — fetch it (it emits nothing) so drain() reaches idle
+            finished.extend(self._drain_pending())
         if self.tel.enabled:
             self.tel.complete("engine_step", self._tb + ENGINE_TRACK,
                               t_step_us,
@@ -542,6 +707,9 @@ class Engine:
         directly and stays re-probeable)."""
         active = active and self.drafter is not None
         if active and not self._spec_active:
+            # an in-flight decode window holds tokens the drafters'
+            # resync must see — fetch it before reading histories
+            self._pending.extend(self._drain_pending())
             hists = self._histories()
             for slot in self._slots:
                 if self._active[slot] and hists[slot] is not None:
@@ -584,6 +752,22 @@ class Engine:
         # paged-pool health: bench dashboards key on this block (schema
         # pinned in tests/test_pages.py)
         s["pages"] = self.pool.stats()
+        # dispatch amortization: the host tax per dispatch vs per token
+        # (the serve-side analogue of the train bench's dispatch split;
+        # BENCH_r03 measured 77.4 ms blocked vs 12.1 ms/step amortized)
+        c = self.metrics.counters
+        disp = self.metrics.hist_summary("decode_dispatch_s")
+        n_disp = int(c.get("decode_dispatches", 0))
+        dec_tokens = int(c.get("dispatch_tokens", 0))
+        mean_ms = disp.get("mean", 0.0) * 1e3
+        s["dispatch"] = {
+            "window_k": self._window,
+            "dispatches": n_disp,
+            "mean_dispatch_ms": round(mean_ms, 4),
+            "host_dispatch_ms_per_token": (
+                round(mean_ms * n_disp / dec_tokens, 4)
+                if dec_tokens else 0.0),
+        }
         c = self.metrics.counters
         s["recovery"] = {
             "watchdog_stalls": int(c.get("watchdog_stalls", 0)),
@@ -702,6 +886,12 @@ class Engine:
             self.drafter.on_admit(slot, req.prompt)
         self._tok[slot] = req.prompt[-1]
         self._active[slot] = True
+        self._budget[slot] = cap
+        self._eos[slot] = (-1 if req.eos_token_id is None
+                           else int(req.eos_token_id))
+        # host mirrors changed: the next window launch re-uploads them
+        # (admission only runs with no dispatch in flight)
+        self._dev_state = None
         sp = req.sampling
         self._temp[slot] = sp.temperature
         self._top_k[slot] = sp.top_k
@@ -721,69 +911,189 @@ class Engine:
         self.metrics.inc("prefix_hit_tokens", claimed)
         self.metrics.observe("queue_wait_s", now - t_submit)
 
-    def _decode_once(self) -> List[RequestResult]:
+    def _head_admissible(self) -> bool:
+        """Whether this step could admit: a free slot AND a queued,
+        unexpired head that fits the page gate. While False, a backlog
+        does not break decode windows — arrivals batch at window
+        boundaries (the scheduler's strict FIFO is unchanged: only the
+        HEAD is consulted, exactly like the admission loop)."""
+        if self.pool.n_free <= 0:
+            return False
+        head = self.scheduler.peek()
+        return head is not None and self._fits(head[0])
+
+    def _launch(self, k: int) -> _InFlight:
+        """Dispatch one ``k``-step decode window WITHOUT fetching its
+        results. The donated device step state from the previous
+        dispatch feeds straight back in when the host hasn't touched
+        per-slot state since (``_dev_state``); otherwise the host
+        mirrors are uploaded once. The token block's device->host copy
+        starts immediately (``copy_to_host_async``), so by the time
+        ``_drain_window`` reads it the transfer has been overlapping
+        device compute."""
         t0_us = self.tel.now_us() if self.tel.enabled else 0.0
-        with annotate("serve/decode"):
-            self.step_timer.start()
-            nxt, cache, rngs = self._decode_guard(
-                self.params, jnp.asarray(self._tok), jnp.asarray(self._pos),
-                jnp.asarray(self._active), jnp.asarray(self.pool.tables),
-                self.pool.cache, self._rngs,
-                jnp.asarray(self._temp), jnp.asarray(self._top_k),
-                jnp.asarray(self._top_p), jnp.asarray(self._greedy),
-                self.cfg, use_pallas=self._use_pallas)
-            self.step_timer.lap(nxt)
+        t_wall = time.perf_counter()
+        n_active = int(self._active.sum())
+        if self._dev_state is None:
+            # host-side bound for the traced window writes: every REAL
+            # write position (bounded by the per-slot budget — the
+            # admission cap's pos + budget <= seq_len invariant) stays
+            # inside the logical buffer
+            check_in_bounds(
+                np.where(self._active,
+                         self._pos + np.minimum(
+                             np.maximum(self._budget, 1), k) - 1, 0),
+                1, self.pool.seq_len, what="decode window write")
+            # committed, like every engine-owned jit input: the state
+            # must enter this call exactly as it leaves the donated
+            # steady-state loop (a committed output), or the jit cache
+            # keys the two placements as two programs
+            from .cache_pool import commit_default
+            state = tuple(commit_default(jnp.asarray(a)) for a in
+                          (self._tok, self._pos, self._active,
+                           self._budget))
+        else:
+            state = self._dev_state
+        tok, pos, active, budget = state
+        toks, emitted, tok, pos, active, budget, cache, rngs = \
+            self._decode_guard(
+                self.params, tok, pos, active, budget,
+                jnp.asarray(self._eos), jnp.asarray(self.pool.tables),
+                self.pool.cache, self._rngs, jnp.asarray(self._temp),
+                jnp.asarray(self._top_k), jnp.asarray(self._top_p),
+                jnp.asarray(self._greedy), self.cfg, k=k,
+                use_pallas=self._use_pallas, use_fused=self._use_fused)
         self.pool.cache = cache
         self._rngs = rngs
-        toks = np.asarray(nxt)
+        self._dev_state = (tok, pos, active, budget)
+        for out in (toks, emitted):
+            copy_async = getattr(out, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        # the host-side dispatch tax this PR amortizes: arg conversion +
+        # trace-cache lookup + enqueue, all BEFORE any device wait (the
+        # bench dispatch-split line reads this histogram)
+        self.metrics.inc("decode_dispatches")
+        self.metrics.observe("decode_dispatch_s",
+                             time.perf_counter() - t_wall)
+        return _InFlight(toks=toks, emitted=emitted, k=k, t0_us=t0_us,
+                         t_wall=t_wall, n_active=n_active)
+
+    def _drain_pending(self) -> List[RequestResult]:
+        if self._inflight is None:
+            return []
+        w, self._inflight = self._inflight, None
+        return self._drain_window(w)
+
+    def _commit_tokens(self, slot: int, st: _Active, committed: List[int],
+                       now: float, t0_us: float, dur_us: float) -> None:
+        """Append a dispatch's committed tokens to a slot's host record
+        — ONE definition for the decode-window and speculative-verify
+        drains: TTFT on the first token, one ``token`` telemetry
+        instant per committed token interpolated across the dispatch
+        span (indices are the request's running count — the strictly-
+        increasing contract tools/trace_check.py enforces), and the
+        ``_tok``/``_pos``/``_budget`` mirrors advanced."""
+        tid = self._tb + SLOT_TRACK_BASE + slot
+        first = not st.tokens
+        base = len(st.tokens)
+        st.tokens.extend(committed)
+        if self.tel.enabled:
+            n = len(committed)
+            for j in range(n):
+                self.tel.instant("token", tid,
+                                 ts_us=t0_us + dur_us * (j + 1) / n,
+                                 request=st.req.id, index=base + j + 1)
+        if first:
+            st.t_first_token = now
+            self.metrics.observe("ttft_s", now - st.t_submit)
+        st.t_last_token = now
+        self._tok[slot] = st.tokens[-1]
+        self._pos[slot] += len(committed)
+        self._budget[slot] = st.cap - len(st.tokens)
+
+    def _drain_window(self, w: _InFlight) -> List[RequestResult]:
+        """Fetch one dispatched window's token block (ONE host snapshot
+        per window — ``np.asarray`` on the async-copied outputs) and run
+        the host bookkeeping: append tokens, advance the mirrors,
+        finish slots whose budget ran out or whose eos landed. Slots
+        that finished mid-window already idled on device; their pages
+        and slot free HERE, at the window boundary."""
+        toks = np.asarray(w.toks)
+        emitted = np.asarray(w.emitted)
+        now = self.clock()
+        self.n_steps += 1
+        self.step_timer.laps.append(time.perf_counter() - w.t_wall)
+        n_tok = int(emitted.sum())
         if self._sanitize:
             # GRAFT_SANITIZE: sampled ids must be valid vocab entries
             # (an out-of-range id would clamp in the next embedding
             # gather and silently decode garbage)
-            bad = (toks < 0) | (toks >= self.cfg.vocab_size)
+            live = toks[emitted]
+            bad = (live < 0) | (live >= self.cfg.vocab_size)
             if bad.any():
                 raise FloatingPointError(
                     f"sanitize: decode produced out-of-range token(s) "
-                    f"{toks[bad][:4].tolist()} (vocab "
+                    f"{live[bad][:4].tolist()} (vocab "
                     f"{self.cfg.vocab_size})")
-        now = self.clock()
-        self.n_steps += 1
-        n_active = int(self._active.sum())
         self.metrics.observe("batch_fill_ratio",
-                             n_active / self.ecfg.pool_size)
+                             w.n_active / self.ecfg.pool_size)
         self.metrics.inc("decode_steps")
-        self.metrics.inc("decode_tokens", n_active)
+        self.metrics.inc("decode_tokens", n_tok)
+        # plain-decode tokens only (decode_tokens also counts verify
+        # commits): the denominator of host_dispatch_ms_per_token —
+        # dispatch time is only accumulated on this path, so a
+        # spec-enabled run must not dilute the ratio
+        self.metrics.inc("dispatch_tokens", n_tok)
         tel_on = self.tel.enabled
+        # span end at ts_us(now) — the same clock reading the finish
+        # path stamps on a request's E event, so a slot's last decode
+        # span never spills past its request envelope
+        dur_us = (self.tel.ts_us(now) - w.t0_us) if tel_on else 0.0
         if tel_on:
-            # end the span at ts_us(now) — the same clock reading the
-            # finish path stamps on a request's E event, so a slot's
-            # last decode span never spills past its request envelope
-            dur_us = self.tel.ts_us(now) - t0_us
             self.tel.complete("decode_step", self._tb + ENGINE_TRACK,
-                              t0_us, dur_us,
-                              step=self.n_steps, n_active=n_active)
+                              w.t0_us, dur_us, step=self.n_steps,
+                              n_active=w.n_active, k=w.k, tokens=n_tok)
         finished: List[RequestResult] = []
         for slot in list(self._slots):
-            if not self._active[slot]:
+            # emitted[:, slot] is a prefix mask: a slot deactivates once
+            # inside a window and never re-arms
+            n_emit = int(emitted[:, slot].sum())
+            if n_emit == 0:
                 continue
             st = self._slots[slot]
             if tel_on:
                 self.tel.complete("decode",
                                   self._tb + SLOT_TRACK_BASE + slot,
-                                  t0_us, dur_us, step=self.n_steps,
-                                  request=st.req.id)
-            st.tokens.append(int(toks[slot]))
-            if len(st.tokens) == 1:
-                st.t_first_token = now
-                self.metrics.observe("ttft_s", now - st.t_submit)
-            st.t_last_token = now
-            self._tok[slot] = toks[slot]
-            self._pos[slot] += 1
-            if len(st.tokens) >= st.cap:
+                                  w.t0_us, dur_us,
+                                  step=self.n_steps, request=st.req.id,
+                                  k=w.k, tokens=n_emit)
+            self._commit_tokens(slot, st,
+                                [int(t) for t in toks[:n_emit, slot]],
+                                now, w.t0_us, dur_us)
+            eos = int(self._eos[slot])
+            if eos >= 0 and st.tokens[-1] == eos:
+                # the device deactivated the slot the step its eos
+                # landed (emission stops right there — the eos token is
+                # the stream's last)
+                finished.append(self._finish_slot(
+                    slot, FINISH_EOS, now, device_stopped=True))
+            elif self._budget[slot] <= 0:
                 reason = (FINISH_LENGTH_CAP if st.capped
                           else FINISH_MAX_TOKENS)
-                finished.append(self._finish_slot(slot, reason, now))
+                finished.append(self._finish_slot(
+                    slot, reason, now, device_stopped=True))
+        # deferred radix registration: the full prompt page holding
+        # position P-1 becomes shareable once the frontier passed it
+        self.pool.flush_pending()
         return finished
+
+    def _decode_once(self) -> List[RequestResult]:
+        """Blocked k=1 decode: dispatch one step and immediately fetch
+        it — the fallback around host-side state mutations (admission,
+        deadline, cancel, speculative transitions)."""
+        with annotate("serve/decode"):
+            return self._drain_window(self._launch(1))
 
     def _histories(self) -> List[Optional[np.ndarray]]:
         """Per-slot prompt+generated token history — pure host data (the
@@ -809,6 +1119,9 @@ class Engine:
         k = self.drafter.k
         S = self.pool.seq_len
         P = self.ecfg.pool_size
+        # verify works off the host mirrors and advances them below:
+        # any device-resident window state is stale after this step
+        self._dev_state = None
         ctx = DraftContext(
             tok=self._tok, pos=self._pos, active=self._active,
             histories=(self._histories() if self.drafter.needs_history
@@ -849,9 +1162,11 @@ class Engine:
             self.step_timer.lap(n_acc)
         self.pool.cache = cache
         self._rngs = rngs
-        # ONE device->host snapshot per step for every slot's outcome
-        n_acc_h, out_h = (np.asarray(a) for a in
-                          jax.device_get((n_acc, out)))
+        # ONE host snapshot per verify step for every slot's outcome
+        # (np.asarray, not jax.device_get: the engine's step loop is
+        # GL004-clean — syncs happen once per dispatch, never per token)
+        n_acc_h = np.asarray(n_acc)
+        out_h = np.asarray(out)
         if self._sanitize:
             bad = (out_h < 0) | (out_h >= self.cfg.vocab_size)
             if bad.any():
@@ -875,8 +1190,8 @@ class Engine:
             self.metrics.observe("accept_rate", accepted / drafted)
         self.metrics.observe("tokens_per_slot_step", emitted / n_active)
         tel_on = self.tel.enabled
+        dur_us = (self.tel.ts_us(now) - t0_us) if tel_on else 0.0
         if tel_on:
-            dur_us = self.tel.ts_us(now) - t0_us
             self.tel.complete("verify_step", self._tb + ENGINE_TRACK,
                               t0_us, dur_us,
                               step=self.n_steps, n_active=n_active,
@@ -907,30 +1222,41 @@ class Engine:
                 continue
             st = self._slots[slot]
             n_emit = int(n_acc_h[slot]) + 1
+            committed = [int(t) for t in out_h[slot, :n_emit]]
+            eos = int(self._eos[slot])
+            if eos >= 0 and eos in committed:
+                # a drafted/accepted eos ends the stream there — drop
+                # whatever the verify window committed past it
+                n_emit = committed.index(eos) + 1
+                committed = committed[:n_emit]
             if tel_on:
                 self.tel.complete("verify",
                                   self._tb + SLOT_TRACK_BASE + slot,
                                   t0_us, dur_us, step=self.n_steps,
                                   request=st.req.id, drafted=int(m[slot]),
                                   committed=n_emit)
-            first = not st.tokens
-            st.tokens.extend(int(t) for t in out_h[slot, :n_emit])
-            if first:
-                st.t_first_token = now
-                self.metrics.observe("ttft_s", now - st.t_submit)
-            st.t_last_token = now
-            self._tok[slot] = st.tokens[-1]
-            self._pos[slot] += n_emit
-            if len(st.tokens) >= st.cap:
+            self._commit_tokens(slot, st, committed, now, t0_us, dur_us)
+            if eos >= 0 and st.tokens[-1] == eos:
+                finished.append(self._finish_slot(slot, FINISH_EOS, now))
+            elif len(st.tokens) >= st.cap:
                 reason = (FINISH_LENGTH_CAP if st.capped
                           else FINISH_MAX_TOKENS)
                 finished.append(self._finish_slot(slot, reason, now))
+        self.pool.flush_pending()
         return finished
 
     def _finish_slot(self, slot: int, reason: str, now: float,
-                     migrated: bool = False) -> RequestResult:
+                     migrated: bool = False,
+                     device_stopped: bool = False) -> RequestResult:
         st = self._slots.pop(slot)
         self._active[slot] = False
+        if not device_stopped:
+            # a host-initiated finish (cancel/deadline/migration): the
+            # device-resident step state still believes the slot is
+            # live — rebuild from the mirrors at the next launch.
+            # Budget/eos finishes already flipped the slot off ON
+            # DEVICE, so their state stays donatable as-is.
+            self._dev_state = None
         if self.tel.enabled:
             extra = {"migrated": True} if migrated else {}
             self.tel.end("request", self._tb + SLOT_TRACK_BASE + slot,

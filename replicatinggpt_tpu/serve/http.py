@@ -15,6 +15,7 @@ Endpoints (all JSON unless noted):
 
 - ``POST /v1/submit`` — body ``{"prompt": [ids], "id"?, "max_new_tokens"?,
   "temperature"?, "top_k"?, "top_p"?, "greedy"?, "rng_seed"?,
+  "eos_token_id"?,
   "deadline_s"?}``; 200 ``{"id", "status": "accepted"}`` or an error
   status from the rejection reason (429 backpressure, 400 validation,
   413 prompt too long, 504 dead-on-arrival deadline).
@@ -126,7 +127,9 @@ def request_from_json(body: dict, default_id: str, clock,
                 top_p=float(body.get("top_p", 0.0)),
                 greedy=bool(body.get("greedy", False))),
             deadline=deadline,
-            rng_seed=int(body.get("rng_seed", 0)))
+            rng_seed=int(body.get("rng_seed", 0)),
+            eos_token_id=(None if body.get("eos_token_id") is None
+                          else int(body["eos_token_id"])))
     except (TypeError, ValueError) as e:
         return None, f"bad request field: {e}"
     return req, None

@@ -148,8 +148,11 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
                             ecfg.prefill_chunk)
 
     def tiny(rid):
+        # long enough to compile the steady-state decode WINDOW on top
+        # of the k=1 admission-step program (EngineConfig.warmup_tokens
+        # — one definition shared with the worker's readiness warmup)
         return Request(id=rid, prompt=np.zeros((1,), np.int32),
-                       max_new_tokens=1,
+                       max_new_tokens=ecfg.warmup_tokens(),
                        sampling=SamplingParams(greedy=True))
 
     if warmup:
@@ -279,6 +282,13 @@ def format_summary(s: dict) -> str:
         f" (pool), queue wait {pct('queue_wait_s', 1e3, ' ms')}",
         f"recompiles after warmup: {s['recompiles_after_warmup']}",
     ]
+    dp = s.get("dispatch")
+    if dp and dp.get("dispatches"):
+        lines.insert(4, (
+            f"dispatch split: window k={dp['window_k']}, "
+            f"{dp['dispatches']} dispatches, host "
+            f"{dp['mean_dispatch_ms']:.3f} ms/dispatch -> "
+            f"{dp['host_dispatch_ms_per_token']:.3f} ms/token"))
     pg = s.get("pages")
     if pg:
         lines.insert(2, (

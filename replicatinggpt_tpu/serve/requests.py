@@ -40,6 +40,11 @@ class SamplingParams:
 FINISH_MAX_TOKENS = "max_tokens"        # produced request.max_new_tokens
 FINISH_LENGTH_CAP = "length_cap"        # hit the slot's context capacity
                                         # (block_size) before max_new_tokens
+FINISH_EOS = "eos"                      # sampled request.eos_token_id (the
+                                        # eos token is the stream's last;
+                                        # detected ON DEVICE inside decode
+                                        # windows, so a stopped slot idles
+                                        # to the window boundary)
 FINISH_DEADLINE = "deadline"            # deadline expired (at submit,
                                         # queued, or active)
 FINISH_CANCELLED = "cancelled"          # caller cancelled (queued or active)
@@ -68,6 +73,11 @@ class Request:
     sampling: SamplingParams = field(default_factory=SamplingParams)
     deadline: Optional[float] = None
     rng_seed: int = 0
+    #: stop token: generation ends the step this id is sampled (it IS
+    #: emitted, as the last token, finish_reason ``eos``); None = run to
+    #: max_new_tokens. Must be a valid vocab id — the engine rejects
+    #: out-of-range values at submit.
+    eos_token_id: Optional[int] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -89,7 +99,8 @@ class RequestResult:
 
     @property
     def ok(self) -> bool:
-        return self.finish_reason in (FINISH_MAX_TOKENS, FINISH_LENGTH_CAP)
+        return self.finish_reason in (FINISH_MAX_TOKENS, FINISH_LENGTH_CAP,
+                                      FINISH_EOS)
 
     def to_dict(self) -> Dict:
         return {"id": self.id, "n_tokens": len(self.tokens),

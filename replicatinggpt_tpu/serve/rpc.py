@@ -107,6 +107,8 @@ def request_to_wire(req: Request, now: float) -> dict:
         "top_p": float(sp.top_p), "greedy": bool(sp.greedy),
         "deadline_rel": (None if req.deadline is None
                          else max(req.deadline - now, 0.0)),
+        "eos_token_id": (None if req.eos_token_id is None
+                         else int(req.eos_token_id)),
     }
 
 
@@ -124,7 +126,9 @@ def request_from_wire(doc: dict, now: float) -> Request:
             temperature=float(doc["temperature"]),
             top_k=int(doc["top_k"]), top_p=float(doc["top_p"]),
             greedy=bool(doc["greedy"])),
-        deadline=deadline, rng_seed=int(doc["rng_seed"]))
+        deadline=deadline, rng_seed=int(doc["rng_seed"]),
+        eos_token_id=(None if doc.get("eos_token_id") is None
+                      else int(doc["eos_token_id"])))
 
 
 def result_to_wire(res: RequestResult) -> dict:

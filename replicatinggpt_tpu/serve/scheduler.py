@@ -48,6 +48,15 @@ class Scheduler:
         (bounded by max_queue, like ``contains``)."""
         return [req.id for req, _ in self._queue]
 
+    def peek(self) -> Optional[Tuple[Request, float]]:
+        """The queue head WITHOUT popping it — the engine's window path
+        asks "could this step admit?" before deciding whether to break
+        a multi-token decode window for the admission (strict FIFO: the
+        head is the only candidate, exactly as in ``admit``). While the
+        head cannot fit, queued arrivals batch up and are admitted
+        together at a later window boundary."""
+        return self._queue[0] if self._queue else None
+
     def submit(self, req: Request) -> Optional[str]:
         """Enqueue ``req``; returns None on acceptance or a rejection
         reason (backpressure / validation) — the caller must surface

@@ -230,15 +230,19 @@ def _write_ready_file(path: str, doc: dict) -> None:
 
 
 def warm_engine(engine: Engine) -> None:
-    """One throwaway greedy token through prefill + decode, so
+    """One throwaway greedy request through prefill + decode, so
     readiness implies compiled programs (no journal attached yet — a
-    warmup request must never appear in a crash journal)."""
+    warmup request must never appear in a crash journal). With a
+    decode window configured the request is long enough to compile the
+    steady-state k-step window program on top of the k=1
+    admission-step fallback (``EngineConfig.warmup_tokens`` — shared
+    with the replay warmup)."""
     import numpy as np
 
     from .requests import SamplingParams
     engine.submit(Request(id="__warmup__",
                           prompt=np.zeros((1,), np.int32),
-                          max_new_tokens=1,
+                          max_new_tokens=engine.ecfg.warmup_tokens(),
                           sampling=SamplingParams(greedy=True)))
     engine.drain()
 
@@ -292,7 +296,8 @@ def run_worker(args) -> int:
                         max_queue=args.max_queue,
                         prefill_chunk=args.prefill_chunk,
                         page_size=args.page_size, n_pages=args.n_pages,
-                        prefix_cache=not args.no_prefix_cache)
+                        prefix_cache=not args.no_prefix_cache,
+                        decode_window=getattr(args, "decode_window", 1))
     engine = Engine(state.params, cfg.model, ecfg)
     warm_engine(engine)
 

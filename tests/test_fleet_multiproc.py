@@ -640,6 +640,53 @@ def test_worker_process_smoke_parity(tmp_path):
     assert '"ev": "submit"' in recs and '"ev": "finish"' in recs
 
 
+def test_step_rpc_round_trips_amortized_by_decode_window(tmp_path):
+    """The worker's step RPC returns the FULL token window per call
+    (and journals/redelivers finishes once per window, not per token):
+    with --decode-window 16 forwarded to the worker, step-RPC round
+    trips per generated token drop >= 4x vs the k=1 identity — a
+    blocked worker with ONE active slot needs at least one step RPC
+    per token by construction, so <= 0.25 RPCs/token IS the >= 4x
+    drop. Greedy stream stays byte-identical to offline generate."""
+    jdir = str(tmp_path / "journals")
+    specs = make_worker_specs(
+        1, jdir, ["--preset", "test-tiny"],
+        ["--pool-size", "2", "--max-queue", "16",
+         "--decode-window", "16"])
+    router, sup = spawn_fleet(
+        specs, RouterConfig(n_replicas=1, journal_dir=jdir,
+                            step_timeout_s=30.0),
+        SupervisorConfig(backoff_s=0.2, probe_every=10_000,
+                         probe_timeout_s=5.0))
+    try:
+        rep = router.replicas[0]
+        n_steps = {"step": 0}
+        orig = rep._call
+
+        def counted(op, **kw):
+            if op == "step":
+                n_steps["step"] += 1
+            return orig(op, **kw)
+
+        rep._call = counted
+        req = Request(id="w0",
+                      prompt=np.asarray([32, 39, 63], np.int32),
+                      max_new_tokens=28,
+                      sampling=SamplingParams(greedy=True))
+        assert router.submit(req) is None
+        results, streams = _drain_streaming(router, sup, ["w0"])
+        assert results["w0"].tokens == _offline(req.prompt, 28)
+        assert streams["w0"] == results["w0"].tokens
+        per_token = n_steps["step"] / 28
+        assert per_token <= 0.25, (
+            f"{n_steps['step']} step RPCs for 28 tokens "
+            f"({per_token:.3f}/token) — window not amortizing the RPC "
+            f"cadence")
+    finally:
+        sup.stop_all()
+        router.close()
+
+
 # ---------------------------------------------------------------------------
 # pinned acceptance soaks (slow tier: -m "multiproc and slow")
 # ---------------------------------------------------------------------------

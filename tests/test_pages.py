@@ -458,25 +458,81 @@ def test_paged_pallas_kernel_matches_gather_reference():
 
 
 def test_paged_kernel_engine_greedy_parity(params, monkeypatch):
-    """The engine's opt-in Pallas paged decode route (packed layout)
-    must keep exact greedy parity with offline generate()."""
-    from replicatinggpt_tpu.ops import paged_pallas
+    """The engine's opt-in Pallas paged decode routes must keep exact
+    greedy parity with offline generate(). ``paged_kernel=True`` now
+    prefers the FUSED all-layers kernel (one launch per decode step,
+    ops/decode_pallas.fused_paged_decode_layers) and falls back to the
+    per-layer kernel (ops/paged_pallas) when the fused envelope says
+    no — both routes are pinned here."""
+    from replicatinggpt_tpu.ops import decode_pallas, paged_pallas
     monkeypatch.setattr(paged_pallas, "_paged_attn_backend_ok",
                         lambda: True)
     cfg = ModelConfig(vocab_size=65, block_size=32, n_layer=2, n_head=2,
                       n_embd=64, dropout=0.0, attn_dropout=0.0,
                       dtype="float32", decode_cache_layout="packed")
     p64 = init_params(jax.random.PRNGKey(1), cfg)
-    eng = Engine(p64, cfg, EngineConfig(pool_size=2, max_queue=4,
-                                        page_size=8, paged_kernel=True))
-    assert eng._use_pallas, "kernel route should be on under the patch"
     reqs = [_greedy("k0", np.array([3, 1, 4, 1, 5], np.int32), max_new=6),
             _greedy("k1", np.array([9, 2, 6], np.int32), max_new=5)]
     want = _offline_greedy(p64, reqs, cfg=cfg)
+
+    ecfg = EngineConfig(pool_size=2, max_queue=4, page_size=8,
+                        paged_kernel=True)
+    eng = Engine(p64, cfg, ecfg)
+    assert eng._use_fused, "fused kernel route should be on under the patch"
+    assert not eng._use_pallas
     for r in reqs:
         assert eng.submit(r) is None
     got = {r.id: r.tokens for r in eng.drain()}
     assert got == want
+
+    # per-layer fallback: force the fused envelope shut
+    monkeypatch.setattr(decode_pallas, "fused_paged_decode_supported",
+                        lambda *a, **kw: False)
+    eng2 = Engine(p64, cfg, ecfg)
+    assert eng2._use_pallas and not eng2._use_fused, \
+        "per-layer kernel route should be the fallback"
+    for r in reqs:
+        assert eng2.submit(r) is None
+    got2 = {r.id: r.tokens for r in eng2.drain()}
+    assert got2 == want
+
+
+def test_fused_paged_kernel_matches_xla_reference():
+    """Interpret-mode parity of the fused all-layers paged kernel
+    against the XLA gather path: logits and the post-write page pools
+    must match on mixed active/inactive slots at ragged positions."""
+    from replicatinggpt_tpu.models.gpt import (decode_step_paged,
+                                               init_paged_kv_pool)
+    from replicatinggpt_tpu.ops.decode_pallas import (
+        fused_paged_decode_supported)
+    cfg = ModelConfig(vocab_size=97, block_size=64, n_layer=3, n_head=2,
+                      n_embd=64, dropout=0.0, attn_dropout=0.0,
+                      dtype="float32", decode_cache_layout="packed")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    B, psz, N, mp = 4, 8, 32, 8
+    assert fused_paged_decode_supported(cfg, B, psz, 4)
+    rng = np.random.default_rng(0)
+    cache = {"k": jnp.asarray(rng.normal(size=(cfg.n_layer, N, psz,
+                                               cfg.n_embd)), jnp.float32),
+             "v": jnp.asarray(rng.normal(size=(cfg.n_layer, N, psz,
+                                               cfg.n_embd)), jnp.float32)}
+    tables = jnp.asarray(rng.permutation(N)[:B * mp]
+                         .reshape(B, mp).astype(np.int32))
+    pos = jnp.asarray(np.array([5, 0, 17, 23], np.int32))
+    active = jnp.asarray(np.array([True, False, True, True]))
+    tok = jnp.asarray(np.array([3, 0, 9, 50], np.int32))
+    ref_lg, ref_c = decode_step_paged(p, tok, pos, active, tables,
+                                      cache, cfg)
+    fus_lg, fus_c = decode_step_paged(p, tok, pos, active, tables,
+                                      cache, cfg, use_fused=True)
+    am = np.asarray(active)
+    np.testing.assert_allclose(np.asarray(fus_lg)[am],
+                               np.asarray(ref_lg)[am],
+                               atol=1e-5, rtol=1e-5)
+    for name in ("k", "v"):
+        np.testing.assert_allclose(np.asarray(fus_c[name]),
+                                   np.asarray(ref_c[name]),
+                                   atol=1e-5, rtol=1e-5)
 
 
 # ---------------------------------------------------------------------------

@@ -430,6 +430,78 @@ def test_run_replay_flushes_artifacts_on_midrun_crash(params, tmp_path,
     assert len(MetricsTimeline.load(tl)) >= 2     # attach + forced final
 
 
+def test_decode_window_spans_and_token_instants(params, tmp_path):
+    """Async-engine telemetry: one decode X span per DISPATCH carrying
+    ``k`` and tokens-emitted args, multiple per-request ``token``
+    instants inside a window span with strictly increasing indices —
+    and the whole trace still validates through trace_check."""
+    from replicatinggpt_tpu.serve import EngineConfig, ReplayConfig
+    out = tmp_path / "window_trace.json"
+    rcfg = ReplayConfig(n_requests=6, rate=50_000.0, seed=3,
+                        prompt_len_min=4, prompt_len_max=8,
+                        max_new_tokens=12, greedy=True)
+    s = run_replay(params, CFG, rcfg,
+                   EngineConfig(pool_size=3, max_queue=16,
+                                decode_window=4),
+                   trace_out=str(out))
+    assert s["n_completed"] == 6
+    assert s["recompiles_after_warmup"] == 0
+    tc = _trace_check()
+    assert tc.check_trace(str(out), min_requests=6) == []
+    doc = json.loads(out.read_text())
+    evs = doc["traceEvents"]
+    # engine-track window spans carry k + tokens; some are real windows
+    steps = [e for e in evs if e.get("ph") == "X"
+             and e.get("name") == "decode_step"]
+    assert steps and all("k" in e["args"] and "tokens" in e["args"]
+                         for e in steps)
+    assert any(e["args"]["k"] == 4 and e["args"]["tokens"] > 1
+               for e in steps), "no multi-token window span in trace"
+    # slot-track decode spans: one per dispatch per live request, with
+    # the window's token count
+    slot_spans = [e for e in evs if e.get("ph") == "X"
+                  and e.get("name") == "decode"]
+    assert any(e["args"].get("tokens", 0) > 1 for e in slot_spans)
+    # token instants: > 1 per window span, strictly increasing per id
+    toks = [e for e in evs if e.get("ph") == "i"
+            and e.get("name") == "token"]
+    assert toks
+    by_req = {}
+    for e in toks:
+        by_req.setdefault(e["args"]["request"], []).append(
+            e["args"]["index"])
+    for rid, idxs in by_req.items():
+        assert idxs == sorted(idxs) and len(set(idxs)) == len(idxs), \
+            (rid, idxs)
+    assert any(len(v) > 4 for v in by_req.values())
+
+
+def test_trace_check_rejects_bad_token_indices(tmp_path):
+    """The window-delivery check has teeth: duplicate / backwards /
+    non-int token indices fail, a well-formed multi-token window
+    passes."""
+    tc = _trace_check()
+
+    def write(tokens):
+        env = [{"ph": "B", "name": "request", "tid": 1, "ts": 0.0,
+                "args": {"request": "r"}}]
+        env += [{"ph": "i", "name": "token", "tid": 1, "ts": 1.0 + i,
+                 "args": {"request": "r", "index": ix}}
+                for i, ix in enumerate(tokens)]
+        env += [{"ph": "E", "name": "request", "tid": 1, "ts": 50.0,
+                 "args": {"request": "r"}}]
+        p = tmp_path / "tok.json"
+        p.write_text(json.dumps({"traceEvents": env}))
+        return str(p)
+
+    assert tc.check_trace(write([1, 2, 3, 4])) == []
+    assert tc.check_trace(write([3, 4, 5])) == []   # ring-buffer suffix
+    assert tc.check_trace(write([1, 2, 2]))         # duplicate
+    assert tc.check_trace(write([2, 1]))            # backwards
+    assert tc.check_trace(write([0, 1]))            # index < 1
+    assert tc.check_trace(write(["x"]))             # non-int
+
+
 def test_trace_check_rejects_malformed_traces(tmp_path):
     """The validator actually validates: unclosed envelopes, crossed
     B/E, negative durations, out-of-envelope spans all fail."""

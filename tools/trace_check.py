@@ -24,7 +24,18 @@ an error, so CI validates structure explicitly:
   slot lifetime, so its route/requeue/health instants legitimately
   fall outside any envelope). Router tracks are recognized by their
   thread-name metadata (``utils.telemetry.ROUTER_TRACK_NAME``) so
-  this validator stays stdlib-only with no imports from the package.
+  this validator stays stdlib-only with no imports from the package;
+- multi-token decode windows are allowed and checked: a window's
+  ``decode``/``verify`` X span may contain MANY per-request ``token``
+  instants; each must carry a positive integer ``index`` (the
+  request's running token count) and a request's token indices must be
+  strictly increasing in event order WITHIN an envelope segment — a
+  duplicate or backwards index means the async engine double-delivered
+  or dropped part of a window. The floor resets when a new envelope
+  segment opens (a migrated / journal-replayed request re-decodes from
+  token 0 on its new replica; the delivery ledger, not the trace,
+  dedupes the client stream), and the ring buffer may evict the oldest
+  events, so indices need not start at 1.
 
 Exits 0 on a valid trace, 1 with one line per violation otherwise.
 Used by tests/test_telemetry.py on a tiny replay's output (tier-1), by
@@ -78,6 +89,8 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
     segments: Dict[str, List[dict]] = {}
     open_envs: Dict[Tuple[str, Tuple[int, int]], List[float]] = {}
     tagged: List[dict] = []
+    # request id -> highest token-instant index seen (window deliveries)
+    token_indices: Dict[str, int] = {}
 
     for ev in events:
         ph = ev.get("ph")
@@ -96,6 +109,10 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
             stacks.setdefault(key, []).append(ev)
             if name == "request":
                 open_envs.setdefault((rid, key), []).append(ts)
+                # a fresh envelope segment (re-admission after a
+                # migration / journal replay) legitimately re-decodes
+                # from token 0 — the index floor resets per segment
+                token_indices.pop(rid, None)
         elif ph == "E":
             stack = stacks.get(key, [])
             if not stack:
@@ -125,6 +142,23 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
         elif ph == "i":
             if rid is not None and name not in UNSTARTED and not on_router:
                 tagged.append(ev)
+                if name == "token":
+                    idx = args.get("index")
+                    if not isinstance(idx, int) or isinstance(idx, bool) \
+                            or idx < 1:
+                        errors.append(
+                            f"token instant for request {rid!r} has bad "
+                            f"index {idx!r} (want int >= 1)")
+                    else:
+                        prev = token_indices.get(rid)
+                        if prev is not None and idx <= prev:
+                            errors.append(
+                                f"request {rid!r}: token index {idx} "
+                                f"after {prev} (token instants must be "
+                                f"strictly increasing — duplicate or "
+                                f"reordered window delivery)")
+                        token_indices[rid] = (idx if prev is None
+                                              else max(prev, idx))
 
     for key, stack in stacks.items():
         for ev in stack:
