@@ -385,11 +385,17 @@ def bench_serve(args) -> None:
     # robustness overhead this artifact's trajectory tracks), shedding
     # off (it would change the measured workload)
     from replicatinggpt_tpu.faults import DEFAULT_SERVE_RESILIENCE
+    from replicatinggpt_tpu.parallel.mesh import resolve_mesh_shape
+    mesh_d, mesh_m = resolve_mesh_shape(args.mesh_shape,
+                                        len(jax.devices()), warn=log)
+    if mesh_d * mesh_m > 1:
+        log(f"serving mesh: {mesh_d}x{mesh_m} (data x model)")
     ecfg = EngineConfig(pool_size=args.serve_pool,
                         max_queue=2 * args.serve_requests,
                         page_size=args.serve_page_size,
                         n_pages=args.serve_n_pages,
-                        decode_window=args.decode_window)
+                        decode_window=args.decode_window,
+                        mesh_data=mesh_d, mesh_model=mesh_m)
     summary = run_replay(state.params, cfg.model, rcfg, ecfg,
                          draft_params=draft_params, draft_cfg=draft_cfg,
                          resilience=DEFAULT_SERVE_RESILIENCE,
@@ -500,6 +506,13 @@ def bench_serve(args) -> None:
         "pages_in_use": pg["pages_in_use"],
         "page_utilization": pg["page_utilization"],
         "page_size": pg["page_size"],
+        # serving mesh (ISSUE 12): the EFFECTIVE shape (1x1 when the
+        # backend had too few devices), per-chip page capacity, and the
+        # aggregate admission currency — n_pages is aggregate, each
+        # data-axis chip physically stores pages_per_chip of it
+        "mesh_shape": pg["mesh_shape"],
+        "pages_per_chip": pg["pages_per_chip"],
+        "aggregate_pages": pg["aggregate_pages"],
         "prefix_hit_rate": pg["prefix_hit_rate"],
         "prefix_hit_tokens": pg["prefix_hit_tokens"],
         "evictions": pg["evictions"],
@@ -1111,6 +1124,15 @@ def main() -> None:
                         "loop). When > 1 the artifact carries the "
                         "dispatch split: blocked (k=1) vs amortized "
                         "host-overhead per token on the same trace")
+    p.add_argument("--mesh-shape", default="1x1",
+                   help="--mode serve: serving mesh DATAxMODEL (e.g. "
+                        "2x2) — the engine runs GSPMD-sharded over a "
+                        "(data, model) mesh: paged KV pages over data "
+                        "(aggregate capacity at fixed per-chip HBM), "
+                        "Megatron TP over model; the artifact carries "
+                        "mesh_shape / pages_per_chip / aggregate_pages. "
+                        "Downgrades to 1x1 with a log line when the "
+                        "backend has fewer devices")
     p.add_argument("--trace-out", default=None,
                    help="--mode serve: write a Perfetto-loadable Chrome "
                         "trace of the replay (one span tree per request "

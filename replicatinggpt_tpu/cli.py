@@ -21,6 +21,101 @@ import sys
 
 from .config import add_config_flags, config_from_args
 
+#: (dest, flag) pairs for every ENGINE-shape flag registered by
+#: add_engine_flags — the serving analogue of
+#: config.MODEL_OVERRIDE_FLAGS, kept adjacent to the registration for
+#: the same reason: `serve --multiproc` respawns workers with
+#: engine_forward_args(), so a flag missing here means a fleet of
+#: workers silently serving a DIFFERENT engine shape (pool, pages,
+#: decode window, mesh slice) than the operator asked for. Round-trip
+#: pinned in tests/test_serve_mesh.py.
+ENGINE_FORWARD_FLAGS = (
+    ("pool_size", "--pool-size"),
+    ("max_queue", "--max-queue"),
+    ("prefill_chunk", "--prefill-chunk"),
+    ("page_size", "--page-size"),
+    ("n_pages", "--n-pages"),
+    ("decode_window", "--decode-window"),
+    ("mesh_shape", "--mesh-shape"),
+)
+#: store_true engine switches, forwarded only when set
+ENGINE_FORWARD_SWITCHES = (("no_prefix_cache", "--no-prefix-cache"),)
+
+
+def add_engine_flags(p: argparse.ArgumentParser) -> None:
+    """Engine-shape knobs shared by serve-replay / serve / serve-worker
+    (one registration — the three parsers must agree or the multiproc
+    forwarding in ``engine_forward_args`` breaks)."""
+    p.add_argument("--pool-size", type=int, default=8,
+                   help="KV-cache slots pre-allocated at engine start")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission queue bound (backpressure past it)")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="prompt tokens per prefill dispatch "
+                        "(0 = min(64, block_size))")
+    p.add_argument("--page-size", type=int, default=0,
+                   help="tokens per KV-cache page (0 = min(16, "
+                        "block_size)); see docs/serving.md")
+    p.add_argument("--n-pages", type=int, default=0,
+                   help="physical KV pages in the pool (0 = "
+                        "pool_size * pages-per-slot — the contiguous "
+                        "pool's HBM exactly; fewer pages shrinks HBM "
+                        "and admission gates on free pages)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable radix prefix reuse (pages only) — "
+                        "the A/B arm for prefix-hit TTFT claims")
+    p.add_argument("--decode-window", type=int, default=1,
+                   help="decode steps rolled into ONE jitted dispatch "
+                        "at steady state (async engine; 1 = blocked "
+                        "step-per-dispatch loop). The engine falls "
+                        "back to k=1 around admissions, deadlines, "
+                        "cancels and speculative verify/re-probe — "
+                        "see docs/serving.md#async-engine")
+    p.add_argument("--mesh-shape", default="1x1",
+                   help="serving mesh DATAxMODEL (e.g. 2x2): run the "
+                        "engine GSPMD-sharded over a (data, model) "
+                        "device mesh — the paged KV pool's page axis "
+                        "shards over data (aggregate page capacity "
+                        "multiplier at fixed per-chip HBM), Megatron "
+                        "TP over model (attention/MLP FLOPs per "
+                        "step). 1x1 = single device. See "
+                        "docs/serving.md#sharded-serving")
+
+
+def engine_forward_args(args: argparse.Namespace) -> list:
+    """Reconstruct the add_engine_flags CLI arguments present on
+    ``args`` so `serve --multiproc` can respawn workers with the exact
+    engine shape (the config_override_args pattern)."""
+    out: list = []
+    for dest, flag in ENGINE_FORWARD_FLAGS:
+        out += [flag, str(getattr(args, dest))]
+    for dest, flag in ENGINE_FORWARD_SWITCHES:
+        if getattr(args, dest, False):
+            out.append(flag)
+    return out
+
+
+def engine_config_from_args(args: argparse.Namespace):
+    """EngineConfig from an add_engine_flags parse. A mesh shape the
+    process cannot satisfy downgrades to 1x1 with a warning (the
+    ``_build_mesh_if_needed`` convention: a dev box run of a pod-slice
+    command should degrade, not die)."""
+    from .parallel.mesh import parse_mesh_shape, resolve_mesh_shape
+    from .serve import EngineConfig
+    d, m = parse_mesh_shape(args.mesh_shape)
+    if d * m > 1:
+        import jax
+        d, m = resolve_mesh_shape(
+            args.mesh_shape, len(jax.devices()),
+            warn=lambda msg: print("warning: " + msg, file=sys.stderr))
+    return EngineConfig(pool_size=args.pool_size,
+                        max_queue=args.max_queue,
+                        prefill_chunk=args.prefill_chunk,
+                        page_size=args.page_size, n_pages=args.n_pages,
+                        prefix_cache=not args.no_prefix_cache,
+                        decode_window=args.decode_window,
+                        mesh_data=d, mesh_model=m)
+
 
 def _build_mesh_if_needed(cfg):
     import jax
@@ -253,11 +348,7 @@ def cmd_serve_replay(args) -> int:
         deadline_s=args.deadline_s, prompt_mode=args.prompt_mode,
         shared_prefix_len=args.shared_prefix_len,
         spec=args.spec, spec_k=args.spec_k, spec_ngram=args.spec_ngram)
-    ecfg = EngineConfig(pool_size=args.pool_size, max_queue=args.max_queue,
-                        prefill_chunk=args.prefill_chunk,
-                        page_size=args.page_size, n_pages=args.n_pages,
-                        prefix_cache=not args.no_prefix_cache,
-                        decode_window=args.decode_window)
+    ecfg = engine_config_from_args(args)
     draft_params = draft_cfg = None
     if rcfg.spec == "model":
         from .models.gpt import init_params, param_count
@@ -270,9 +361,11 @@ def cmd_serve_replay(args) -> int:
               f"{draft_cfg.n_embd}C ({param_count(draft_params):,} params, "
               f"random init)", file=sys.stderr)
     dev = jax.devices()[0]
+    mesh_note = (f", mesh {ecfg.mesh_data}x{ecfg.mesh_model}"
+                 if ecfg.mesh_data * ecfg.mesh_model > 1 else "")
     print(f"serve-replay: {rcfg.n_requests} requests @ {rcfg.rate}/s, "
           f"pool {ecfg.pool_size}, queue {ecfg.max_queue}, "
-          f"spec {rcfg.spec} (k={rcfg.spec_k}), "
+          f"spec {rcfg.spec} (k={rcfg.spec_k}){mesh_note}, "
           f"model {cfg.model.n_layer}L/{cfg.model.n_head}H/"
           f"{cfg.model.n_embd}C on {dev.platform} ({dev.device_kind})",
           file=sys.stderr)
@@ -346,14 +439,12 @@ def cmd_serve(args) -> int:
                        + config_override_args(args))
         if args.rng_impl is not None:
             config_args += ["--rng-impl", args.rng_impl]
-        engine_args = ["--pool-size", str(args.pool_size),
-                       "--max-queue", str(args.max_queue),
-                       "--prefill-chunk", str(args.prefill_chunk),
-                       "--page-size", str(args.page_size),
-                       "--n-pages", str(args.n_pages),
-                       "--decode-window", str(args.decode_window)]
-        if args.no_prefix_cache:
-            engine_args.append("--no-prefix-cache")
+        # the full engine shape — pool/pages/window/MESH SLICE — rides
+        # the same pinned plumbing as the model overrides above
+        # (ENGINE_FORWARD_FLAGS next to add_engine_flags), so each
+        # worker process builds exactly the engine the operator asked
+        # for, mesh included
+        engine_args = engine_forward_args(args)
         if args.no_fsync:
             engine_args.append("--no-fsync")
         if args.checkpoint_dir:
@@ -371,7 +462,7 @@ def cmd_serve(args) -> int:
         import jax
 
         from .config import config_from_args
-        from .serve import EngineConfig, Router
+        from .serve import Router
         from .train.state import create_train_state
         cfg = config_from_args(args)
         state = create_train_state(jax.random.PRNGKey(cfg.train.seed),
@@ -385,15 +476,9 @@ def cmd_serve(args) -> int:
                       file=sys.stderr)
             else:
                 state = restored
-        router = Router(
-            state.params, cfg.model, rcfg,
-            EngineConfig(pool_size=args.pool_size,
-                         max_queue=args.max_queue,
-                         prefill_chunk=args.prefill_chunk,
-                         page_size=args.page_size, n_pages=args.n_pages,
-                         prefix_cache=not args.no_prefix_cache,
-                         decode_window=args.decode_window),
-            telemetry=telemetry)
+        router = Router(state.params, cfg.model, rcfg,
+                        engine_config_from_args(args),
+                        telemetry=telemetry)
     app = ServeApp(router, idle_timeout_s=args.idle_timeout_s,
                    supervisor=supervisor)
     rc = 0
@@ -540,31 +625,7 @@ def main(argv=None) -> int:
     ps.add_argument("--n-requests", type=int, default=64)
     ps.add_argument("--rate", type=float, default=200.0,
                     help="mean Poisson arrival rate, requests/sec")
-    ps.add_argument("--pool-size", type=int, default=8,
-                    help="KV-cache slots pre-allocated at engine start")
-    ps.add_argument("--max-queue", type=int, default=64,
-                    help="admission queue bound (backpressure past it)")
-    ps.add_argument("--prefill-chunk", type=int, default=0,
-                    help="prompt tokens per prefill dispatch "
-                         "(0 = min(64, block_size))")
-    ps.add_argument("--page-size", type=int, default=0,
-                    help="tokens per KV-cache page (0 = min(16, "
-                         "block_size)); see docs/serving.md")
-    ps.add_argument("--n-pages", type=int, default=0,
-                    help="physical KV pages in the pool (0 = "
-                         "pool_size * pages-per-slot — the contiguous "
-                         "pool's HBM exactly; fewer pages shrinks HBM "
-                         "and admission gates on free pages)")
-    ps.add_argument("--no-prefix-cache", action="store_true",
-                    help="disable radix prefix reuse (pages only) — "
-                         "the A/B arm for prefix-hit TTFT claims")
-    ps.add_argument("--decode-window", type=int, default=1,
-                    help="decode steps rolled into ONE jitted dispatch "
-                         "at steady state (async engine; 1 = blocked "
-                         "step-per-dispatch loop). The engine falls "
-                         "back to k=1 around admissions, deadlines, "
-                         "cancels and speculative verify/re-probe — "
-                         "see docs/serving.md#async-engine")
+    add_engine_flags(ps)
     ps.add_argument("--shared-prefix-len", type=int, default=0,
                     help="--prompt-mode shared_prefix: common prefix "
                          "length (0 = prompt-len-max // 2)")
@@ -655,15 +716,7 @@ def main(argv=None) -> int:
                          "is quarantined and its in-flight work "
                          "re-routed")
     pv.add_argument("--wedge-patience", type=int, default=2)
-    pv.add_argument("--pool-size", type=int, default=8)
-    pv.add_argument("--max-queue", type=int, default=64)
-    pv.add_argument("--prefill-chunk", type=int, default=0)
-    pv.add_argument("--page-size", type=int, default=0)
-    pv.add_argument("--n-pages", type=int, default=0)
-    pv.add_argument("--no-prefix-cache", action="store_true")
-    pv.add_argument("--decode-window", type=int, default=1,
-                    help="decode steps per dispatch at steady state "
-                         "(per replica; see docs/serving.md#async-engine)")
+    add_engine_flags(pv)
     pv.add_argument("--multiproc", action="store_true",
                     help="run replicas as real worker PROCESSES "
                          "(serve-worker) under the process supervisor: "
@@ -721,13 +774,7 @@ def main(argv=None) -> int:
                          "incarnation)")
     pw.add_argument("--no-fsync", action="store_true",
                     help="disable fsync-per-finish journal durability")
-    pw.add_argument("--pool-size", type=int, default=8)
-    pw.add_argument("--max-queue", type=int, default=64)
-    pw.add_argument("--prefill-chunk", type=int, default=0)
-    pw.add_argument("--page-size", type=int, default=0)
-    pw.add_argument("--n-pages", type=int, default=0)
-    pw.add_argument("--no-prefix-cache", action="store_true")
-    pw.add_argument("--decode-window", type=int, default=1)
+    add_engine_flags(pw)
     pw.set_defaults(fn=cmd_serve_worker)
 
     pe = sub.add_parser("eval", help="estimate train/val loss")

@@ -868,6 +868,28 @@ def verify_step_multi(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
 # Paged KV pool (serving engine: page tables instead of contiguous slots)
 # ---------------------------------------------------------------------------
 
+def _constrain(x, s):
+    """``jax.lax.with_sharding_constraint`` when a sharding is given;
+    identity when ``s`` is None. The sharded serving engine pins the
+    page pool and the per-slot step state to their PartitionSpecs
+    (parallel.mesh.ServeShardings) INSIDE every traced program: GSPMD
+    left alone may re-layout a scan carry mid-program, and donation
+    only aliases input to output when their shardings match — so the
+    pool spec must survive every window/verify/prefill body unchanged,
+    and the sampled token block must leave fully replicated (the
+    engine's one-``np.asarray``-per-window fetch stays a local read)."""
+    if s is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, s)
+
+
+def _constrain_cache(cache: Dict[str, jnp.ndarray], shardings
+                     ) -> Dict[str, jnp.ndarray]:
+    if shardings is None:
+        return cache
+    return {n: _constrain(a, shardings.cache) for n, a in cache.items()}
+
+
 def init_paged_kv_pool(cfg: ModelConfig, n_pages: int, page_size: int,
                        dtype=None) -> Dict[str, jnp.ndarray]:
     """Paged KV storage for the serving engine (serve/pages.py): the
@@ -1037,7 +1059,8 @@ def decode_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
                         eos: jnp.ndarray, tables: jnp.ndarray,
                         cache: Dict[str, jnp.ndarray], rngs: jnp.ndarray,
                         cfg: ModelConfig, *, sample_fn, length: int,
-                        use_pallas: bool = False, use_fused: bool = False):
+                        use_pallas: bool = False, use_fused: bool = False,
+                        shardings=None):
     """``length`` decode steps over the paged pool in ONE traced program
     — the device-resident loop the async serving engine dispatches once
     per WINDOW instead of once per token (the lax.scan analogue of the
@@ -1068,7 +1091,16 @@ def decode_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
     slot deactivates once and never re-arms inside a window); the rest
     is the advanced step state the caller feeds to the NEXT window
     (donated end to end by the engine's jit wrapper).
+
+    ``shardings`` (parallel.mesh.ServeShardings, None = unsharded)
+    pins the scan carry on a serving mesh: the page pool to its
+    (data, model) PartitionSpec and the step state + per-step token
+    outputs to replication, so window-to-window donation aliases and
+    the engine's token-block fetch stays a local read (see
+    ``_constrain``).
     """
+    rep = None if shardings is None else shardings.rep
+
     def body(carry, _):
         tok, pos, active, budget, cache, rngs = carry
         logits, cache = decode_step_paged(
@@ -1082,6 +1114,10 @@ def decode_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
         pos = jnp.where(emitted, pos + 1, pos)
         tok = jnp.where(emitted, nxt, tok)
         active = active & (budget > 0) & ~hit_eos
+        cache = _constrain_cache(cache, shardings)
+        tok, pos, active, budget, rngs, nxt, emitted = (
+            _constrain(a, rep) for a in (tok, pos, active, budget, rngs,
+                                         nxt, emitted))
         return (tok, pos, active, budget, cache, rngs), (nxt, emitted)
 
     carry = (tok, pos, active, budget, cache, rngs)
@@ -1093,11 +1129,12 @@ def decode_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
 def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
                       n_valid: jnp.ndarray, active: jnp.ndarray,
                       tables: jnp.ndarray, cache: Dict[str, jnp.ndarray],
-                      cfg: ModelConfig
+                      cfg: ModelConfig, *, shardings=None
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """``verify_step_multi`` over a paged pool: the speculative window's
     K/V scatters through each slot's page table and the whole drafted
-    window attends the gathered logical view.
+    window attends the gathered logical view. ``shardings`` pins the
+    pool layout per layer on a serving mesh (see ``_constrain``).
 
     Window token j of slot b sits at logical position pos[b]+j, physical
     page ``tables[b, (pos+j)//page]`` offset ``(pos+j) % page``. Padding
@@ -1154,6 +1191,8 @@ def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
             jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
             tables, packed, H)
         attn = windowed_cached_attention(q_h, k_all, v_all, pos_eff)
+        ck = _constrain(ck, None if shardings is None else shardings.cache)
+        cv = _constrain(cv, None if shardings is None else shardings.cache)
         return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
                 ck, cv), None
 
@@ -1178,9 +1217,11 @@ def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
 def prefill_chunk_paged(params: Params, idx: jnp.ndarray,
                         offset: jnp.ndarray, limit: jnp.ndarray,
                         table_row: jnp.ndarray,
-                        cache: Dict[str, jnp.ndarray], cfg: ModelConfig
-                        ) -> Dict[str, jnp.ndarray]:
+                        cache: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
+                        shardings=None) -> Dict[str, jnp.ndarray]:
     """Chunked prefill of ONE slot's prompt through its page table.
+    ``shardings`` pins the pool layout per layer on a serving mesh
+    (see ``_constrain``).
 
     idx: (1, Pc) chunk of the prompt; offset: scalar int32 first
     absolute position (with a prefix-cache hit the first chunk starts at
@@ -1238,6 +1279,8 @@ def prefill_chunk_paged(params: Params, idx: jnp.ndarray,
             table_row[None], packed, H)
         attn = windowed_cached_attention(_split_heads(q_m, H), k_all,
                                          v_all, base)
+        ck = _constrain(ck, None if shardings is None else shardings.cache)
+        cv = _constrain(cv, None if shardings is None else shardings.cache)
         return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
                 ck, cv), None
 

@@ -350,14 +350,19 @@ def fused_decode_layers(x0: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
 # ---------------------------------------------------------------------------
 
 def fused_paged_decode_supported(cfg, n_slots: int, page_size: int,
-                                 itemsize: int = 2) -> bool:
+                                 itemsize: int = 2, mesh=None) -> bool:
     """Envelope for ``fused_paged_decode_layers``: packed cache layout,
     lane-sliceable heads, sublane-aligned pages, per-head accumulator
     lanes available, and one layer's weights + a double-buffered page
     pair + the (n_slots, C) residual scratch within FUSED_LAYER_BYTES.
     The serve engine prefers this route over the per-layer paged kernel
     (ops/paged_pallas.py) whenever it fits — one launch per decode step
-    instead of one per layer."""
+    instead of one per layer. On a >1-device serving mesh the route is
+    OFF (``ops.paged_pallas.paged_kernel_mesh_ok``): a bare pallas_call
+    cannot be GSPMD-partitioned, so sharded engines take the XLA path."""
+    from .paged_pallas import paged_kernel_mesh_ok
+    if not paged_kernel_mesh_ok(mesh):
+        return False
     if cfg.decode_cache_layout != "packed":
         return False
     C, H = cfg.n_embd, cfg.n_head

@@ -44,9 +44,24 @@ PAGED_DECODE_BYTES = 4 * 1024 * 1024
 
 def _paged_attn_backend_ok() -> bool:
     """Pallas lowering gate (tests monkeypatch this to run the
-    interpret-mode kernel on CPU). Sharding safety is the caller's
-    concern — the serve engine is single-device by construction."""
+    interpret-mode kernel on CPU). Sharding safety is a SEPARATE gate:
+    ``paged_kernel_mesh_ok`` — the serve engine may now run on a
+    (data, model) mesh, where a bare pallas_call cannot partition."""
     return jax.default_backend() == "tpu"
+
+
+def paged_kernel_mesh_ok(mesh) -> bool:
+    """Sharding-aware kernel routing: a bare ``pallas_call`` cannot be
+    GSPMD-partitioned, so on a >1-device serving mesh both this file's
+    per-layer paged-attention kernel and the fused all-layers kernel
+    (ops/decode_pallas.py) must route to the XLA gather path inside
+    ``models.gpt.decode_step_paged`` — that path is plain gather/
+    scatter/einsum, which the partitioner handles. A future shard_map
+    wrapper (per-shard kernel over the chip's local page block, specs
+    from parallel.mesh.page_pool_pspec) would lift this gate; until
+    then falling back IS the routing decision, made once per engine at
+    construction (never inside a traced program)."""
+    return mesh is None or mesh.size == 1
 
 
 def clamped_live_page(p, pos, page_size: int):
@@ -63,9 +78,12 @@ def clamped_live_page(p, pos, page_size: int):
 
 
 def paged_decode_supported(n_head: int, head_dim: int, page_size: int,
-                           itemsize: int = 2) -> bool:
+                           itemsize: int = 2, mesh=None) -> bool:
     """Envelope: lane-sliceable heads, sublane-aligned page length,
-    per-head accumulator lanes available, both page blocks in budget."""
+    per-head accumulator lanes available, both page blocks in budget —
+    and no serving mesh (``paged_kernel_mesh_ok``)."""
+    if not paged_kernel_mesh_ok(mesh):
+        return False
     if head_dim not in (32, 64, 128, 256) or n_head > LANES:
         return False
     if page_size % 8 != 0:

@@ -33,6 +33,7 @@ Batches are (B, T) sharded ('data', 'seq').
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -163,3 +164,152 @@ def shard_train_state(create_fn: Callable[[], Any], mesh: Mesh,
     shardings = state_shardings(abstract, mesh, mesh_cfg)
     with jax.set_mesh(mesh):
         return jax.jit(create_fn, out_shardings=shardings)()
+
+
+# ---------------------------------------------------------------------------
+# Serving mesh: the (data, model) layout of the sharded engine
+# ---------------------------------------------------------------------------
+#
+# The serving engine (serve/engine.py) runs on a 2-axis slice of the
+# framework mesh: 'data' multiplies KV capacity (the paged pool's
+# physical page axis shards across it, so each chip stores
+# n_pages/data pages and the same per-chip HBM holds data× more
+# aggregate pages), 'model' multiplies attention/MLP FLOPs per step
+# (Megatron TP — the same column/row specs training uses, but
+# replicated over 'data': FSDP's gather-per-use trades latency for
+# memory in exactly the wrong direction for single-token decode).
+#
+# Page-pool PartitionSpec, designed first (ROADMAP item 1):
+#
+# =========================  ===========================  ==============
+# array                      shape                        spec
+# =========================  ===========================  ==============
+# page pool (packed)         (L, n_pages, page, C)        (None, 'data',
+#                                                          None, 'model')
+# page pool (heads)          (L, n_pages, H, page, D)     (None, 'data',
+#                                                          'model', None,
+#                                                          None)
+# step vectors / tables /    (n_slots,) (n_slots, mp)     replicated
+# token block                (k, n_slots)
+# params                     Megatron TP over 'model'     (see table up
+#                            (decode layout: no FSDP)      top)
+# =========================  ===========================  ==============
+#
+# Rationale: the model dim (C, or H for the heads layout) shards over
+# 'model' so each chip's page shard stores only its TP heads' K/V —
+# the gathered logical view then lines up with the TP-sharded QKV
+# activations without resharding. The PAGE axis (not the slot axis)
+# shards over 'data': pages are the physical storage (slots are host
+# bookkeeping + fixed-shape tables), so page-axis sharding is what
+# actually divides HBM bytes per chip. The tiny per-step vectors and
+# the (k, n_slots) sampled-token block replicate — the engine fetches
+# ONE replicated block per window (`np.asarray` reads a local shard,
+# never a cross-device gather), preserving the async engine's
+# one-host-snapshot-per-window contract. Non-divisible dims drop their
+# axis to None exactly like `_leaf_spec` (documented, not silent: the
+# pool's stats() reports the effective mesh shape).
+
+
+def parse_mesh_shape(text: str) -> Tuple[int, int]:
+    """'2x2' / '2,2' / '4x1' -> (data, model). The serving CLI/bench
+    flag format; '1x1' is the unsharded identity."""
+    s = text.lower().replace(",", "x").split("x")
+    if len(s) != 2:
+        raise ValueError(f"--mesh-shape must be DxM (e.g. 2x2), got "
+                         f"{text!r}")
+    d, m = int(s[0]), int(s[1])
+    if d < 1 or m < 1:
+        raise ValueError(f"--mesh-shape axes must be >= 1, got {text!r}")
+    return d, m
+
+
+def resolve_mesh_shape(text: str, n_devices: int,
+                       warn=None) -> Tuple[int, int]:
+    """``parse_mesh_shape`` + the device-count downgrade rule — ONE
+    definition (message included) for the CLI
+    (`engine_config_from_args`) and bench: a mesh the process cannot
+    satisfy resolves to (1, 1) (degrade, not die — the
+    `_build_mesh_if_needed` convention), with the downgrade reported
+    through ``warn`` (a callable taking the message; None = silent)."""
+    d, m = parse_mesh_shape(text)
+    if d * m > max(n_devices, 1):
+        if warn is not None:
+            warn(f"serve mesh {text} wants {d * m} devices, have "
+                 f"{n_devices}; running unsharded")
+        return 1, 1
+    return d, m
+
+
+def make_serve_mesh(data: int, model: int,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """The serving engine's (data, model) mesh — a MeshConfig slice of
+    the framework mesh (seq=pipe=1), so every PartitionSpec axis name
+    used anywhere in the framework stays valid on it."""
+    return make_mesh(MeshConfig(data=data, model=model), devices=devices)
+
+
+def page_pool_pspec(cfg: ModelConfig, n_pages: int, data: int,
+                    model: int) -> P:
+    """The paged KV pool's PartitionSpec (table above), with
+    non-divisible axes dropped to replication the same way `_leaf_spec`
+    drops TP dims — a 7-page pool on data=2 replicates pages rather
+    than pad-sharding them."""
+    d_ax = "data" if data > 1 and n_pages % data == 0 else None
+    if cfg.decode_cache_layout == "packed":
+        axes = (None, d_ax, None,
+                "model" if model > 1 and cfg.n_embd % model == 0 else None)
+    else:
+        axes = (None, d_ax,
+                "model" if model > 1 and cfg.n_head % model == 0 else None,
+                None, None)
+    # trailing Nones trimmed: jit NORMALIZES output specs this way, and
+    # the engine's jit caches key on input shardings — an untrimmed
+    # spec here would make "cache fresh from device_put" and "cache
+    # from the previous program's output" two different programs (a
+    # recompile per step, caught by CompileGuard)
+    while axes and axes[-1] is None:
+        axes = axes[:-1]
+    return P(*axes)
+
+
+@dataclass(frozen=True)
+class ServeShardings:
+    """The sharding bundle threaded through every device program the
+    engine owns (a STATIC jit argument: hashable, one value per
+    engine). ``cache`` pins the page pool's layout inside every traced
+    program — donation aliases input to output only when their
+    shardings match, so the pool spec must survive each scan body
+    unchanged; ``rep`` pins the per-slot step state and the sampled
+    token block to full replication (the host fetch stays local).
+
+    ``rep2`` is the same full replication in the RANK-2 spec
+    representation ``P(None, None)``: the jit cache key is
+    representational (``P() != P(None, None)`` even though both mean
+    replicated), a no-op with_sharding_constraint does not rewrite the
+    propagated representation, and the window program's (B, 2) rng
+    streams propagate out rank-matched — so the engine's bootstrap
+    commit of the rng state must use this representation or the first
+    steady-state dispatch after it compiles the same program twice
+    (caught by CompileGuard, pinned in tests/test_serve_mesh.py)."""
+
+    cache: NamedSharding
+    rep: NamedSharding
+    rep2: NamedSharding
+
+
+def serve_shardings(mesh: Mesh, cfg: ModelConfig, n_pages: int,
+                    data: int, model: int) -> ServeShardings:
+    return ServeShardings(
+        cache=NamedSharding(mesh, page_pool_pspec(cfg, n_pages, data,
+                                                  model)),
+        rep=NamedSharding(mesh, P()),
+        rep2=NamedSharding(mesh, P(None, None)))
+
+
+def serve_param_shardings(cfg: ModelConfig, mesh: Mesh, model: int) -> Any:
+    """Decode-time parameter layout: Megatron TP over 'model',
+    replicated over 'data' (the `shard_for_decode` rationale — no FSDP,
+    no pipe at decode)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        param_pspecs(cfg, MeshConfig(model=model)))

@@ -45,15 +45,20 @@ def prefill_chunk_size(requested: int, block_size: int) -> int:
     return c
 
 
-def commit_default(x):
-    """device_put onto an EXPLICIT device (the configured default) —
+def commit_default(x, sharding=None):
+    """device_put onto an EXPLICIT placement (the configured default
+    device, or ``sharding`` — a NamedSharding over the serving mesh) —
     plain device_put without a device keeps the array *uncommitted*,
     and the engine's jit cache keys on committed-ness: engine-owned
     state must enter the first call exactly as it leaves every step (a
     committed jit output), or warmup compiles one throwaway executable
     per program (observed with checkpoint-restored, i.e. committed,
-    params)."""
+    params). The sharded engine passes its mesh placement here for the
+    same reason: state must enter each window exactly as the previous
+    window's constrained outputs left it."""
     import jax
+    if sharding is not None:
+        return jax.device_put(x, sharding)
     dev = jax.config.jax_default_device or jax.local_devices()[0]
     return jax.device_put(x, dev)
 

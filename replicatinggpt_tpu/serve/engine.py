@@ -43,14 +43,29 @@ Per step the engine:
    multi-token decode windows while speculation is degraded).
 
 Zero recompiles at steady state: the decode/verify programs are keyed
-only on the (static) model config, pool/page shapes and draft width,
-the prefill program only on the chunk shape, the COW page copy on the
-pool shape alone; page tables, positions and every other request-level
-input are traced fixed-shape arrays, so admissions, prefix hits, LRU
-evictions and copy-on-write splits all happen without a recompile. All
-are module-level jits whose cache sizes the tests assert stay flat
-across a long replay (tests/test_serve.py, tests/test_speculative.py,
-tests/test_pages.py).
+only on the (static) model config, pool/page shapes, draft width and
+the engine's sharding plan, the prefill program only on the chunk
+shape, the COW page copy on the pool shape alone; page tables,
+positions and every other request-level input are traced fixed-shape
+arrays, so admissions, prefix hits, LRU evictions and copy-on-write
+splits all happen without a recompile. All are module-level jits whose
+cache sizes the tests assert stay flat across a long replay
+(tests/test_serve.py, tests/test_speculative.py, tests/test_pages.py).
+
+Sharded serving (``EngineConfig.mesh_data``/``mesh_model``, the
+``--mesh-shape`` knob): the SAME engine runs GSPMD-partitioned over a
+(data, model) mesh — params take the decode TP layout, the paged pool
+shards its physical page axis over 'data' and its model dim over
+'model' (parallel.mesh.page_pool_pspec, designed first per ROADMAP),
+and every program above carries the engine's static
+``ServeShardings`` bundle so the pool layout survives each traced body
+(donation needs matching shardings to alias) while the step state and
+the per-window token block stay replicated — the host fetch contract
+(one ``np.asarray`` per window, reading a local shard) is unchanged.
+Request-level architecture, host bookkeeping and the paged Pallas
+fallback routing (ops/paged_pallas.paged_kernel_mesh_ok) are all
+mesh-agnostic; greedy streams are token-identical across mesh shapes
+(tests/test_serve_mesh.py).
 
 Observability: per-request TTFT / decode tok/s / queue wait, engine
 counters (admissions, rejections, completions, tokens), slot-occupancy
@@ -121,6 +136,21 @@ class EngineConfig:
                                 # any step with an admission, active-
                                 # deadline expiry, cancel, or
                                 # speculative verify/re-probe pending
+    # --- serving mesh (parallel/mesh.py, the --mesh-shape knob) ---------
+    mesh_data: int = 1          # 'data' axis: the paged pool's physical
+                                # page axis shards across it — each chip
+                                # stores n_pages/data pages, so the same
+                                # per-chip HBM holds data× more
+                                # aggregate pages (capacity multiplier)
+    mesh_model: int = 1         # 'model' axis: Megatron TP over the
+                                # decode/prefill/verify programs
+                                # (attention+MLP FLOPs multiplier);
+                                # params shard by the training TP specs,
+                                # replicated over 'data'
+
+    @property
+    def mesh_shape(self) -> tuple:
+        return (self.mesh_data, self.mesh_model)
 
     def chunk(self, block_size: int) -> int:
         """Effective prefill chunk — see ``cache_pool.prefill_chunk_size``
@@ -171,14 +201,15 @@ class _InFlight:
     n_active: int                 # live slots at launch
 
 
-@partial(jax.jit, static_argnames=("cfg", "k", "use_pallas", "use_fused"),
+@partial(jax.jit, static_argnames=("cfg", "k", "use_pallas", "use_fused",
+                                   "shardings"),
          donate_argnames=("tok", "pos", "active", "budget", "cache",
                           "rngs"))
 def _engine_decode_window(params, tok, pos, active, budget, eos, tables,
                           cache, rngs, temp, top_k, top_p, greedy,
                           cfg: ModelConfig, k: int,
                           use_pallas: bool = False,
-                          use_fused: bool = False):
+                          use_fused: bool = False, shardings=None):
     """The steady-state program: ``k`` multi-slot PAGED decode + batched
     sample steps in ONE dispatch (``models.gpt.decode_window_paged``),
     with the whole per-slot step state ``(tok, pos, active, budget,
@@ -196,6 +227,15 @@ def _engine_decode_window(params, tok, pos, active, budget, eos, tables,
     with their cache writes DROPPED inside ``decode_step_paged`` (a
     released slot's stale table may reference pages another request now
     owns) and their sampled token is masked to 0.
+
+    ``shardings`` (parallel.mesh.ServeShardings; STATIC — hashable, one
+    value per engine, so sharded and unsharded engines are distinct
+    programs under the same budget discipline) runs the whole window on
+    the serving mesh: the page pool stays pinned to its (data, model)
+    PartitionSpec through every scan step (donation needs matching in/
+    out shardings to alias), the step state and the (k, n_slots) token
+    block leave fully replicated — the caller's ``np.asarray`` fetch is
+    a local read, never a cross-device gather.
     """
     def sample_fn(rngs, logits):
         splits = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
@@ -206,20 +246,23 @@ def _engine_decode_window(params, tok, pos, active, budget, eos, tables,
     return decode_window_paged(params, tok, pos, active, budget, eos,
                                tables, cache, rngs, cfg,
                                sample_fn=sample_fn, length=k,
-                               use_pallas=use_pallas, use_fused=use_fused)
+                               use_pallas=use_pallas, use_fused=use_fused,
+                               shardings=shardings)
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+@partial(jax.jit, static_argnames=("cfg", "shardings"),
+         donate_argnames=("cache",))
 def _engine_prefill(params, chunk, offset, limit, table_row, cache,
-                    cfg: ModelConfig):
+                    cfg: ModelConfig, shardings=None):
     return prefill_chunk_paged(params, chunk, offset, limit, table_row,
-                               cache, cfg)
+                               cache, cfg, shardings=shardings)
 
 
-@partial(jax.jit, static_argnames=("cfg",),
+@partial(jax.jit, static_argnames=("cfg", "shardings"),
          donate_argnames=("cache", "rngs"))
 def _engine_verify(params, window, pos, m, active, tables, cache, rngs,
-                   temp, top_k, top_p, greedy, cfg: ModelConfig):
+                   temp, top_k, top_p, greedy, cfg: ModelConfig,
+                   shardings=None):
     """The speculative steady-state program: ONE target forward over a
     static (n_slots, k+1) window against the PAGED pool + per-position
     acceptance. Draft count k is carried by the window's static width,
@@ -228,30 +271,44 @@ def _engine_verify(params, window, pos, m, active, tables, cache, rngs,
     draft counts, page tables, sampling params, the drafted tokens —
     are traced fixed-shape arrays, so acceptance outcomes never
     retrace. Inactive slots run at position 0 with zero valid drafts
-    and dropped writes; their outputs are masked.
+    and dropped writes; their outputs are masked. ``shardings`` runs
+    the verify forward on the serving mesh (pool pinned per layer) with
+    the acceptance outputs replicated for the host commit.
     """
     logits, cache = verify_step_paged(params, window, pos, m, active,
-                                      tables, cache, cfg)
+                                      tables, cache, cfg,
+                                      shardings=shardings)
     m_eff = jnp.where(active, m, 0)
     n_acc, out, rngs = spec_accept_and_sample(rngs, logits, window, m_eff,
                                               temp, top_k, top_p, greedy)
-    return (jnp.where(active, n_acc, 0),
-            jnp.where(active[:, None], out, 0), cache, rngs)
+    n_acc = jnp.where(active, n_acc, 0)
+    out = jnp.where(active[:, None], out, 0)
+    if shardings is not None:
+        n_acc = jax.lax.with_sharding_constraint(n_acc, shardings.rep)
+        out = jax.lax.with_sharding_constraint(out, shardings.rep)
+        rngs = jax.lax.with_sharding_constraint(rngs, shardings.rep)
+    return n_acc, out, cache, rngs
 
 
-@partial(jax.jit, donate_argnames=("cache",))
-def _engine_page_copy(cache, src, dst):
+@partial(jax.jit, static_argnames=("shardings",),
+         donate_argnames=("cache",))
+def _engine_page_copy(cache, src, dst, shardings=None):
     """Copy-on-write page split: duplicate physical page ``src`` into
     ``dst`` across all layers of both pool arrays. One program for any
     (src, dst) — both traced scalars — warmed at engine construction so
     the first real COW mid-replay cannot cost a compile. The caller
-    bounds dst host-side (check_in_bounds below no-ops on tracers)."""
+    bounds dst host-side (check_in_bounds below no-ops on tracers). On
+    a serving mesh the copy crosses data shards when src and dst land
+    on different chips — GSPMD inserts the collective; the output stays
+    pinned to the pool spec so the donated buffers alias."""
     out = {}
     for name, arr in cache.items():
         check_in_bounds(dst, 1, arr.shape[1], what="COW page copy")
         page = jax.lax.dynamic_index_in_dim(arr, src, 1, keepdims=True)
-        out[name] = jax.lax.dynamic_update_slice_in_dim(arr, page, dst,
-                                                        axis=1)
+        new = jax.lax.dynamic_update_slice_in_dim(arr, page, dst, axis=1)
+        if shardings is not None:
+            new = jax.lax.with_sharding_constraint(new, shardings.cache)
+        out[name] = new
     return out
 
 
@@ -350,10 +407,38 @@ class Engine:
                     "draft model must share the target block_size"
                 assert drafter.pool_size == ecfg.pool_size, \
                     "draft pool must match the engine pool"
+        # serving mesh (parallel/mesh.py): params take the decode TP
+        # layout (Megatron over 'model', replicated over 'data'), the
+        # page pool its (data, model) PartitionSpec — both placed ONCE
+        # here; every jitted program then carries the same static
+        # ServeShardings bundle, so GSPMD runs the whole engine sharded
+        # without any program gaining a second compiled variant.
+        # Drafter params/caches stay single-device (they are separate
+        # jits over separate state — prefix reuse logic is unchanged).
+        self.mesh = None
+        self._plan = None
+        if ecfg.mesh_data > 1 or ecfg.mesh_model > 1:
+            from ..parallel.mesh import (make_serve_mesh,
+                                         serve_param_shardings,
+                                         serve_shardings)
+            from .pages import pool_geometry
+            self.mesh = make_serve_mesh(ecfg.mesh_data, ecfg.mesh_model)
+            _, _, n_pages_eff = pool_geometry(
+                cfg, ecfg.pool_size, ecfg.page_size, ecfg.max_pages,
+                ecfg.n_pages)
+            self._plan = serve_shardings(self.mesh, cfg, n_pages_eff,
+                                         ecfg.mesh_data, ecfg.mesh_model)
+            self.params = jax.device_put(
+                self.params,
+                serve_param_shardings(cfg, self.mesh, ecfg.mesh_model))
+        self._rep = self._plan.rep if self._plan is not None else None
         self.pool = PagedCachePool(
             cfg, ecfg.pool_size, page_size=ecfg.page_size,
             max_pages=ecfg.max_pages, n_pages=ecfg.n_pages,
-            prefix_cache=ecfg.prefix_cache, telemetry=self.tel)
+            prefix_cache=ecfg.prefix_cache, telemetry=self.tel,
+            sharding=(self._plan.cache if self._plan is not None
+                      else None),
+            mesh_shape=(ecfg.mesh_data, ecfg.mesh_model))
         self.scheduler = Scheduler(ecfg.max_queue, cfg.block_size,
                                    clock=clock)
         self.metrics = Metrics()
@@ -369,16 +454,19 @@ class Engine:
         # fallback when the layer weights don't fit its VMEM envelope.
         from ..ops import decode_pallas, paged_pallas
         itemsize = jnp.dtype(self.pool.cache["k"].dtype).itemsize
+        # (the mesh gate lives inside the two supported() calls below
+        # — ops.paged_pallas.paged_kernel_mesh_ok is the one seam)
         kernel_ok = (ecfg.paged_kernel
                      and cfg.decode_cache_layout == "packed"
                      and paged_pallas._paged_attn_backend_ok())
         self._use_fused = bool(
             kernel_ok and decode_pallas.fused_paged_decode_supported(
-                cfg, P, self.pool.page_size, itemsize))
+                cfg, P, self.pool.page_size, itemsize, mesh=self.mesh))
         self._use_pallas = bool(
             kernel_ok and not self._use_fused
             and paged_pallas.paged_decode_supported(
-                cfg.n_head, cfg.head_dim, self.pool.page_size, itemsize))
+                cfg.n_head, cfg.head_dim, self.pool.page_size, itemsize,
+                mesh=self.mesh))
         self._tok = np.zeros((P,), np.int32)
         # ALIAS of pool.positions (one host buffer): the pool exposes the
         # committed frontier to drafters, the engine advances it in place
@@ -402,8 +490,14 @@ class Engine:
         # CachePool.cache (the array becomes a committed jit output
         # after the first step)
         from .cache_pool import commit_default
+        # rng streams are (P, 2): their bootstrap commit must use the
+        # rank-2 replicated REPRESENTATION (ServeShardings.rep2) — the
+        # jit cache key is representational, and the window programs
+        # propagate the rng state out rank-matched
         self._rngs = commit_default(
-            jnp.stack([jax.random.PRNGKey(i) for i in range(P)]))
+            jnp.stack([jax.random.PRNGKey(i) for i in range(P)]),
+            sharding=(self._plan.rep2 if self._plan is not None
+                      else None))
         self._slots: Dict[int, _Active] = {}
         self._pending: List[RequestResult] = []  # cancellations between steps
         self.n_steps = 0
@@ -427,7 +521,8 @@ class Engine:
         # the first real copy-on-write happens mid-replay, where a
         # compile would break the pinned-flat compile_counts invariant
         self.pool.cache = self._copy_guard(self.pool.cache, jnp.int32(0),
-                                           jnp.int32(0))
+                                           jnp.int32(0),
+                                           shardings=self._plan)
         self._sanitize = sanitize_enabled()
         # self-healing (faults.watchdog): all policies opt-in via rcfg.
         # Degraded transitions move between the two already-budgeted
@@ -841,7 +936,8 @@ class Engine:
                              request=req.id)
             self.pool.cache = self._copy_guard(self.pool.cache,
                                                jnp.int32(src),
-                                               jnp.int32(dst))
+                                               jnp.int32(dst),
+                                               shardings=self._plan)
         claimed = adm.claimed
         S = self.pool.seq_len
         if claimed < P:
@@ -867,7 +963,8 @@ class Engine:
                         jnp.asarray(padded[None,
                                            c * chunk:(c + 1) * chunk]),
                         jnp.int32(claimed + c * chunk), jnp.int32(P),
-                        table_row, cache, self.cfg)
+                        table_row, cache, self.cfg,
+                        shardings=self._plan)
                     if self.tel.enabled:
                         # host dispatch time (the device runs async);
                         # a jax.profiler capture of the same run shows
@@ -947,9 +1044,12 @@ class Engine:
             # committed, like every engine-owned jit input: the state
             # must enter this call exactly as it leaves the donated
             # steady-state loop (a committed output), or the jit cache
-            # keys the two placements as two programs
+            # keys the two placements as two programs — on a mesh that
+            # means replicated over every device (the constrained
+            # window output's placement), not one chip
             from .cache_pool import commit_default
-            state = tuple(commit_default(jnp.asarray(a)) for a in
+            state = tuple(commit_default(jnp.asarray(a),
+                                         sharding=self._rep) for a in
                           (self._tok, self._pos, self._active,
                            self._budget))
         else:
@@ -962,7 +1062,8 @@ class Engine:
                 self.pool.cache, self._rngs, jnp.asarray(self._temp),
                 jnp.asarray(self._top_k), jnp.asarray(self._top_p),
                 jnp.asarray(self._greedy), self.cfg, k=k,
-                use_pallas=self._use_pallas, use_fused=self._use_fused)
+                use_pallas=self._use_pallas, use_fused=self._use_fused,
+                shardings=self._plan)
         self.pool.cache = cache
         self._rngs = rngs
         self._dev_state = (tok, pos, active, budget)
@@ -1158,7 +1259,8 @@ class Engine:
                 jnp.asarray(self.pool.tables), self.pool.cache,
                 self._rngs, jnp.asarray(self._temp),
                 jnp.asarray(self._top_k), jnp.asarray(self._top_p),
-                jnp.asarray(self._greedy), self.cfg)
+                jnp.asarray(self._greedy), self.cfg,
+                shardings=self._plan)
             self.step_timer.lap(n_acc)
         self.pool.cache = cache
         self._rngs = rngs

@@ -58,6 +58,19 @@ def default_page_size(requested: int, block_size: int) -> int:
     return min(requested or 16, block_size)
 
 
+def pool_geometry(cfg: ModelConfig, n_slots: int, page_size: int = 0,
+                  max_pages: int = 0,
+                  n_pages: int = 0) -> Tuple[int, int, int]:
+    """Resolve the (page_size, max_pages_per_slot, n_pages) triple from
+    the EngineConfig knobs — ONE definition shared by the pool's
+    constructor and the sharded engine, which must size the page pool's
+    PartitionSpec (parallel.mesh.page_pool_pspec divisibility) BEFORE
+    the pool allocates its device arrays."""
+    psz = default_page_size(page_size, cfg.block_size)
+    mp = max_pages or -(-cfg.block_size // psz)
+    return psz, mp, (n_pages or n_slots * mp)
+
+
 class _RadixNode:
     __slots__ = ("id", "page", "parent", "key", "n_children", "last_use")
 
@@ -197,6 +210,20 @@ class PageAllocator:
     @property
     def pages_in_use(self) -> int:
         return self.n_pages - len(self._free)
+
+    def in_use_by_block(self, n_blocks: int) -> List[int]:
+        """Pages in use per contiguous block of the physical page axis
+        — exactly per-CHIP occupancy when the pool's page axis shards
+        over the serving mesh's 'data' axis (NamedSharding assigns
+        contiguous blocks), so the router's least-loaded signal and the
+        Prometheus gauges stay meaningful on a mesh. 'In use' matches
+        ``pages_in_use``: slot-referenced pages AND radix-held
+        refcount-0 prefix pages (both occupy HBM)."""
+        free = np.zeros((self.n_pages,), bool)
+        free[np.fromiter(self._free, np.int64, len(self._free))] = True
+        blk = -(-self.n_pages // n_blocks)
+        return [int((~free[i * blk:(i + 1) * blk]).sum())
+                for i in range(n_blocks)]
 
     def n_pages_for(self, n_prompt: int, cap: int) -> int:
         """Logical pages a request needs END TO END: the last write
@@ -351,25 +378,42 @@ class PagedCachePool:
 
     def __init__(self, cfg: ModelConfig, n_slots: int, *,
                  page_size: int = 0, max_pages: int = 0, n_pages: int = 0,
-                 prefix_cache: bool = True, dtype=None, telemetry=None):
+                 prefix_cache: bool = True, dtype=None, telemetry=None,
+                 sharding=None, mesh_shape: Tuple[int, int] = (1, 1)):
+        """``sharding`` (a NamedSharding from
+        ``parallel.mesh.serve_shardings().cache``) commits the page
+        pool onto the serving mesh instead of one device: the physical
+        page axis shards over 'data' (each chip stores
+        ceil(n_pages / data) pages — the capacity multiplier) and the
+        model dim over 'model'. All HOST state here (allocator, radix,
+        tables) is mesh-agnostic: page ids are logical either way.
+        ``mesh_shape`` is carried for stats()/gauges only."""
         assert n_slots >= 1, n_slots
         self.cfg = cfg
         self.n_slots = n_slots
-        self.page_size = default_page_size(page_size, cfg.block_size)
-        self.max_pages = max_pages or -(-cfg.block_size // self.page_size)
+        self.page_size, self.max_pages, self.n_pages = pool_geometry(
+            cfg, n_slots, page_size, max_pages, n_pages)
         assert self.max_pages * self.page_size >= cfg.block_size, (
             f"max_pages={self.max_pages} x page_size={self.page_size} "
             f"cannot hold block_size={cfg.block_size}")
         # default physical pool = the contiguous pool's HBM exactly;
         # fewer pages is the point (admission then gates on free pages)
-        self.n_pages = n_pages or n_slots * self.max_pages
         assert self.n_pages >= self.max_pages, (
             "pool smaller than one slot's worst case")
+        self.mesh_shape = (int(mesh_shape[0]), int(mesh_shape[1]))
+        # effective shard count of the PAGE axis (may be 1 when the
+        # page count was not divisible and the spec dropped the axis)
+        self._page_shards = 1
+        if sharding is not None and len(sharding.spec) > 1 \
+                and sharding.spec[1] is not None:
+            self._page_shards = int(
+                sharding.mesh.shape[sharding.spec[1]])
         self.alloc = PageAllocator(self.n_pages, self.page_size,
                                    prefix_cache=prefix_cache,
                                    telemetry=telemetry)
         self.cache: Dict = commit_default(init_paged_kv_pool(
-            cfg, self.n_pages, self.page_size, dtype=dtype))
+            cfg, self.n_pages, self.page_size, dtype=dtype),
+            sharding=sharding)
         # host-mirrored, device-fed each step (fixed shape: the paged
         # programs never retrace on table contents)
         self.tables = np.zeros((n_slots, self.max_pages), np.int32)
@@ -471,6 +515,15 @@ class PagedCachePool:
 
     def stats(self) -> dict:
         a = self.alloc
+        # mesh accounting: n_pages is the AGGREGATE admission currency
+        # (the allocator is mesh-agnostic); each chip along the data
+        # axis physically stores pages_per_chip of it, so per-chip
+        # occupancy is what a capacity dashboard / the router's
+        # least-loaded signal should watch on a mesh (on 1x1 the
+        # per-chip numbers degenerate to the aggregate ones)
+        d = self._page_shards
+        by_chip = a.in_use_by_block(d)
+        per_chip = -(-self.n_pages // d)
         return {
             "page_size": self.page_size,
             "max_pages_per_slot": self.max_pages,
@@ -478,6 +531,12 @@ class PagedCachePool:
             "pages_in_use": a.pages_in_use,
             "pages_free": a.pages_free,
             "page_utilization": round(a.pages_in_use / self.n_pages, 4),
+            "mesh_shape": list(self.mesh_shape),
+            "aggregate_pages": self.n_pages,
+            "pages_per_chip": per_chip,
+            "pages_in_use_by_chip": by_chip,
+            "page_utilization_by_chip": [round(c / per_chip, 4)
+                                         for c in by_chip],
             "radix_pages": len(a.page_node),
             "prefix_cache": a.prefix_cache,
             "prefix_lookups": a.prefix_lookups,

@@ -248,7 +248,8 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
                 engine.metrics,
                 extra_gauges={k: pages[k] for k in
                               ("pages_in_use", "page_utilization",
-                               "prefix_hit_rate", "radix_pages")
+                               "prefix_hit_rate", "radix_pages",
+                               "pages_per_chip", "aggregate_pages")
                               if k in pages}))
         artifacts["metrics_out"] = metrics_out
     if profile_dir:
@@ -299,6 +300,13 @@ def format_summary(s: dict) -> str:
             f"({pg['prefix_hit_tokens']} tok, rate "
             f"{pg['prefix_hit_rate']:.2f}), {pg['evictions']} evictions, "
             f"{pg['cow_copies']} COW copies"))
+        if pg.get("mesh_shape", [1, 1]) != [1, 1]:
+            d, m = pg["mesh_shape"]
+            lines.insert(2, (
+                f"mesh: {d}x{m} (data x model), "
+                f"{pg['pages_per_chip']} pages/chip of "
+                f"{pg['aggregate_pages']} aggregate, per-chip in use "
+                f"{pg['pages_in_use_by_chip']}"))
     sp = s.get("speculative")
     if sp:
         lines.insert(2, (

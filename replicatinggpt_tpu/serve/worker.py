@@ -47,7 +47,7 @@ import sys
 import time
 from typing import Dict, List, Optional
 
-from .engine import Engine, EngineConfig
+from .engine import Engine
 from .journal import RequestJournal
 from .requests import FINISH_CANCELLED, Request, RequestResult
 from .rpc import (REJECT_REPLICA_DOWN, request_from_wire,
@@ -292,12 +292,12 @@ def run_worker(args) -> int:
                   file=sys.stderr)
         else:
             state = restored
-    ecfg = EngineConfig(pool_size=args.pool_size,
-                        max_queue=args.max_queue,
-                        prefill_chunk=args.prefill_chunk,
-                        page_size=args.page_size, n_pages=args.n_pages,
-                        prefix_cache=not args.no_prefix_cache,
-                        decode_window=getattr(args, "decode_window", 1))
+    # ONE EngineConfig builder with the router process (cli.py): the
+    # multiproc forwarding contract (ENGINE_FORWARD_FLAGS) holds only
+    # if both sides parse the same flags into the same config — a
+    # worker owning its own --mesh-shape slice included
+    from ..cli import engine_config_from_args
+    ecfg = engine_config_from_args(args)
     engine = Engine(state.params, cfg.model, ecfg)
     warm_engine(engine)
 

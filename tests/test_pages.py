@@ -587,7 +587,17 @@ def test_metrics_summary_key_schema(params):
         "page_size", "max_pages_per_slot", "n_pages", "pages_in_use",
         "pages_free", "page_utilization", "radix_pages", "prefix_cache",
         "prefix_lookups", "prefix_hits", "prefix_hit_tokens",
-        "prefix_hit_rate", "evictions", "cow_copies"}
+        "prefix_hit_rate", "evictions", "cow_copies",
+        # sharded-serving block (ISSUE 12): on 1x1 the per-chip numbers
+        # degenerate to the aggregate ones but the SCHEMA is mesh-
+        # independent — dashboards and the router gauges never branch
+        "mesh_shape", "aggregate_pages", "pages_per_chip",
+        "pages_in_use_by_chip", "page_utilization_by_chip"}
+    assert s["pages"]["mesh_shape"] == [1, 1]
+    assert s["pages"]["aggregate_pages"] == s["pages"]["n_pages"]
+    assert s["pages"]["pages_per_chip"] == s["pages"]["n_pages"]
+    assert s["pages"]["pages_in_use_by_chip"] == \
+        [s["pages"]["pages_in_use"]]
     for guard in s["compile_guards"].values():
         assert set(guard) == {"calls", "compiles", "budget"}
     # every histogram summary carries the pinned hist_summary schema
