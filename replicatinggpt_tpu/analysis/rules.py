@@ -1236,5 +1236,92 @@ state = step(state, delta)
     project_checker=_project("check_donated_closure_capture")))
 
 
+# ---------------------------------------------------------------------------
+# GL015 — host-blocking calls inside the windowed dispatch path
+# ---------------------------------------------------------------------------
+
+#: function-name prefixes marking the LAUNCH side of a double-buffered
+#: dispatch path (the serving engine's `_launch*` family): code here runs
+#: BETWEEN dispatching window N and fetching window N-1, so any blocking
+#: fetch forfeits the overlap the whole async design exists to buy
+_GL015_LAUNCH_PREFIXES = ("_launch",)
+#: calls that force a host<->device sync (or drain the in-flight window)
+_GL015_BLOCKING_NAMES = {"np.asarray", "numpy.asarray", "jax.device_get",
+                         "jax.block_until_ready"}
+_GL015_BLOCKING_ATTRS = {"_drain_pending", "_drain_window",
+                         "block_until_ready", "item"}
+
+
+def _check_windowed_host_block(tree: ast.Module, lines: Sequence[str],
+                               path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(node.name.startswith(p)
+                   for p in _GL015_LAUNCH_PREFIXES):
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = dotted(call.func)
+            hit = None
+            if f in _GL015_BLOCKING_NAMES:
+                hit = f
+            elif (isinstance(call.func, ast.Attribute)
+                  and call.func.attr in _GL015_BLOCKING_ATTRS):
+                hit = call.func.attr
+            if hit is not None:
+                findings.append(_finding(
+                    "GL015", call,
+                    f"`{hit}(...)` inside `{node.name}` — the launch "
+                    f"side of a windowed dispatch path must not block "
+                    f"on (or drain) the in-flight window: a "
+                    f"synchronous fetch here serializes host and "
+                    f"device, silently re-creating the blocked "
+                    f"step-per-dispatch loop the window path exists "
+                    f"to amortize; fetch in the drain-side function "
+                    f"(`_drain_window`) after the next window has "
+                    f"launched",
+                    path, lines))
+    return findings
+
+
+_register(Rule(
+    id="GL015", name="windowed-path-host-block",
+    rationale=(
+        "The async serving engine's launch path (`_launch*`) runs "
+        "between dispatching window N and fetching window N-1 — the "
+        "host-runs-ahead overlap that amortizes the per-dispatch host "
+        "tax (BENCH_r03's 4-5x). A blocking fetch (np.asarray of a "
+        "device array, jax.device_get, .block_until_ready(), .item()) "
+        "or a `_drain_pending()`/`_drain_window()` call introduced "
+        "there serializes host against device on EVERY window and "
+        "silently reverts the engine to blocked step-per-dispatch "
+        "behavior — no error, no recompile, just the dispatch-split "
+        "line quietly collapsing. Continuous windows made admissions, "
+        "deadlines and cancels ride the dispatch as masks exactly so "
+        "nothing needs to block at launch; keep every sync in the "
+        "drain-side function, after the next window is in flight."),
+    bad="""\
+class Engine:
+    def _launch(self, k):
+        toks = np.asarray(self._inflight.toks)   # blocks mid-launch
+        self._drain_pending()                    # breaks the window
+        return self._dispatch(k)
+""",
+    good="""\
+class Engine:
+    def _launch(self, k):
+        out = self._dispatch(k)      # enqueue only; no device wait
+        out.copy_to_host_async()     # overlap the transfer
+        return out
+
+    def _drain_window(self, w):
+        return np.asarray(w.toks)    # the ONE sync, at the boundary
+""",
+    checker=_check_windowed_host_block))
+
+
 def all_rule_ids() -> List[str]:
     return sorted(RULES)

@@ -39,7 +39,8 @@ ENGINE_FORWARD_FLAGS = (
     ("mesh_shape", "--mesh-shape"),
 )
 #: store_true engine switches, forwarded only when set
-ENGINE_FORWARD_SWITCHES = (("no_prefix_cache", "--no-prefix-cache"),)
+ENGINE_FORWARD_SWITCHES = (("no_prefix_cache", "--no-prefix-cache"),
+                           ("decode_window_auto", "--decode-window-auto"))
 
 
 def add_engine_flags(p: argparse.ArgumentParser) -> None:
@@ -67,10 +68,19 @@ def add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--decode-window", type=int, default=1,
                    help="decode steps rolled into ONE jitted dispatch "
                         "at steady state (async engine; 1 = blocked "
-                        "step-per-dispatch loop). The engine falls "
-                        "back to k=1 around admissions, deadlines, "
-                        "cancels and speculative verify/re-probe — "
-                        "see docs/serving.md#async-engine")
+                        "step-per-dispatch loop). Continuous windows: "
+                        "admissions ride mixed prefill+decode "
+                        "dispatches and deadlines/cancels land as "
+                        "on-device lifecycle masks, so only "
+                        "speculative verify/re-probe still breaks a "
+                        "window — see docs/serving.md#async-engine")
+    p.add_argument("--decode-window-auto", action="store_true",
+                   help="auto-tune the window size from the live "
+                        "host-vs-device dispatch split: bounded "
+                        "additive increase over power-of-two buckets "
+                        "up to --decode-window (all bucket programs "
+                        "compiled at engine start, so tuning never "
+                        "recompiles)")
     p.add_argument("--mesh-shape", default="1x1",
                    help="serving mesh DATAxMODEL (e.g. 2x2): run the "
                         "engine GSPMD-sharded over a (data, model) "
@@ -114,6 +124,7 @@ def engine_config_from_args(args: argparse.Namespace):
                         page_size=args.page_size, n_pages=args.n_pages,
                         prefix_cache=not args.no_prefix_cache,
                         decode_window=args.decode_window,
+                        decode_window_auto=args.decode_window_auto,
                         mesh_data=d, mesh_model=m)
 
 

@@ -1126,10 +1126,100 @@ def decode_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
     return toks, emitted, tok, pos, active, budget, cache, rngs
 
 
+def mixed_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
+                       active: jnp.ndarray, budget: jnp.ndarray,
+                       eos: jnp.ndarray, pf_left: jnp.ndarray,
+                       pf_off: jnp.ndarray, pf_limit: jnp.ndarray,
+                       pf_toks: jnp.ndarray, tables: jnp.ndarray,
+                       cache: Dict[str, jnp.ndarray], rngs: jnp.ndarray,
+                       cfg: ModelConfig, *, sample_fn, length: int,
+                       shardings=None):
+    """``decode_window_paged`` with chunked prefill folded INTO the
+    window — the Sarathi-style mixed step the continuous-window engine
+    dispatches when an admission landed at the window boundary: newly
+    admitted slots prefill their prompt's uncached tail chunk-by-chunk
+    while live slots decode, all inside ONE ``length``-step lax.scan,
+    so an admission no longer costs a window break (the blocked-k=1
+    fallback that used to erase the dispatch amortization exactly when
+    traffic peaks).
+
+    Per-slot phase mask: at scan step ``t`` a slot is PREFILLING while
+    ``t < pf_left[b]`` (``pf_left``: chunks this window must write for
+    the slot; 0 = plain decode) and DECODING afterwards. Each step runs
+    one ``verify_step_paged`` forward over a (B, W) token window
+    (W = the prefill chunk width, ``pf_toks.shape[-1]``):
+
+    - a prefilling slot's row is its next chunk ``pf_toks[t, b]``,
+      written through its page table at absolute positions
+      ``pf_off + t*W + j`` (positions >= ``pf_limit`` — the true prompt
+      length — are scatter-DROPPED, exactly ``prefill_chunk_paged``'s
+      padding discipline); its sampled token is discarded and its rng
+      stream does NOT advance, so the first decoded token still uses
+      split 0 of the slot's admission-fresh key (stream parity with the
+      blocked path, where decode starts the admission step);
+    - a decoding slot's row is its current token at window position 0
+      (rows past 0 are dropped padding) at its frontier position — the
+      same write-then-attend row math as ``decode_step_paged`` via the
+      pinned verify<->decode per-row equivalence — and its sample /
+      budget / eos bookkeeping is ``decode_window_paged``'s exactly.
+
+    A slot whose prefill exhausts mid-window (``t == pf_left - 1``
+    consumed its last chunk) flips to decode at the NEXT scan step with
+    no transition math: ``pos``/``tok`` were primed at admission to the
+    decode frontier (P-1, last prompt token) and stay untouched while
+    prefilling. The caller sizes ``pf_left <= length`` per window and
+    carries longer prefills across windows host-side (consumption is
+    deterministic, so no device fetch is needed to know the cursor).
+
+    Returns the same ``(toks, emitted, tok, pos, active, budget, cache,
+    rngs)`` tuple as ``decode_window_paged`` — ``emitted[:, b]`` is now
+    False during b's prefill steps and True from its first decode step
+    until deactivation (a suffix-start run, not a prefix: the engine
+    commits tokens by mask, not by count).
+    """
+    rep = None if shardings is None else shardings.rep
+    steps = jnp.arange(length, dtype=jnp.int32)
+    W = pf_toks.shape[-1]
+
+    def body(carry, xs):
+        tok, pos, active, budget, cache, rngs = carry
+        chunk_toks, t = xs                       # (B, W), scalar step
+        prefilling = active & (t < pf_left)
+        cur = pf_off + t * W
+        n_tok = jnp.where(prefilling, jnp.clip(pf_limit - cur, 1, W), 1)
+        base = jnp.where(prefilling, cur, pos)
+        col0 = jnp.zeros_like(chunk_toks).at[:, 0].set(tok)
+        window = jnp.where(prefilling[:, None], chunk_toks, col0)
+        logits, cache = verify_step_paged(
+            params, window, base, n_tok - 1, active, tables, cache, cfg,
+            shardings=shardings, logits_rows=1)
+        decoding = active & ~prefilling
+        nxt, new_rngs = sample_fn(rngs, logits[:, 0, :])
+        rngs = jnp.where(decoding[:, None], new_rngs, rngs)
+        nxt = jnp.where(decoding, nxt, 0)
+        emitted = decoding
+        budget = jnp.where(decoding, budget - 1, budget)
+        hit_eos = decoding & (eos >= 0) & (nxt == eos)
+        pos = jnp.where(decoding, pos + 1, pos)
+        tok = jnp.where(decoding, nxt, tok)
+        active = active & ~(decoding & ((budget <= 0) | hit_eos))
+        cache = _constrain_cache(cache, shardings)
+        tok, pos, active, budget, rngs, nxt, emitted = (
+            _constrain(a, rep) for a in (tok, pos, active, budget, rngs,
+                                         nxt, emitted))
+        return (tok, pos, active, budget, cache, rngs), (nxt, emitted)
+
+    carry = (tok, pos, active, budget, cache, rngs)
+    (tok, pos, active, budget, cache, rngs), (toks, emitted) = jax.lax.scan(
+        body, carry, (pf_toks, steps), length=length)
+    return toks, emitted, tok, pos, active, budget, cache, rngs
+
+
 def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
                       n_valid: jnp.ndarray, active: jnp.ndarray,
                       tables: jnp.ndarray, cache: Dict[str, jnp.ndarray],
-                      cfg: ModelConfig, *, shardings=None
+                      cfg: ModelConfig, *, shardings=None,
+                      logits_rows: Optional[int] = None
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """``verify_step_multi`` over a paged pool: the speculative window's
     K/V scatters through each slot's page table and the whole drafted
@@ -1143,6 +1233,11 @@ def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
     drops the update (a stale table must never be written through; see
     ``decode_step_paged``). Per-row logits are ``verify_step_multi``'s
     exactly, so speculative greedy parity survives paging unchanged.
+    ``logits_rows`` limits the final layernorm + vocab head to the
+    first that-many window rows (the mixed-window caller samples only
+    row 0 — projecting all W rows to the vocab every scan step would
+    multiply the head cost by the chunk width for nothing); None keeps
+    the full (B, W, V) output the speculative verifier needs.
     """
     cd = _dtype(cfg.dtype)
     B, W = window.shape
@@ -1207,6 +1302,8 @@ def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
             lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
             carry, _ = body(carry, (lp, i))
         x, new_k, new_v = carry
+    if logits_rows is not None:
+        x = x[:, :logits_rows, :]
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                     cfg.layernorm_eps)
     head = (params["wte"].astype(cd).T if cfg.tied_head

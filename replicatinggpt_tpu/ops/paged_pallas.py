@@ -64,6 +64,23 @@ def paged_kernel_mesh_ok(mesh) -> bool:
     return mesh is None or mesh.size == 1
 
 
+def mixed_step_kernel_ok() -> bool:
+    """Kernel routing for the MIXED prefill+decode window step
+    (models.gpt.mixed_window_paged): always False today. Both paged
+    Pallas kernels here and in ops/decode_pallas.py are single-token
+    decode kernels — their grid walks one fresh column per slot, while
+    a mixed scan step writes up to a whole chunk of K/V rows per slot
+    and attends a (W, S) score tile per head. The mixed window
+    therefore routes the XLA gather path unconditionally (the same
+    per-row math, partitioner-friendly), and this seam is where a
+    mixed-phase kernel — per-slot chunk scatter + windowed flash tile,
+    the Sarathi-style fused step — would flip the decision. Kept as a
+    function, not a constant, so the engine's routing reads as a
+    decision point and a future kernel lands without touching the
+    engine."""
+    return False
+
+
 def clamped_live_page(p, pos, page_size: int):
     """The fetch-skip trick, shared by every paged block index map
     (this file's per-layer kernel and the fused all-layers kernel in

@@ -19,28 +19,39 @@ Per step the engine:
    regardless of prompt length or prefix-hit length,
 3. runs ONE jitted decode dispatch over ALL slots — per-slot page
    tables, positions, active mask, RNG streams and sampling params
-   (``sample.generate.sample_tokens_batched``). At steady state (no
-   admission, finish bookkeeping, or speculative re-probe pending) the
-   dispatch is a WINDOW of ``EngineConfig.decode_window`` decode steps
-   rolled into one program (``models.gpt.decode_window_paged``: a
-   lax.scan over the step body with per-slot budget/EOS masks computed
-   ON DEVICE, so a slot finishing mid-window idles inside it instead of
-   forcing an early exit), the step state ``(tok, pos, active, budget,
-   rngs)`` lives on the device and is DONATED from window to window
-   alongside the cache, and the host runs AHEAD of the device: window
-   N+1 is dispatched before window N's token block is fetched
-   (one async ``copy_to_host_async`` + ``np.asarray`` per window, not
-   one blocking snapshot per token — the BENCH_r03 dispatch-tax fix,
-   ROADMAP item 2). Anything that must mutate per-slot state host-side
-   (an admission, an active-deadline expiry, a cancel, a speculative
-   mode flip) first drains the in-flight window and falls back to a
-   blocked k=1 dispatch for that step. With a drafter attached
-   (serve/speculative.py) the decode phase is instead ONE jitted
-   ``_engine_verify``: score a static (k+1)-token drafted window per
-   slot against the pooled cache and commit 1..k+1 accepted tokens —
-   up to k+1 tokens per slot per full-model forward, interleaved with
-   chunked prefill admissions exactly like plain decode (and with
-   multi-token decode windows while speculation is degraded).
+   (``sample.generate.sample_tokens_batched``). With
+   ``EngineConfig.decode_window > 1`` the dispatch is a WINDOW of k
+   decode steps rolled into one program
+   (``models.gpt.decode_window_paged``: a lax.scan over the step body
+   with per-slot budget/EOS masks computed ON DEVICE, so a slot
+   finishing mid-window idles inside it instead of forcing an early
+   exit), the step state ``(tok, pos, active, budget, rngs)`` lives on
+   the device and is DONATED from window to window alongside the
+   cache, and the host runs AHEAD of the device: window N+1 is
+   dispatched before window N's token block is fetched (one async
+   ``copy_to_host_async`` + ``np.asarray`` per window, not one
+   blocking snapshot per token — the BENCH_r03 dispatch-tax fix).
+   The window cadence is CONTINUOUS (ROADMAP item 4): an admission
+   lands at a window boundary as host bookkeeping while window N-1 is
+   still in flight, and the prompt's uncached tail prefills INSIDE
+   window N as a Sarathi-style mixed prefill+decode program
+   (``models.gpt.mixed_window_paged`` — new slots write prompt chunks
+   while resident slots decode, one per-slot phase mask, no separate
+   prefill dispatches); deadline expiry and cancels land as per-
+   dispatch lifecycle masks (``_merge_lifecycle`` — the slot goes
+   inactive on device, its pages free at the boundary, and a
+   cancelled slot emits no tokens after the mask lands); the window
+   size can AUTO-TUNE from the live host-vs-device dispatch split
+   (bounded additive increase over construction-warmed buckets,
+   ``decode_window_auto``). Only a speculative mode flip still drains
+   the window (counted in ``window_breaks_*``). With a drafter
+   attached (serve/speculative.py) the decode phase is instead ONE
+   jitted ``_engine_verify``: score a static (k+1)-token drafted
+   window per slot against the pooled cache and commit 1..k+1
+   accepted tokens — up to k+1 tokens per slot per full-model
+   forward, interleaved with chunked prefill admissions exactly like
+   plain decode (and with continuous windows while speculation is
+   degraded).
 
 Zero recompiles at steady state: the decode/verify programs are keyed
 only on the (static) model config, pool/page shapes, draft width and
@@ -89,8 +100,8 @@ from ..config import ModelConfig
 from ..faults.inject import fire as fault_fire
 from ..faults.watchdog import (LoadShedder, ResilienceConfig, SpecHealth,
                                StepWatchdog)
-from ..models.gpt import (decode_window_paged, prefill_chunk_paged,
-                          verify_step_paged)
+from ..models.gpt import (decode_window_paged, mixed_window_paged,
+                          prefill_chunk_paged, verify_step_paged)
 from ..sample.generate import sample_tokens_batched
 from ..utils.logging import Metrics
 from ..utils.profiling import StepTimer, annotate
@@ -103,6 +114,14 @@ from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_EOS,
 from .scheduler import Scheduler
 from .speculative import (DraftContext, Drafter, spec_accept_and_sample,
                           timed_draft)
+
+#: k-autotune policy (EngineConfig.decode_window_auto): consult the
+#: host-vs-device dispatch split every this-many windows, and climb one
+#: bucket while the host tax still exceeds this fraction of window wall
+#: time. Small interval on purpose — the policy is bounded (one bucket
+#: per decision, capped at decode_window) so eagerness cannot overshoot.
+WINDOW_AUTOTUNE_INTERVAL = 8
+WINDOW_AUTOTUNE_HOST_FRAC = 0.05
 
 
 @dataclass(frozen=True)
@@ -131,11 +150,24 @@ class EngineConfig:
                                 # at steady state (the --decode-window
                                 # knob): 1 = the blocked step-per-
                                 # dispatch loop; >1 enables the async
-                                # double-buffered window path — the
-                                # engine still falls back to k=1 for
-                                # any step with an admission, active-
-                                # deadline expiry, cancel, or
-                                # speculative verify/re-probe pending
+                                # double-buffered window path. The
+                                # continuous-window engine keeps
+                                # windows engaged through admissions
+                                # (mixed prefill+decode dispatch),
+                                # deadlines and cancels (on-device
+                                # lifecycle masks); only speculative
+                                # verify/re-probe still breaks windows
+    decode_window_auto: bool = False
+                                # auto-tune the window size from the
+                                # live dispatch split (host-us vs
+                                # device-us per window): bounded
+                                # additive increase over the bucketed
+                                # sizes window_buckets(), with
+                                # decode_window as the cap. Every
+                                # bucket's programs are compiled at
+                                # engine construction, so tuning moves
+                                # between ALREADY-WARM programs and can
+                                # never recompile mid-traffic
     # --- serving mesh (parallel/mesh.py, the --mesh-shape knob) ---------
     mesh_data: int = 1          # 'data' axis: the paged pool's physical
                                 # page axis shards across it — each chip
@@ -158,16 +190,34 @@ class EngineConfig:
         from .cache_pool import prefill_chunk_size
         return prefill_chunk_size(self.prefill_chunk, block_size)
 
+    def window_buckets(self) -> tuple:
+        """The static window sizes this engine may dispatch, smallest
+        first. Fixed small set by design: every bucket is a separate
+        compiled program (the window width is static), all of them
+        warmed at engine construction, so the k-autotuner's additive
+        increase walks between warm programs and ``decode_window_auto``
+        can never cost a mid-traffic compile. Non-auto engines own
+        exactly one window program (their configured k)."""
+        W = max(int(self.decode_window), 1)
+        if W <= 1:
+            return (1,)
+        if not self.decode_window_auto:
+            return (W,)
+        out, b = [], 2
+        while b < W:
+            out.append(b)
+            b *= 2
+        out.append(W)
+        return tuple(out)
+
     def warmup_tokens(self) -> int:
-        """Tokens a warmup request must generate so that warmup compiles
-        EVERY steady-state decode program: the admission step runs the
-        k=1 fallback, every later step a full window — so a windowed
-        engine needs the request to outlive the admission step by at
-        least one whole window (two, for slack against scheduling
-        details). ONE definition, shared by the replay warmup and the
-        worker's readiness warmup: they must never disagree, or one
-        deployment path compiles the window program mid-traffic and
-        breaks the recompiles_after_warmup == 0 invariant."""
+        """Tokens a warmup request must generate so that the
+        request-driven warmup EXERCISES the steady-state window path on
+        top of the admission boundary's mixed dispatch (the window
+        programs themselves are compiled at engine construction —
+        ``Engine._warm_windows`` — so this is a drive-through, not the
+        compile). ONE definition, shared by the replay warmup and the
+        worker's readiness warmup."""
         return 1 if self.decode_window <= 1 else 2 * self.decode_window + 2
 
 
@@ -199,15 +249,60 @@ class _InFlight:
     t0_us: float                  # launch timestamp (telemetry clock)
     t_wall: float                 # launch timestamp (perf_counter)
     n_active: int                 # live slots at launch
+    host_s: float = 0.0           # host dispatch tax of the launch (the
+                                  # numerator of the autotuner's
+                                  # host-vs-device split)
+    #: (slot, request_id) pairs whose in-window prefill COMPLETES in
+    #: this dispatch — their radix registration (pool.commit_admission)
+    #: happens at this window's drain, once the writes are known landed;
+    #: the id guards against the slot having been recycled since
+    pf_done: List = field(default_factory=list)
+
+
+def _merge_lifecycle(tok, pos, active, budget, life, shardings):
+    """Fold the boundary's host-side lifecycle deltas into the donated
+    device step state AT THE TOP of a window dispatch — the mechanism
+    that keeps admissions, deadlines and cancels from ever invalidating
+    the device-resident state (which would force a blocking drain and a
+    re-upload, the old k=1 fallback).
+
+    ``life`` is ONE packed (5, n_slots) int32 array — a deliberate
+    single device_put per boundary (per-array transfer setup, not
+    bytes, dominates small-array upload cost on the hot path):
+
+    - row 0, kill flags: slots whose request was cancelled or passed
+      its deadline since the last dispatch go inactive ON DEVICE —
+      their writes drop and their emissions mask off from scan step 0,
+      so a cancelled slot emits no tokens after the mask lands;
+    - row 1, admission flags + rows 2-4 (token, position, budget):
+      slots admitted at this boundary take their host-mirror state
+      (last prompt token, decode frontier P-1, full budget) and go
+      active.
+
+    A traced input, so lifecycle traffic never retraces; a quiet
+    boundary passes a cached all-zero array (no device_put at all, and
+    the merge folds into the window program — no extra dispatch,
+    ever)."""
+    kill = life[0].astype(bool)
+    adm = life[1].astype(bool)
+    tok = jnp.where(adm, life[2], tok)
+    pos = jnp.where(adm, life[3], pos)
+    budget = jnp.where(adm, life[4], budget)
+    active = (active | adm) & ~kill
+    if shardings is not None:
+        tok, pos, active, budget = (
+            jax.lax.with_sharding_constraint(a, shardings.rep)
+            for a in (tok, pos, active, budget))
+    return tok, pos, active, budget
 
 
 @partial(jax.jit, static_argnames=("cfg", "k", "use_pallas", "use_fused",
                                    "shardings"),
          donate_argnames=("tok", "pos", "active", "budget", "cache",
                           "rngs"))
-def _engine_decode_window(params, tok, pos, active, budget, eos, tables,
-                          cache, rngs, temp, top_k, top_p, greedy,
-                          cfg: ModelConfig, k: int,
+def _engine_decode_window(params, tok, pos, active, budget, eos, life,
+                          tables, cache, rngs, temp, top_k, top_p,
+                          greedy, cfg: ModelConfig, k: int,
                           use_pallas: bool = False,
                           use_fused: bool = False, shardings=None):
     """The steady-state program: ``k`` multi-slot PAGED decode + batched
@@ -237,6 +332,9 @@ def _engine_decode_window(params, tok, pos, active, budget, eos, tables,
     block leave fully replicated — the caller's ``np.asarray`` fetch is
     a local read, never a cross-device gather.
     """
+    tok, pos, active, budget = _merge_lifecycle(
+        tok, pos, active, budget, life, shardings)
+
     def sample_fn(rngs, logits):
         splits = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
         nxt = sample_tokens_batched(splits[:, 0], logits, temp, top_k,
@@ -248,6 +346,44 @@ def _engine_decode_window(params, tok, pos, active, budget, eos, tables,
                                sample_fn=sample_fn, length=k,
                                use_pallas=use_pallas, use_fused=use_fused,
                                shardings=shardings)
+
+
+@partial(jax.jit, static_argnames=("cfg", "k", "shardings"),
+         donate_argnames=("tok", "pos", "active", "budget", "cache",
+                          "rngs"))
+def _engine_mixed_window(params, tok, pos, active, budget, eos, life,
+                         pfc, pf_toks, tables, cache, rngs,
+                         temp, top_k, top_p, greedy, cfg: ModelConfig,
+                         k: int, shardings=None):
+    """The mixed steady-state program: ``models.gpt.mixed_window_paged``
+    behind the same lifecycle merge, donation set and sampling closure
+    as ``_engine_decode_window`` — dispatched instead of the pure decode
+    window whenever an admission left prompt chunks to write, so newly
+    admitted slots prefill while resident slots decode and the window
+    cadence never breaks. One compiled program per window bucket (the
+    prefill chunk width and pool shapes are static); the per-slot phase
+    mask, chunk cursors and chunk payloads are all traced inputs, so
+    WHICH slots prefill and how much never retraces. Routes the XLA
+    gather path regardless of the paged-kernel knob — the fused/
+    per-layer Pallas kernels are single-token decode kernels
+    (ops/paged_pallas.mixed_step_kernel_ok is the seam a mixed-phase
+    kernel would flip). ``pfc`` packs the three (n_slots,) prefill
+    cursors — chunks-this-window / next write position / true prompt
+    length — into one (3, n_slots) upload, like ``life``."""
+    tok, pos, active, budget = _merge_lifecycle(
+        tok, pos, active, budget, life, shardings)
+
+    def sample_fn(rngs, logits):
+        splits = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
+        nxt = sample_tokens_batched(splits[:, 0], logits, temp, top_k,
+                                    top_p, greedy)
+        return nxt, splits[:, 1]
+
+    return mixed_window_paged(params, tok, pos, active, budget, eos,
+                              pfc[0], pfc[1], pfc[2], pf_toks,
+                              tables, cache, rngs, cfg,
+                              sample_fn=sample_fn, length=k,
+                              shardings=shardings)
 
 
 @partial(jax.jit, static_argnames=("cfg", "shardings"),
@@ -341,6 +477,7 @@ def compile_counts() -> Dict[str, int]:
     from the offending step instead of reporting after the fact."""
     from .speculative import _draft_decode_k, _draft_prefill
     return {"decode": _engine_decode_window._cache_size(),
+            "mixed": _engine_mixed_window._cache_size(),
             "prefill": _engine_prefill._cache_size(),
             "verify": _engine_verify._cache_size(),
             "page_copy": _engine_page_copy._cache_size(),
@@ -446,6 +583,17 @@ class Engine:
         P = ecfg.pool_size
         self._chunk = ecfg.chunk(cfg.block_size)
         self._window = max(int(ecfg.decode_window), 1)
+        # bucketed window sizes + the autotune cursor: _window_cur is
+        # the size the next steady-state dispatch uses; the additive-
+        # increase policy (_maybe_autotune) only ever moves it UP the
+        # bucket list, and every bucket's programs compile at
+        # construction (_warm_windows), so a bucket move is free
+        self._buckets = ecfg.window_buckets()
+        self._wk = 0
+        self._window_cur = self._buckets[0]
+        self._at_host = 0.0           # autotune accumulators: host
+        self._at_wall = 0.0           # dispatch tax vs window wall time
+        self._at_n = 0                # over windows since last decision
         # Pallas paged-decode route: static per engine (one compiled
         # program either way); packed layout + TPU backend + envelope.
         # The FUSED all-layers kernel (one launch per decode step,
@@ -467,6 +615,12 @@ class Engine:
             and paged_pallas.paged_decode_supported(
                 cfg.n_head, cfg.head_dim, self.pool.page_size, itemsize,
                 mesh=self.mesh))
+        # mixed prefill+decode windows route the XLA gather path no
+        # matter what the paged-kernel knob says: the Pallas kernels
+        # above are single-token decode kernels
+        # (ops/paged_pallas.mixed_step_kernel_ok documents the seam a
+        # mixed-phase Sarathi-style fused kernel would flip — wiring it
+        # means adding use_pallas-style routing to _engine_mixed_window)
         self._tok = np.zeros((P,), np.int32)
         # ALIAS of pool.positions (one host buffer): the pool exposes the
         # committed frontier to drafters, the engine advances it in place
@@ -474,10 +628,36 @@ class Engine:
         self._active = np.zeros((P,), bool)
         self._budget = np.zeros((P,), np.int32)   # tokens still allowed
         self._eos = np.full((P,), -1, np.int32)   # per-slot stop token
+        # lifecycle masks (continuous windows): per-slot deadline
+        # precomputed at admission (vectorized expiry check, no dict
+        # walk), the pending-kill map feeding the per-dispatch kill
+        # flags, and the admission-merge mask — all consumed by
+        # _merge_lifecycle at the top of the next window dispatch
+        self._deadline = np.full((P,), np.inf)
+        self._kill: Dict[str, str] = {}           # request_id -> reason
+        self._adm_mask = np.zeros((P,), bool)
+        # in-window prefill cursors (mixed steps): chunks left to write,
+        # next absolute write position, true prompt length, and the
+        # pending padded prompt tails — consumption is deterministic
+        # (min(k, pf_left) chunks per window), so the host tracks the
+        # cursor without ever fetching device state
+        self._pf_left = np.zeros((P,), np.int32)
+        self._pf_off = np.zeros((P,), np.int32)
+        self._pf_limit = np.zeros((P,), np.int32)
+        self._pf_tail: Dict[int, np.ndarray] = {}
         self._temp = np.ones((P,), np.float32)
         self._top_k = np.zeros((P,), np.int32)
         self._top_p = np.zeros((P,), np.float32)
         self._greedy = np.zeros((P,), bool)
+        # launch-invariant device inputs (eos / page tables / sampling
+        # params), converted ONCE per change instead of once per
+        # dispatch — a window dispatch's host tax is mostly device_put
+        # calls, so re-uploading arrays that only change at admission/
+        # finish boundaries would tax exactly the steady state the
+        # window amortizes (None = rebuild at the next launch); plus
+        # shared all-zero lifecycle masks for quiet boundaries
+        self._li = None
+        self._z_life = jnp.zeros((5, P), jnp.int32)
         # async window machinery: the device-resident donated step state
         # (tok, pos, active, budget) between window dispatches — None
         # means "host mirrors are authoritative, re-upload at the next
@@ -508,12 +688,16 @@ class Engine:
         # the step that caused it. Replaces the ad-hoc two-program
         # bookkeeping the first serving PR shipped (compile_counts()
         # remains for offline summaries).
-        # a windowed engine legitimately owns TWO decode programs: the
-        # k=decode_window steady-state window and the k=1 fallback it
-        # drops to around admissions/finishes/spec transitions
+        # a windowed engine owns one decode-window program and one mixed
+        # prefill+decode program PER BUCKET (the admission path is a
+        # mixed window, never a k=1 fallback — the blocked program only
+        # exists on decode_window=1 engines)
         self._decode_guard = CompileGuard(
             _engine_decode_window, "serve/decode",
-            max_programs=2 if self._window > 1 else 1)
+            max_programs=len(self._buckets))
+        self._mixed_guard = CompileGuard(
+            _engine_mixed_window, "serve/mixed",
+            max_programs=len(self._buckets))
         self._prefill_guard = CompileGuard(_engine_prefill, "serve/prefill")
         self._verify_guard = CompileGuard(_engine_verify, "serve/verify")
         self._copy_guard = CompileGuard(_engine_page_copy, "serve/page-copy")
@@ -523,6 +707,11 @@ class Engine:
         self.pool.cache = self._copy_guard(self.pool.cache, jnp.int32(0),
                                            jnp.int32(0),
                                            shardings=self._plan)
+        if self._window > 1:
+            # compile every bucketed window program up front (masked
+            # no-op dispatches) — admissions, lifecycle masks and
+            # autotune bucket moves then always hit a warm program
+            self._warm_windows()
         self._sanitize = sanitize_enabled()
         # self-healing (faults.watchdog): all policies opt-in via rcfg.
         # Degraded transitions move between the two already-budgeted
@@ -578,16 +767,25 @@ class Engine:
     def cancel(self, request_id: str, migrated: bool = False) -> bool:
         """Cancel a queued or running request. The terminal
         ``RequestResult`` (with any tokens already produced) surfaces
-        from the next ``step()``; True iff the request was found. An
-        active request's slot and its reserved KV pages are released
-        IMMEDIATELY (not at the next step) — a cancelled mid-stream
-        request must not hold capacity while its terminal result waits
-        to surface. ``migrated=True`` is the fleet router's re-route
-        path: the request is not ending, it is moving to another
-        replica — the telemetry envelope closes tagged ``migrated`` (a
-        non-terminal segment, see tools/trace_check.py) and the journal
-        still records a finish so THIS replica's journal replay never
-        resurrects it."""
+        from the next ``step()``; True iff the request was found.
+
+        On a windowed engine a plain cancel is a LIFECYCLE MASK, not a
+        window break: the request id joins the pending-kill map, the
+        kill flag rides the next window dispatch (deactivating the slot
+        on device from its first scan step — the slot emits nothing
+        after the mask lands), and the slot + pages release at that
+        boundary, right after the in-flight window's already-committed
+        tokens are fetched to ride the terminal result. A cancel racing
+        a window that already finished the request surfaces the natural
+        finish. On blocked (k=1) engines, and for ``migrated=True`` —
+        the fleet router's re-route path, where the id must be
+        releasable BEFORE the router resubmits it elsewhere — the old
+        drain-now semantics hold: fetch the in-flight window, finish
+        and free immediately (counted as a ``cancel`` window break).
+        ``migrated=True`` closes the telemetry envelope tagged
+        ``migrated`` (a non-terminal segment, see tools/trace_check.py)
+        and still journals a finish so THIS replica's journal replay
+        never resurrects the id."""
         now = self.clock()
         if self.scheduler.cancel(request_id):
             self.metrics.inc("finished_" + FINISH_CANCELLED)
@@ -598,11 +796,10 @@ class Engine:
         slot = self.pool.slot_of(request_id)
         if slot is None:
             return False
-        # cancel-during-window: fetch the in-flight dispatch first so
-        # the tokens it already committed ride the terminal result, and
-        # the slot + pages release at the window boundary — never while
-        # a dispatch that writes through the slot's table is in flight
-        self._pending.extend(self._drain_pending())
+        if self._window > 1 and not migrated:
+            self._kill[request_id] = FINISH_CANCELLED
+            return True
+        self._pending.extend(self._drain_pending("cancel"))
         slot = self.pool.slot_of(request_id)
         if slot is None:
             # the drained window finished it naturally; its terminal
@@ -648,14 +845,21 @@ class Engine:
         shedding) folded around the decode phase when configured.
 
         With ``decode_window > 1`` the steady-state decode phase is the
-        double-buffered window path: dispatch the NEXT k-step window,
-        then fetch the previous one's token block — the host stays one
-        window ahead of the device. Any step that must mutate per-slot
-        state host-side (admission possible, an active deadline
-        expired, a speculative verify or re-probe due) first drains the
-        in-flight window and runs the blocked k=1 (or verify) dispatch
-        instead; queued-deadline expiry and overload shedding are
-        host-only and never break a window."""
+        CONTINUOUS window path: dispatch the NEXT k-step window, then
+        fetch the previous one's token block — the host stays one
+        window ahead of the device, and host-side request dynamism
+        rides the dispatch instead of breaking it. Admissions land at
+        window boundaries: page tables, COW copies and slot mirrors are
+        written host-side while window N-1 is still in flight, and the
+        prompt's uncached tail prefills INSIDE window N as a mixed
+        prefill+decode program (``_engine_mixed_window``). Deadline
+        expiry and cancels land as per-dispatch lifecycle masks
+        (``_merge_lifecycle``): the slot goes inactive on device, its
+        in-flight tokens ride the terminal result, and its pages free
+        at the boundary. Only a speculative verify / re-probe still
+        drains the window and leaves the path (counted in the
+        ``window_breaks_*`` counters); queued-deadline expiry and
+        overload shedding are host-only and never touch it."""
         finished: List[RequestResult] = self._pending
         self._pending = []
         now = self.clock()
@@ -677,9 +881,12 @@ class Engine:
                                    f"queued request(s) under sustained "
                                    f"overload")
 
-        expired = [slot for slot in list(self._slots)
-                   if self._slots[slot].req.deadline is not None
-                   and now >= self._slots[slot].req.deadline]
+        # active-deadline expiry against the per-slot deadline mirror
+        # precomputed at admission (one vectorized compare, no dict
+        # walk). On the windowed path these become lifecycle-mask kills.
+        expired = [int(s) for s in
+                   np.flatnonzero(self._active & (self._deadline <= now))
+                   if int(s) in self._slots]
 
         # speculative re-probe countdown while degraded (auto-disabled
         # only: an operator pin via set_spec_active(False) must stick)
@@ -692,19 +899,38 @@ class Engine:
 
         use_spec = (self.drafter is not None
                     and (self._spec_active or reprobe))
-        # steady state = nothing needs the host to touch per-slot state
-        # before the next dispatch. A deep backlog whose head cannot
-        # admit (pool full / not enough pages) does NOT break windows:
-        # arrivals batch up and admit at the next window boundary.
-        windowed = (self._window > 1 and not use_spec and not expired
-                    and not self._head_admissible()
-                    and bool(self._active.any()))
+        # the continuous-window steady state: everything except a
+        # speculative mode flip stays on the window path — admissions
+        # become mixed dispatches, deadlines/cancels become masks
+        windowed = (self._window > 1 and not use_spec
+                    and (bool(self._active.any()) or bool(self._kill)
+                         or bool(expired) or self._head_admissible()))
 
-        if not windowed:
-            # a host mutation is coming: fetch the in-flight window
-            # first — its tokens commit now, finished slots' pages and
-            # slots free at this window boundary
-            finished.extend(self._drain_pending())
+        if windowed:
+            for slot in expired:
+                self._kill.setdefault(self._slots[slot].req.id,
+                                      FINISH_DEADLINE)
+        else:
+            # a speculative transition (or a blocked k=1 engine): fetch
+            # the in-flight window first — its tokens commit now,
+            # finished slots' pages and slots free at this boundary
+            finished.extend(self._drain_pending(
+                "reprobe" if reprobe else "spec" if use_spec else
+                "deadline" if expired else "cancel" if self._kill else
+                "admit"))
+            # any slot still mid-prefill (its chunks were riding the
+            # mixed windows this branch just abandoned) completes
+            # host-side NOW: the verify/decode paths assume every
+            # admitted slot's prompt pages are fully written
+            self._flush_prefill()
+            # kills deferred while windows were engaged resolve here the
+            # old way (host-initiated finish; the device state rebuilds
+            # from mirrors at the next upload)
+            for rid, reason in list(self._kill.items()):
+                slot = self.pool.slot_of(rid)
+                if slot is not None and slot in self._slots:
+                    finished.append(self._finish_slot(slot, reason, now))
+            self._kill.clear()
             for slot in expired:
                 if slot in self._slots:   # may have finished in the drain
                     finished.append(self._finish_slot(
@@ -715,21 +941,7 @@ class Engine:
                 self.metrics.inc("spec_reprobes")
                 self._event(f"step {self.n_steps}: re-probing "
                                    f"speculative decoding")
-            # one-at-a-time admission: each _admit changes page
-            # availability, so the fits check must see fresh allocator
-            # state per request (FIFO preserved — a head that does not
-            # fit blocks the queue rather than being skipped, so big
-            # requests cannot starve)
-            while self.pool.n_free > 0:
-                admitted, dropped = self.scheduler.admit(1, now,
-                                                         fits=self._fits)
-                for req, t_submit, reason in dropped:
-                    finished.append(self._finish_unstarted(req, t_submit,
-                                                           reason, now))
-                if not admitted:
-                    break
-                req, t_submit = admitted[0]
-                self._admit(req, t_submit, now)
+            self._admit_queue(now, finished, self._admit)
 
         self.metrics.gauge("queue_depth", self.scheduler.depth)
         self.metrics.gauge("slots_active", int(self._active.sum()))
@@ -742,44 +954,28 @@ class Engine:
         if flt is not None and flt.kind == "delay":
             time.sleep(flt.arg)
 
-        if self._active.any():
-            if windowed:
-                with annotate("serve/decode"):
-                    # every live slot's remaining budget fits one more
-                    # window => that window is the LAST (barring eos,
-                    # which only ends sooner): no point dispatching
-                    # blind past it
-                    last = int(self._budget[self._active].max()
-                               ) <= self._window
-                    if self._inflight is not None and last:
-                        # the in-flight window already finishes
-                        # everything — just fetch it
-                        finished.extend(self._drain_pending())
-                    elif last:
-                        finished.extend(self._drain_window(
-                            self._launch(self._window)))
-                    else:
-                        # double buffering: launch window N+1 BEFORE
-                        # fetching window N's token block
-                        nxt = self._launch(self._window)
-                        finished.extend(self._drain_pending())
-                        self._inflight = nxt
-            else:
-                spec_now = self.drafter is not None and self._spec_active
-                finished.extend(self._verify_once() if spec_now
-                                else self._decode_once())
-            if self._watchdog is not None:
-                dur = time.perf_counter() - t_wall
-                if self._watchdog.observe(dur):
-                    self.metrics.inc("watchdog_stalls")
-                    self.metrics.gauge("last_stall_s", dur)
-                    self._event(f"step {self.n_steps}: stall — "
-                                       f"{dur * 1e3:.1f} ms step against "
-                                       f"a p99-derived budget")
+        ran_decode = False
+        if windowed:
+            with annotate("serve/decode"):
+                self._window_step(now, finished)
+            ran_decode = True
+        elif self._active.any():
+            spec_now = self.drafter is not None and self._spec_active
+            finished.extend(self._verify_once() if spec_now
+                            else self._decode_once())
+            ran_decode = True
         elif self._inflight is not None:
             # endgame: every slot finished while a window was in flight
             # — fetch it (it emits nothing) so drain() reaches idle
             finished.extend(self._drain_pending())
+        if ran_decode and self._watchdog is not None:
+            dur = time.perf_counter() - t_wall
+            if self._watchdog.observe(dur):
+                self.metrics.inc("watchdog_stalls")
+                self.metrics.gauge("last_stall_s", dur)
+                self._event(f"step {self.n_steps}: stall — "
+                                   f"{dur * 1e3:.1f} ms step against "
+                                   f"a p99-derived budget")
         if self.tel.enabled:
             self.tel.complete("engine_step", self._tb + ENGINE_TRACK,
                               t_step_us,
@@ -789,6 +985,76 @@ class Engine:
                               n_active=int(self._active.sum()),
                               n_finished=len(finished))
         return finished
+
+    def _window_step(self, now: float, finished: List[RequestResult]
+                     ) -> None:
+        """One continuous-window boundary: resolve pending kills into
+        this dispatch's flag array, admit the queue head(s) host-side
+        (their prefill chunks ride the dispatch), launch window N,
+        fetch window N-1, then finish masked-out slots — whose pages
+        are safe to release while window N flies, because the kill flag
+        already deactivated them on device (writes dropped, reads
+        masked) before the launch."""
+        k = self._window_cur
+        P = self.ecfg.pool_size
+        kill_arr = np.zeros((P,), bool)
+        kills: List = []
+        for rid, reason in self._kill.items():
+            slot = self.pool.slot_of(rid)
+            if slot is not None and slot in self._slots:
+                kill_arr[slot] = True
+                kills.append((slot, reason))
+        # admissions at the boundary: host bookkeeping only (window N-1
+        # is still in flight); slots freed by this boundary's kills
+        # become available at the NEXT one
+        self._admit_queue(now, finished, self._admit_windowed)
+        adm_any = bool(self._adm_mask.any())
+        live = self._active & ~kill_arr
+        live_any = bool(live.any())
+        if kills or adm_any:
+            if live_any:
+                # the masks/merge must land on device: dispatch window N
+                # (kill flags + admission merge ride it), then fetch
+                # N-1 so a killed slot's already-committed tokens ride
+                # its terminal result
+                nxt = self._launch(k, kill=kill_arr)
+                finished.extend(self._drain_pending())
+                for slot, reason in kills:
+                    if slot in self._slots:   # may have finished in N-1
+                        finished.append(self._finish_slot(
+                            slot, reason, now, masked=True))
+                self._inflight = nxt
+            else:
+                # the kills empty the engine: nothing left to dispatch,
+                # so no mask ever lands — finish host-side (invalidates
+                # the device state; the next upload rebuilds it)
+                finished.extend(self._drain_pending())
+                for slot, reason in kills:
+                    if slot in self._slots:
+                        finished.append(self._finish_slot(slot, reason,
+                                                          now))
+            self._kill.clear()
+        elif live_any:
+            # remaining work per slot in window steps: pending prefill
+            # chunks + the decode budget. When it all fits one more
+            # window, that window is the LAST (barring eos, which only
+            # ends sooner): no point dispatching blind past it.
+            rem = np.where(live, self._pf_left + self._budget, 0)
+            last = int(rem.max()) <= k
+            if self._inflight is not None and last:
+                # the in-flight window already finishes everything
+                finished.extend(self._drain_pending())
+            elif last:
+                finished.extend(self._drain_window(self._launch(k)))
+            else:
+                # double buffering: launch window N BEFORE fetching
+                # window N-1's token block
+                nxt = self._launch(k)
+                finished.extend(self._drain_pending())
+                self._inflight = nxt
+        else:
+            finished.extend(self._drain_pending())
+            self._kill.clear()   # stale ids whose requests already ended
 
     def set_spec_active(self, active: bool) -> None:
         """Flip speculative decoding between its verify program and the
@@ -803,8 +1069,11 @@ class Engine:
         active = active and self.drafter is not None
         if active and not self._spec_active:
             # an in-flight decode window holds tokens the drafters'
-            # resync must see — fetch it before reading histories
-            self._pending.extend(self._drain_pending())
+            # resync must see — fetch it before reading histories; a
+            # slot still mid-prefill completes host-side (the verify
+            # path attends its whole prompt range)
+            self._pending.extend(self._drain_pending("spec"))
+            self._flush_prefill()
             hists = self._histories()
             for slot in self._slots:
                 if self._active[slot] and hists[slot] is not None:
@@ -841,6 +1110,7 @@ class Engine:
         s["n_steps"] = self.n_steps
         s["compile_counts"] = compile_counts()
         s["compile_guards"] = {"decode": self._decode_guard.stats(),
+                               "mixed": self._mixed_guard.stats(),
                                "prefill": self._prefill_guard.stats(),
                                "verify": self._verify_guard.stats(),
                                "page_copy": self._copy_guard.stats()}
@@ -856,13 +1126,24 @@ class Engine:
         dec_tokens = int(c.get("dispatch_tokens", 0))
         mean_ms = disp.get("mean", 0.0) * 1e3
         s["dispatch"] = {
-            "window_k": self._window,
+            "window_k": self._window_cur,
+            "window_k_max": self._window,
+            "autotune": bool(self.ecfg.decode_window_auto),
+            "autotune_increases": int(
+                c.get("autotune_window_increases", 0)),
             "dispatches": n_disp,
             "mean_dispatch_ms": round(mean_ms, 4),
             "host_dispatch_ms_per_token": (
                 round(mean_ms * n_disp / dec_tokens, 4)
                 if dec_tokens else 0.0),
         }
+        # window-break observability (continuous windows): which host
+        # mutations still force the engine off the window path. Post
+        # continuous-windows only the speculative reasons should move
+        # on a healthy engine — admit/deadline/cancel ride the window.
+        s["window_breaks"] = {
+            r: int(c.get("window_breaks_" + r, 0))
+            for r in ("admit", "deadline", "cancel", "spec", "reprobe")}
         c = self.metrics.counters
         s["recovery"] = {
             "watchdog_stalls": int(c.get("watchdog_stalls", 0)),
@@ -977,6 +1258,87 @@ class Engine:
         # registration AFTER the prefill wrote the pages: a same-step
         # neighbor may claim them the moment they hit the radix
         self.pool.commit_admission(slot)
+        # host mirrors changed: the next window launch re-uploads them
+        # (blocked-path admission only runs with no dispatch in flight)
+        self._dev_state = None
+        self._admit_finalize(req, t_submit, now, slot, cap, claimed,
+                             t_admit_us)
+
+    def _admit_windowed(self, req: Request, t_submit: float, now: float
+                        ) -> None:
+        """Admission at a CONTINUOUS window boundary: identical host
+        bookkeeping to ``_admit`` — page acquisition, COW copies, slot
+        mirrors — but the prompt's uncached tail is NOT dispatched as
+        separate prefill programs: its chunks are queued on the
+        in-window prefill cursors and ride the next MIXED window
+        dispatch, and the slot's state enters the donated device loop
+        through the admission-merge mask instead of invalidating it
+        (``_merge_lifecycle``). Window N-1 stays in flight throughout:
+        the COW copy and the coming prefill writes consume its output
+        cache, so device dispatch order sequences them after it. Radix
+        registration is DEFERRED until the window that finishes the
+        prefill drains (``_InFlight.pf_done``) — registering pages a
+        still-flying window is writing would let a same-boundary
+        neighbor attend garbage."""
+        P = int(req.prompt.size)
+        cap = self._cap(req)
+        t_admit_us = self.tel.now_us() if self.tel.enabled else 0.0
+        adm = self.pool.acquire(req.id, req.prompt, cap,
+                                defer_commit=True)
+        assert adm is not None, "scheduler admitted past pool capacity"
+        slot = adm.slot
+        tid = self._tb + SLOT_TRACK_BASE + slot
+        if self.tel.enabled:
+            ts_sub = self.tel.ts_us(t_submit)
+            self.tel.begin("request", tid, ts_us=ts_sub, request=req.id,
+                           prompt_tokens=P, max_new_tokens=cap)
+            self.tel.complete("queue", tid, ts_sub,
+                              self.tel.ts_us(now) - ts_sub,
+                              request=req.id)
+        for src, dst in adm.cow:
+            check_in_bounds(dst, 1, self.pool.n_pages, what="COW page")
+            self.tel.instant("cow_split", tid, src=src, dst=dst,
+                             request=req.id)
+            self.pool.cache = self._copy_guard(self.pool.cache,
+                                               jnp.int32(src),
+                                               jnp.int32(dst),
+                                               shardings=self._plan)
+        claimed = adm.claimed
+        S = self.pool.seq_len
+        if claimed < P:
+            chunk = self._chunk
+            n_chunks = -(-(P - claimed) // chunk)
+            # host-side bound for the traced in-window prefill writes:
+            # every REAL position sits inside the logical buffer;
+            # padded tail positions scatter-drop past pf_limit
+            check_in_bounds(claimed, P - claimed, S,
+                            what=f"windowed prefill of {P}-token prompt "
+                                 f"from {claimed} in {chunk}-chunks")
+            padded = np.zeros((n_chunks * chunk,), np.int32)
+            padded[:P - claimed] = req.prompt[claimed:]
+            self._pf_tail[slot] = padded
+            self._pf_left[slot] = n_chunks
+            self._pf_off[slot] = claimed
+            self._pf_limit[slot] = P
+        else:
+            # fully-cached prompt (COW split aside): nothing to write —
+            # the slot decodes from its first window step, and the
+            # claim registers immediately (its pages were written and
+            # registered by previous owners)
+            self.pool.commit_admission(slot)
+        self._adm_mask[slot] = True
+        self._admit_finalize(req, t_submit, now, slot, cap, claimed,
+                             t_admit_us)
+
+    def _admit_finalize(self, req: Request, t_submit: float, now: float,
+                        slot: int, cap: int, claimed: int,
+                        t_admit_us: float) -> None:
+        """Mirror/record/telemetry bookkeeping shared by the blocked
+        and windowed admission paths — ONE definition so the two can
+        never drift on a per-slot field (the deadline mirror and the
+        rng reset are both parity-load-bearing)."""
+        P = int(req.prompt.size)
+        tid = self._tb + SLOT_TRACK_BASE + slot
         if self.drafter is not None:
             # drafters keep their own (unpaged) cache and see the full
             # prompt — prefix reuse is a target-pool concern
@@ -986,15 +1348,18 @@ class Engine:
         self._budget[slot] = cap
         self._eos[slot] = (-1 if req.eos_token_id is None
                            else int(req.eos_token_id))
-        # host mirrors changed: the next window launch re-uploads them
-        # (admission only runs with no dispatch in flight)
-        self._dev_state = None
+        # deadline precomputed at admission into the vectorized expiry
+        # mirror (inf = none): the step loop's check is one compare
+        # (req.deadline is a host float already — no conversion)
+        self._deadline[slot] = (np.inf if req.deadline is None
+                                else req.deadline)
         sp = req.sampling
         self._temp[slot] = sp.temperature
         self._top_k[slot] = sp.top_k
         self._top_p[slot] = sp.top_p
         self._greedy[slot] = sp.greedy
         self._rngs = self._rngs.at[slot].set(jax.random.PRNGKey(req.rng_seed))
+        self._li = None           # eos/tables/sampling mirrors changed
         self._slots[slot] = _Active(req=req, t_submit=t_submit, t_admit=now,
                                     cap=cap,
                                     capped=cap < req.max_new_tokens)
@@ -1008,6 +1373,60 @@ class Engine:
         self.metrics.inc("prefix_hit_tokens", claimed)
         self.metrics.observe("queue_wait_s", now - t_submit)
 
+    def _admit_queue(self, now: float, finished: List[RequestResult],
+                     admit_fn) -> None:
+        """One-at-a-time admission off the queue head — ONE definition
+        of the FIFO protocol for the blocked (``_admit``) and windowed
+        (``_admit_windowed``) paths: each admission changes page
+        availability, so the fits check must see fresh allocator state
+        per request, and a head that does not fit BLOCKS the queue
+        rather than being skipped (big requests cannot starve)."""
+        while self.pool.n_free > 0:
+            admitted, dropped = self.scheduler.admit(1, now,
+                                                     fits=self._fits)
+            for req, t_submit, reason in dropped:
+                finished.append(self._finish_unstarted(req, t_submit,
+                                                       reason, now))
+            if not admitted:
+                break
+            req, t_submit = admitted[0]
+            admit_fn(req, t_submit, now)
+
+    def _flush_prefill(self) -> None:
+        """Complete any still-pending in-window prefill through the
+        blocked prefill program — called whenever the engine LEAVES the
+        windowed path with chunks outstanding (a speculative
+        verify/re-probe transition, which only exists on drafter
+        engines, whose warmup compiles ``_engine_prefill``): the
+        verify/decode paths attend each admitted slot's full prompt
+        range, so abandoning unwritten chunks would read never-written
+        pages. The deferred radix registration commits here too — the
+        writes are enqueued ahead of any later dispatch."""
+        for slot in np.flatnonzero(self._pf_left > 0):
+            slot = int(slot)
+            chunk = self._chunk
+            tail = self._pf_tail.pop(slot)
+            n = int(self._pf_left[slot])
+            off = int(self._pf_off[slot])
+            limit = int(self._pf_limit[slot])
+            table_row = jnp.asarray(self.pool.tables[slot])
+            cache = self.pool.cache
+            with annotate("serve/prefill"):
+                for c in range(n):
+                    cache = self._prefill_guard(
+                        self.params,
+                        jnp.asarray(tail[None,
+                                         c * chunk:(c + 1) * chunk]),
+                        jnp.int32(off + c * chunk), jnp.int32(limit),
+                        table_row, cache, self.cfg,
+                        shardings=self._plan)
+            self.pool.cache = cache
+            self._pf_left[slot] = 0
+            self._pf_off[slot] = 0
+            self._pf_limit[slot] = 0
+            if slot in self._slots:
+                self.pool.commit_admission(slot)
+
     def _head_admissible(self) -> bool:
         """Whether this step could admit: a free slot AND a queued,
         unexpired head that fits the page gate. While False, a backlog
@@ -1019,18 +1438,83 @@ class Engine:
         head = self.scheduler.peek()
         return head is not None and self._fits(head[0])
 
-    def _launch(self, k: int) -> _InFlight:
-        """Dispatch one ``k``-step decode window WITHOUT fetching its
-        results. The donated device step state from the previous
-        dispatch feeds straight back in when the host hasn't touched
-        per-slot state since (``_dev_state``); otherwise the host
-        mirrors are uploaded once. The token block's device->host copy
-        starts immediately (``copy_to_host_async``), so by the time
-        ``_drain_window`` reads it the transfer has been overlapping
-        device compute."""
+    def _warm_windows(self) -> None:
+        """Compile every bucketed window program — the pure decode
+        window AND the mixed prefill+decode window at each
+        ``window_buckets()`` size — with masked no-op dispatches at
+        construction: all slots inactive, all masks False, so writes
+        drop, emissions mask off and the step-state values pass through
+        unchanged (the donated cache/rng buffers are threaded through
+        and reassigned). After this, admissions, lifecycle masks and
+        k-autotune bucket moves always land on a warm program; the
+        request-driven replay/worker warmups merely EXERCISE the paths.
+        Per-slot rng streams are reset at admission, so the decode
+        windows' unconditional in-scan splits here cannot perturb any
+        request's sampled stream."""
+        P = self.ecfg.pool_size
+        from .cache_pool import commit_default
+        zi = np.zeros((P,), np.int32)
+        zb = np.zeros((P,), bool)
+        state = tuple(commit_default(jnp.asarray(a), sharding=self._rep)
+                      for a in (zi, zi, zb, zi))
+        cache, rngs = self.pool.cache, self._rngs
+        eos_d, tables_d, *sample = self._launch_inputs()
+        for k in self._buckets:
+            out = self._decode_guard(
+                self.params, *state, eos_d, self._z_life,
+                tables_d, cache, rngs, *sample,
+                self.cfg, k=k, use_pallas=self._use_pallas,
+                use_fused=self._use_fused, shardings=self._plan)
+            _, _, t_, p_, a_, b_, cache, rngs = out
+            state = (t_, p_, a_, b_)
+            out = self._mixed_guard(
+                self.params, *state, eos_d, self._z_life,
+                jnp.zeros((3, P), jnp.int32),
+                jnp.zeros((k, P, self._chunk), jnp.int32),
+                tables_d, cache, rngs, *sample,
+                self.cfg, k=k, shardings=self._plan)
+            _, _, t_, p_, a_, b_, cache, rngs = out
+            state = (t_, p_, a_, b_)
+        self.pool.cache = cache
+        self._rngs = rngs
+        # mirrors stay authoritative: the warm state is discarded, the
+        # first real launch re-uploads (values were untouched anyway)
+
+    def _launch_inputs(self) -> tuple:
+        """Device copies of the launch-invariant per-slot inputs (eos,
+        page tables, sampling params), rebuilt only when an admission
+        or finish dirtied them (``self._li = None``) — at steady state
+        a window dispatch re-uses them with zero device_put calls,
+        which is most of the host tax the window amortizes."""
+        if self._li is None:
+            self._li = (jnp.asarray(self._eos),
+                        jnp.asarray(self.pool.tables),
+                        jnp.asarray(self._temp),
+                        jnp.asarray(self._top_k),
+                        jnp.asarray(self._top_p),
+                        jnp.asarray(self._greedy))
+        return self._li
+
+    def _launch(self, k: int, kill: Optional[np.ndarray] = None
+                ) -> _InFlight:
+        """Dispatch one ``k``-step window WITHOUT fetching its results
+        — the pure decode-window program, or the MIXED prefill+decode
+        program whenever any slot still has prompt chunks to write.
+        The donated device step state from the previous dispatch feeds
+        straight back in (``_dev_state``); boundary lifecycle traffic —
+        ``kill`` flags and the admission-merge mask — rides the
+        dispatch as small traced inputs (``_merge_lifecycle``) instead
+        of invalidating it. Only a host-initiated finish outside the
+        mask path forces a mirror re-upload. The token block's
+        device->host copy starts immediately (``copy_to_host_async``),
+        so by the time ``_drain_window`` reads it the transfer has been
+        overlapping device compute."""
         t0_us = self.tel.now_us() if self.tel.enabled else 0.0
         t_wall = time.perf_counter()
-        n_active = int(self._active.sum())
+        P = self.ecfg.pool_size
+        if kill is None:
+            kill = np.zeros((P,), bool)
+        n_active = int((self._active & ~kill).sum())
         if self._dev_state is None:
             # host-side bound for the traced window writes: every REAL
             # write position (bounded by the per-slot budget — the
@@ -1055,34 +1539,97 @@ class Engine:
         else:
             state = self._dev_state
         tok, pos, active, budget = state
-        toks, emitted, tok, pos, active, budget, cache, rngs = \
-            self._decode_guard(
-                self.params, tok, pos, active, budget,
-                jnp.asarray(self._eos), jnp.asarray(self.pool.tables),
-                self.pool.cache, self._rngs, jnp.asarray(self._temp),
-                jnp.asarray(self._top_k), jnp.asarray(self._top_p),
-                jnp.asarray(self._greedy), self.cfg, k=k,
+        eos_d, tables_d, temp_d, top_k_d, top_p_d, greedy_d = \
+            self._launch_inputs()
+        # lifecycle inputs: quiet boundaries (the steady state) reuse
+        # the cached all-zero pack — no device_put; a boundary with
+        # kills or admissions uploads ONE (5, P) array (the admission
+        # merge reads the host mirrors directly, which were written at
+        # this boundary's admissions)
+        adm = self._adm_mask
+        if kill.any() or adm.any():
+            life_np = np.zeros((5, P), np.int32)
+            life_np[0] = kill
+            life_np[1] = adm
+            life_np[2] = self._tok
+            life_np[3] = self._pos
+            life_np[4] = self._budget
+            life = jnp.asarray(life_np)
+        else:
+            life = self._z_life
+        pf = np.flatnonzero((self._pf_left > 0) & ~kill)
+        if pf.size:
+            # mixed window: lay each still-prefilling slot's next
+            # min(k, pf_left) chunks into the scan's per-step payload;
+            # consumption is deterministic, so the cursors advance
+            # host-side with no fetch
+            chunk = self._chunk
+            pf_toks = np.zeros((k, P, chunk), np.int32)
+            pfc = np.zeros((3, P), np.int32)
+            pfc[1] = self._pf_off
+            pfc[2] = self._pf_limit
+            pf_done: List = []
+            for slot in pf:
+                slot = int(slot)
+                n = min(k, int(self._pf_left[slot]))
+                pfc[0, slot] = n
+                pf_toks[:n, slot, :] = \
+                    self._pf_tail[slot][:n * chunk].reshape(n, chunk)
+            out = self._mixed_guard(
+                self.params, tok, pos, active, budget, eos_d, life,
+                jnp.asarray(pfc), jnp.asarray(pf_toks),
+                tables_d, self.pool.cache, self._rngs,
+                temp_d, top_k_d, top_p_d, greedy_d, self.cfg, k=k,
+                shardings=self._plan)
+            for slot in pf:
+                slot = int(slot)
+                n = int(pfc[0, slot])
+                self._pf_left[slot] -= n
+                self._pf_off[slot] += n * chunk
+                if self._pf_left[slot] <= 0:
+                    self._pf_tail.pop(slot, None)
+                    pf_done.append((slot, self._slots[slot].req.id))
+                else:
+                    self._pf_tail[slot] = self._pf_tail[slot][n * chunk:]
+        else:
+            pf_done = []
+            out = self._decode_guard(
+                self.params, tok, pos, active, budget, eos_d, life,
+                tables_d, self.pool.cache, self._rngs,
+                temp_d, top_k_d, top_p_d, greedy_d, self.cfg, k=k,
                 use_pallas=self._use_pallas, use_fused=self._use_fused,
                 shardings=self._plan)
+        toks, emitted, tok, pos, active, budget, cache, rngs = out
         self.pool.cache = cache
         self._rngs = rngs
         self._dev_state = (tok, pos, active, budget)
-        for out in (toks, emitted):
-            copy_async = getattr(out, "copy_to_host_async", None)
+        self._adm_mask[:] = False       # the merge landed with this launch
+        for out_arr in (toks, emitted):
+            copy_async = getattr(out_arr, "copy_to_host_async", None)
             if copy_async is not None:
                 copy_async()
         # the host-side dispatch tax this PR amortizes: arg conversion +
         # trace-cache lookup + enqueue, all BEFORE any device wait (the
-        # bench dispatch-split line reads this histogram)
+        # bench dispatch-split line and the k-autotuner read this)
+        host_s = time.perf_counter() - t_wall
         self.metrics.inc("decode_dispatches")
-        self.metrics.observe("decode_dispatch_s",
-                             time.perf_counter() - t_wall)
+        self.metrics.observe("decode_dispatch_s", host_s)
         return _InFlight(toks=toks, emitted=emitted, k=k, t0_us=t0_us,
-                         t_wall=t_wall, n_active=n_active)
+                         t_wall=t_wall, n_active=n_active, host_s=host_s,
+                         pf_done=pf_done)
 
-    def _drain_pending(self) -> List[RequestResult]:
+    def _drain_pending(self, break_reason: str = "") -> List[RequestResult]:
+        """Fetch the in-flight window, if any. A non-empty
+        ``break_reason`` marks this drain as a WINDOW BREAK — the
+        continuous-window path had to be abandoned for a host mutation
+        — and feeds the ``window_breaks_{reason}`` counters
+        (admit|deadline|cancel|spec|reprobe), the PR's before/after
+        observability: post-continuous-windows only the speculative
+        reasons should ever move on a healthy engine."""
         if self._inflight is None:
             return []
+        if break_reason and self._window > 1:
+            self.metrics.inc("window_breaks_" + break_reason)
         w, self._inflight = self._inflight, None
         return self._drain_window(w)
 
@@ -1155,11 +1702,24 @@ class Engine:
             self.tel.complete("decode_step", self._tb + ENGINE_TRACK,
                               w.t0_us, dur_us, step=self.n_steps,
                               n_active=w.n_active, k=w.k, tokens=n_tok)
+        # windowed-admission radix registration: a slot whose in-window
+        # prefill COMPLETED in this dispatch has verifiably written its
+        # prompt pages — they become claimable from this boundary on
+        # (never earlier: a same-window neighbor sharing a page still
+        # being written would attend garbage). The id guards against
+        # the slot having been killed and recycled since the launch.
+        for slot, rid in w.pf_done:
+            st = self._slots.get(slot)
+            if st is not None and st.req.id == rid:
+                self.pool.commit_admission(slot)
         finished: List[RequestResult] = []
         for slot in list(self._slots):
-            # emitted[:, slot] is a prefix mask: a slot deactivates once
-            # inside a window and never re-arms
-            n_emit = int(emitted[:, slot].sum())
+            # emitted[:, slot] is a RUN mask: False while the slot
+            # prefills its admission chunks (mixed windows), True from
+            # its first decode step, False again once it deactivates —
+            # commit by mask, not by count
+            mask = emitted[:, slot]
+            n_emit = int(mask.sum())
             if n_emit == 0:
                 continue
             st = self._slots[slot]
@@ -1170,7 +1730,7 @@ class Engine:
                                   step=self.n_steps, request=st.req.id,
                                   k=w.k, tokens=n_emit)
             self._commit_tokens(slot, st,
-                                [int(t) for t in toks[:n_emit, slot]],
+                                [int(t) for t in toks[mask, slot]],
                                 now, w.t0_us, dur_us)
             eos = int(self._eos[slot])
             if eos >= 0 and st.tokens[-1] == eos:
@@ -1187,7 +1747,39 @@ class Engine:
         # deferred radix registration: the full prompt page holding
         # position P-1 becomes shareable once the frontier passed it
         self.pool.flush_pending()
+        # k-autotune: accumulate this window's host-vs-device split and
+        # let the bounded additive-increase policy climb the buckets
+        if w.k > 1:
+            self._at_host += w.host_s
+            self._at_wall += self.step_timer.laps[-1]
+            self._at_n += 1
+            self._maybe_autotune()
         return finished
+
+    def _maybe_autotune(self) -> None:
+        """Bounded additive-increase window sizing from the live
+        dispatch split: every ``WINDOW_AUTOTUNE_INTERVAL`` windows,
+        when the host dispatch tax is still more than
+        ``WINDOW_AUTOTUNE_HOST_FRAC`` of window wall time, move ONE
+        bucket up (never down, never past ``decode_window``). Every
+        bucket's programs compiled at construction, so a move is a
+        warm-cache dispatch-size change — zero recompiles by design."""
+        if (not self.ecfg.decode_window_auto
+                or self._wk >= len(self._buckets) - 1
+                or self._at_n < WINDOW_AUTOTUNE_INTERVAL):
+            return
+        host_frac = self._at_host / max(self._at_wall, 1e-9)
+        if host_frac > WINDOW_AUTOTUNE_HOST_FRAC:
+            self._wk += 1
+            self._window_cur = self._buckets[self._wk]
+            self.metrics.inc("autotune_window_increases")
+            self.metrics.gauge("decode_window_k", self._window_cur)
+            self._event(
+                f"step {self.n_steps}: autotune k -> {self._window_cur} "
+                f"(host dispatch {host_frac:.1%} of window wall over "
+                f"{self._at_n} windows)")
+        self._at_host = self._at_wall = 0.0
+        self._at_n = 0
 
     def _decode_once(self) -> List[RequestResult]:
         """Blocked k=1 decode: dispatch one step and immediately fetch
@@ -1349,15 +1941,25 @@ class Engine:
 
     def _finish_slot(self, slot: int, reason: str, now: float,
                      migrated: bool = False,
-                     device_stopped: bool = False) -> RequestResult:
+                     device_stopped: bool = False,
+                     masked: bool = False) -> RequestResult:
         st = self._slots.pop(slot)
         self._active[slot] = False
-        if not device_stopped:
-            # a host-initiated finish (cancel/deadline/migration): the
-            # device-resident step state still believes the slot is
-            # live — rebuild from the mirrors at the next launch.
-            # Budget/eos finishes already flipped the slot off ON
-            # DEVICE, so their state stays donatable as-is.
+        self._deadline[slot] = np.inf
+        self._adm_mask[slot] = False
+        self._li = None           # release zeroes the slot's table row
+        self._pf_left[slot] = 0
+        self._pf_off[slot] = 0
+        self._pf_limit[slot] = 0
+        self._pf_tail.pop(slot, None)
+        if not (device_stopped or masked):
+            # a host-initiated finish outside the mask path (a migrated
+            # cancel, or any finish on a blocked engine): the device-
+            # resident step state still believes the slot is live —
+            # rebuild from the mirrors at the next launch. Budget/eos
+            # finishes flipped the slot off ON DEVICE, and masked
+            # kills landed through the kill flags of a dispatch that
+            # has already launched, so both leave the state donatable.
             self._dev_state = None
         if self.tel.enabled:
             extra = {"migrated": True} if migrated else {}

@@ -74,6 +74,72 @@ class _Session:
     waiting_on: Optional[str] = None
 
 
+@dataclass(frozen=True)
+class AdmissionStormConfig:
+    """The admission-heavy saturating trace (the continuous-window
+    acceptance workload): arrivals outpace the pool so nearly EVERY
+    window boundary has an admissible head, prompts are short (admission
+    cost dominates decode), and a slice of the traffic carries tight
+    deadlines or mid-stream cancels — exactly the request dynamism that
+    used to collapse the async engine to blocked k=1 dispatches. A
+    continuous-window engine must hold its idle-trace dispatch
+    amortization (>= 90%) through this storm; the pre-PR engine drops
+    to 1.0x by construction."""
+
+    n_requests: int = 96
+    rate: float = 50_000.0         # arrivals/sec — saturating by design
+    prompt_len_min: int = 2
+    prompt_len_max: int = 8
+    max_new_min: int = 6
+    max_new_max: int = 14
+    deadline_frac: float = 0.2     # fraction with a tight deadline
+    deadline_s: float = 0.05       # relative deadline for that slice
+    cancel_frac: float = 0.15      # fraction cancelled mid-flight
+    cancel_after_s: float = 0.02   # cancel issued this long after arrival
+    greedy: bool = True
+    seed: int = 0
+
+
+def admission_storm(mcfg: ModelConfig, scfg: AdmissionStormConfig
+                    ) -> tuple:
+    """Build the storm: returns ``(trace, cancels, deadlines)`` —
+    ``trace`` is the (arrival_time, request) list ``run_replay`` takes,
+    ``cancels`` a time-sorted [(t, request_id), ...] schedule the replay
+    issues through ``engine.cancel``, and ``deadlines`` a
+    {request_id: relative_deadline_s} map applied at submit. All draws
+    seeded; the deadline/cancel slices are disjoint (a cancelled
+    request's terminal reason must be unambiguous in the artifact)."""
+    rng = np.random.default_rng(scfg.seed)
+    hi = min(scfg.prompt_len_max, mcfg.block_size)
+    lo = min(scfg.prompt_len_min, hi)
+    sp = SamplingParams(greedy=scfg.greedy)
+    n = scfg.n_requests
+    # all scalar randomness drawn vectorized, converted once (host
+    # numpy; .tolist() keeps the per-request loop free of per-item
+    # float()/int() conversions per GL004)
+    gaps = rng.exponential(1.0 / max(scfg.rate, 1e-9), n)
+    arrivals = np.cumsum(gaps).tolist()
+    lens = rng.integers(lo, hi + 1, n).tolist()
+    budgets = rng.integers(scfg.max_new_min, scfg.max_new_max + 1,
+                           n).tolist()
+    lanes = rng.random(n).tolist() # [0, deadline_frac) -> deadline,
+                                   # [deadline_frac, +cancel_frac) -> cancel
+    trace, cancels, deadlines = [], [], {}
+    for i in range(n):
+        rid = f"storm{i:04d}"
+        prompt = rng.integers(0, mcfg.vocab_size, (lens[i],),
+                              dtype=np.int64).astype(np.int32)
+        trace.append((arrivals[i], Request(
+            id=rid, prompt=prompt, max_new_tokens=budgets[i],
+            sampling=sp, rng_seed=scfg.seed * 100_003 + i)))
+        if lanes[i] < scfg.deadline_frac:
+            deadlines[rid] = scfg.deadline_s
+        elif lanes[i] < scfg.deadline_frac + scfg.cancel_frac:
+            cancels.append((arrivals[i] + scfg.cancel_after_s, rid))
+    cancels.sort()
+    return trace, cancels, deadlines
+
+
 class StepClock:
     """Injectable virtual clock for deterministic fleet replays: the
     driver advances it one ``dt`` per router step, so arrival order,

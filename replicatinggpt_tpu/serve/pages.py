@@ -422,6 +422,12 @@ class PagedCachePool:
         self._owner: Dict[int, str] = {}
         self._slot_by_request: Dict[str, int] = {}   # reverse index: O(1)
         self._claims: Dict[int, PageClaim] = {}
+        # slots admitted with defer_commit=True (in-window prefill):
+        # their radix registration is gated on commit_admission — the
+        # engine calls it only once the writes are known landed, so
+        # flush_pending can never pre-register a page a still-flying
+        # window is writing
+        self._deferred: set = set()
 
     # ---------------------------------------------------------- geometry
 
@@ -462,13 +468,22 @@ class PagedCachePool:
         return len(chain) * self.page_size
 
     def acquire(self, request_id: str, prompt: np.ndarray,
-                cap: int) -> Optional[Admission]:
+                cap: int, defer_commit: bool = False
+                ) -> Optional[Admission]:
+        """``defer_commit=True`` (the engine's windowed-admission path)
+        holds the slot OUT of radix registration — including
+        ``flush_pending`` — until ``commit_admission``: its prompt
+        pages are being written by an in-flight mixed window, and a
+        registered page must never be claimable before its writes have
+        landed in dispatch order."""
         if not self._free_slots:
             return None
         claim = self.alloc.acquire(prompt, cap)
         if claim is None:
             return None
         slot = self._free_slots.pop()
+        if defer_commit:
+            self._deferred.add(slot)
         self._owner[slot] = request_id
         self._slot_by_request[request_id] = slot
         self._claims[slot] = claim
@@ -482,19 +497,25 @@ class PagedCachePool:
     def commit_admission(self, slot: int) -> None:
         """Register the slot's already-final full prompt pages (called
         after prefill wrote them — registration order is what lets a
-        same-step neighbor claim them safely)."""
+        same-step neighbor claim them safely). Lifts a
+        ``defer_commit`` hold."""
+        self._deferred.discard(slot)
         self.alloc.register(self._claims[slot], int(self.positions[slot]))
 
     def flush_pending(self) -> None:
         """Advance deferred registrations (the page containing prompt
         position P-1 becomes shareable once the first decode write
         passed it). Called once per engine step — cheap: at most one
-        page per slot ever waits."""
+        page per slot ever waits. Slots under a ``defer_commit`` hold
+        are skipped: their prompt writes may still be in flight."""
         for slot, claim in self._claims.items():
+            if slot in self._deferred:
+                continue
             if self.alloc.pending_registration(claim):
                 self.alloc.register(claim, int(self.positions[slot]))
 
     def release(self, slot: int) -> None:
+        self._deferred.discard(slot)
         owner = self._owner.pop(slot, None)
         assert owner is not None, f"slot {slot} double-free"
         # conditional: duplicate request ids are rejected at submit, but

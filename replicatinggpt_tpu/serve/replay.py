@@ -109,7 +109,10 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
                metrics_out: Optional[str] = None,
                profile_dir: Optional[str] = None,
                profile_start: int = 10,
-               profile_steps: int = 5) -> dict:
+               profile_steps: int = 5,
+               trace: Optional[List[Tuple[float, Request]]] = None,
+               cancels: Optional[List[Tuple[float, str]]] = None,
+               deadlines: Optional[dict] = None) -> dict:
     """Replay the trace in wall-clock time; returns the summary dict.
 
     ``warmup`` first pushes one tiny request through a throwaway engine
@@ -141,6 +144,15 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
     timeline, with host spans linked by ``annotate`` region names.
     Paths of everything written land in the summary's ``artifacts``
     block (bench.py attaches it to the artifact JSON).
+
+    ``trace`` replays a PREBUILT (arrival_time, request) list instead of
+    ``make_trace(mcfg, rcfg)`` — the admission-storm preset
+    (serve/loadgen.admission_storm) enters here. ``cancels`` is a
+    time-sorted [(t, request_id), ...] schedule issued through
+    ``engine.cancel`` as the replay clock passes each t (a cancel for a
+    request that already finished is a no-op), and ``deadlines`` maps
+    request ids to RELATIVE deadlines applied at submit (per-request,
+    where ``rcfg.deadline_s`` is uniform).
     """
     def drafter():
         return make_drafter(rcfg.spec, rcfg.spec_k, rcfg.spec_ngram,
@@ -148,9 +160,11 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
                             ecfg.prefill_chunk)
 
     def tiny(rid):
-        # long enough to compile the steady-state decode WINDOW on top
-        # of the k=1 admission-step program (EngineConfig.warmup_tokens
-        # — one definition shared with the worker's readiness warmup)
+        # long enough to EXERCISE the steady-state window path past the
+        # admission boundary's mixed dispatch (the window programs
+        # themselves compile at engine construction —
+        # Engine._warm_windows; EngineConfig.warmup_tokens is one
+        # definition shared with the worker's readiness warmup)
         return Request(id=rid, prompt=np.zeros((1,), np.int32),
                        max_new_tokens=ecfg.warmup_tokens(),
                        sampling=SamplingParams(greedy=True))
@@ -177,9 +191,12 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
     from ..utils.profiling import trace_window
     profiler = trace_window(profile_dir, start=profile_start,
                             n_steps=profile_steps)
-    trace = make_trace(mcfg, rcfg)
+    if trace is None:
+        trace = make_trace(mcfg, rcfg)
+    cancels = sorted(cancels) if cancels else []
     results: List[RequestResult] = []
     i = 0
+    ci = 0
     n_trace_events = 0
     t0 = time.monotonic()
     # GRAFT_SANITIZE=1 runs the whole replay under jax's tracer-leak +
@@ -194,12 +211,20 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
                 now = time.monotonic() - t0
                 while i < len(trace) and trace[i][0] <= now:
                     arr_t, req = trace[i]
-                    if rcfg.deadline_s > 0:
+                    if deadlines and req.id in deadlines:
+                        req.deadline = (time.monotonic()
+                                        + deadlines[req.id])
+                    elif rcfg.deadline_s > 0:
                         req.deadline = time.monotonic() + rcfg.deadline_s
                     rej = engine.submit(req)
                     if rej is not None:
                         results.append(rej)
                     i += 1
+                while ci < len(cancels) and cancels[ci][0] <= now:
+                    # mid-flight cancel traffic (the storm trace); a
+                    # cancel for an already-finished id is a no-op
+                    engine.cancel(cancels[ci][1])
+                    ci += 1
                 if engine.idle:
                     if i >= len(trace):
                         break
@@ -285,11 +310,21 @@ def format_summary(s: dict) -> str:
     ]
     dp = s.get("dispatch")
     if dp and dp.get("dispatches"):
+        auto = (f" (autotuned from {dp['window_k_max']} cap, "
+                f"{dp['autotune_increases']} increase(s))"
+                if dp.get("autotune") else "")
         lines.insert(4, (
-            f"dispatch split: window k={dp['window_k']}, "
+            f"dispatch split: window k={dp['window_k']}{auto}, "
             f"{dp['dispatches']} dispatches, host "
             f"{dp['mean_dispatch_ms']:.3f} ms/dispatch -> "
             f"{dp['host_dispatch_ms_per_token']:.3f} ms/token"))
+        wb = s.get("window_breaks") or {}
+        if dp.get("window_k_max", dp["window_k"]) > 1:
+            lines.insert(5, (
+                "window breaks: "
+                + " ".join(f"{r}={wb.get(r, 0)}" for r in
+                           ("admit", "deadline", "cancel", "spec",
+                            "reprobe"))))
     pg = s.get("pages")
     if pg:
         lines.insert(2, (

@@ -233,9 +233,10 @@ def warm_engine(engine: Engine) -> None:
     """One throwaway greedy request through prefill + decode, so
     readiness implies compiled programs (no journal attached yet — a
     warmup request must never appear in a crash journal). With a
-    decode window configured the request is long enough to compile the
-    steady-state k-step window program on top of the k=1
-    admission-step fallback (``EngineConfig.warmup_tokens`` — shared
+    decode window configured the bucketed window programs compiled at
+    engine construction (``Engine._warm_windows``); this request is
+    long enough to EXERCISE the steady-state path past the admission
+    boundary's mixed dispatch (``EngineConfig.warmup_tokens`` — shared
     with the replay warmup)."""
     import numpy as np
 

@@ -1,0 +1,16 @@
+"""GL015 bad: blocking fetches / window drains on the launch side of a
+windowed dispatch path."""
+
+import numpy as np
+
+
+class Engine:
+    def _launch(self, k):
+        toks = np.asarray(self._inflight.toks)   # blocks mid-launch
+        self._drain_pending()                    # breaks the window
+        return self._dispatch(k), toks
+
+    def _launch_mixed(self, k):
+        w = self._dispatch(k)
+        w.toks.block_until_ready()               # serializes every window
+        return w

@@ -576,10 +576,17 @@ def test_metrics_summary_key_schema(params):
                 "pages"):
         assert key in s, key
     assert set(s["compile_counts"]) == {
-        "decode", "prefill", "verify", "page_copy", "draft_decode",
-        "draft_prefill"}
-    assert set(s["compile_guards"]) == {"decode", "prefill", "verify",
-                                        "page_copy"}
+        "decode", "mixed", "prefill", "verify", "page_copy",
+        "draft_decode", "draft_prefill"}
+    assert set(s["compile_guards"]) == {"decode", "mixed", "prefill",
+                                        "verify", "page_copy"}
+    # continuous-window observability (ISSUE 13): the break counters
+    # keyed by reason, and the k-autotune fields in the dispatch block
+    assert set(s["window_breaks"]) == {"admit", "deadline", "cancel",
+                                       "spec", "reprobe"}
+    for key in ("window_k", "window_k_max", "autotune",
+                "autotune_increases"):
+        assert key in s["dispatch"], key
     assert set(s["recovery"]) == {
         "watchdog_stalls", "spec_disables", "spec_reprobes",
         "shed_requests", "spec_active", "events"}

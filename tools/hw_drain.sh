@@ -14,6 +14,17 @@ echo "=== hw_validate rc=$? $(date -u +%FT%TZ)" >> "$LOG"
 timeout -s INT --kill-after=60 2400 python bench.py \
   > benchmarks/BENCH_r05_builder.json 2>> "$LOG"
 echo "=== bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+# continuous-window serve row (ISSUE 13): dispatch split at window k=8
+# + admission-storm retention + autotuned k, 1x1 then the 2x2 mesh
+timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
+  --decode-window 8 --decode-window-auto --serve-storm-trace \
+  > benchmarks/BENCH_serve_window.json 2>> "$LOG"
+echo "=== serve-window rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
+  --decode-window 8 --decode-window-auto --serve-storm-trace \
+  --mesh-shape 2x2 \
+  > benchmarks/BENCH_serve_window_2x2.json 2>> "$LOG"
+echo "=== serve-window-2x2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
 mkdir -p benchmarks/converged_gpt2
 timeout -s INT --kill-after=60 5400 python -m replicatinggpt_tpu train \
   --preset gpt2-large --dataset datasets/shakespeare.txt \
