@@ -614,17 +614,30 @@ def bench_fleet(args) -> None:
     ``chaos: replica_kill``.
 
     ``--multiproc`` runs the replicas as real worker PROCESSES
-    (serve-worker + faults/procsup.py supervisor) speaking serve/rpc.py
-    over loopback sockets: the artifact gains per-worker pid/restart
-    counts and the requeue-latency distribution, and ``--fleet-kill-at``
-    becomes a REAL ``SIGKILL`` of worker 0's process (``proc_kill``) —
-    recovery is supervised restart + journal replay, and the completed
-    turn count still has to come out whole."""
+    (serve-worker + faults/procsup.py supervisor) registering over
+    RPC, each with a PRIVATE journal dir: the artifact gains
+    per-worker pid/restart counts and the requeue-latency
+    distribution, and ``--fleet-kill-at`` becomes a REAL ``SIGKILL``
+    of worker 0's process (``proc_kill``) — recovery is supervised
+    restart + journal replay, and the completed turn count still has
+    to come out whole. ``--fleet-host-loss`` upgrades the kill to
+    ``host_loss`` (SIGKILL + the worker's journal/workdir deleted):
+    recovery is then the ROUTER's own request ledger, nothing on the
+    worker's filesystem survives by construction.
+
+    ``--fleet-load-step`` is the autoscaler preset: ONE worker starts,
+    session arrivals double mid-run then halve
+    (``SessionLoadConfig.load_step``), and the supervisor's autoscaler
+    spawns/drains workers from the router's offered-load gauges up to
+    ``--fleet-replicas``. The artifact emits scale-up/scale-down
+    counts, peak/final worker counts, and the zero-drop verification
+    (completed == submitted)."""
     import jax
 
     from replicatinggpt_tpu.config import get_config
     from replicatinggpt_tpu.faults import Fault, FaultPlan, installed
     from replicatinggpt_tpu.faults.fleet import (FLEET_STEP,
+                                                 KIND_HOST_LOSS,
                                                  KIND_PROC_KILL,
                                                  KIND_REPLICA_KILL)
     from replicatinggpt_tpu.serve import (EngineConfig, RouterConfig,
@@ -641,11 +654,18 @@ def bench_fleet(args) -> None:
     max_new = min(args.serve_max_new_tokens,
                   max((block - prefix_len) // (2 * args.fleet_turns), 1))
     user_len = max(min(max_new // 2, 8), 1)
+    multiproc = args.multiproc or args.fleet_load_step
+    if args.fleet_host_loss and not multiproc:
+        raise SystemExit("--fleet-host-loss requires --multiproc "
+                         "(host loss is a real SIGKILL + workdir "
+                         "deletion of a worker PROCESS; the "
+                         "in-process fleet has no host to lose)")
     lcfg = SessionLoadConfig(
         n_sessions=args.fleet_sessions, turns=args.fleet_turns,
         n_prefix_groups=args.fleet_prefix_groups, prefix_len=prefix_len,
         user_len_min=1, user_len_max=user_len, max_new_tokens=max_new,
-        rate=args.serve_rate, greedy=True, seed=0)
+        rate=args.serve_rate, greedy=True, seed=0,
+        load_step=args.fleet_load_step)
     rcfg = RouterConfig(n_replicas=args.fleet_replicas,
                         journal_dir=args.fleet_journal_dir or None)
     # default the page size so the shared prefix spans >= 2 full pages
@@ -656,40 +676,69 @@ def bench_fleet(args) -> None:
                         max_queue=4 * args.fleet_sessions,
                         page_size=page_size,
                         n_pages=args.serve_n_pages)
+    n_initial = 1 if args.fleet_load_step else rcfg.n_replicas
     log(f"fleet replay: {lcfg.n_sessions} sessions x {lcfg.turns} turns "
-        f"@ {lcfg.rate}/s over {rcfg.n_replicas} "
-        f"{'worker process' if args.multiproc else 'replica'}(s) "
+        f"@ {lcfg.rate}/s{' (load-step x2 then /2)' if lcfg.load_step else ''} "
+        f"over {n_initial} "
+        f"{'worker process' if multiproc else 'replica'}(s)"
+        f"{f' (autoscale <= {rcfg.n_replicas})' if args.fleet_load_step else ''} "
         f"(pool {ecfg.pool_size} each), prefix {prefix_len} tok x "
         f"{lcfg.n_prefix_groups} groups, model {cfg.model.n_layer}L/"
         f"{cfg.model.n_head}H/{cfg.model.n_embd}C on {dev.device_kind}")
     import contextlib
     import tempfile
     plan_ctx = contextlib.nullcontext()
+    chaos_kind = None
     if args.fleet_kill_at >= 0:
         # in-process: simulated replica_kill; multiproc: a REAL SIGKILL
-        # of worker 0's OS process through the supervisor
-        kind = KIND_PROC_KILL if args.multiproc else KIND_REPLICA_KILL
+        # of worker 0's OS process through the supervisor —
+        # --fleet-host-loss additionally deletes its journal/workdir
+        if not multiproc:
+            chaos_kind = KIND_REPLICA_KILL
+        elif args.fleet_host_loss:
+            chaos_kind = KIND_HOST_LOSS
+        else:
+            chaos_kind = KIND_PROC_KILL
         plan_ctx = installed(FaultPlan(Fault(
-            site=FLEET_STEP, kind=kind, at=args.fleet_kill_at, arg=0)))
+            site=FLEET_STEP, kind=chaos_kind, at=args.fleet_kill_at,
+            arg=0)))
     workers = None
+    scale = None
     with tempfile.TemporaryDirectory() as td:
+        import dataclasses
         if rcfg.journal_dir is None:
             # requeue-after-kill needs journals; default them to a temp
             # dir so the chaos arm always has the recovery path
-            import dataclasses
             rcfg = dataclasses.replace(rcfg, journal_dir=td)
-        if args.multiproc:
+        if multiproc:
             from replicatinggpt_tpu.faults.procsup import (
-                SupervisorConfig, make_worker_specs, spawn_fleet)
-            specs = make_worker_specs(
-                rcfg.n_replicas, rcfg.journal_dir,
-                ["--preset", args.preset],
-                ["--pool-size", str(ecfg.pool_size),
-                 "--max-queue", str(ecfg.max_queue),
-                 "--page-size", str(ecfg.page_size),
-                 "--n-pages", str(ecfg.n_pages)])
-            log(f"spawning {rcfg.n_replicas} worker process(es) "
-                f"(journals in {rcfg.journal_dir})")
+                AutoscaleConfig, SupervisorConfig, make_worker_specs,
+                spawn_fleet, worker_spec_factory)
+            # the router's own ledger: host_loss recovery reads no
+            # worker filesystem
+            rcfg = dataclasses.replace(
+                rcfg, ledger_path=os.path.join(rcfg.journal_dir,
+                                               "router_ledger.jsonl"))
+            config_args = ["--preset", args.preset]
+            engine_args = ["--pool-size", str(ecfg.pool_size),
+                           "--max-queue", str(ecfg.max_queue),
+                           "--page-size", str(ecfg.page_size),
+                           "--n-pages", str(ecfg.n_pages)]
+            specs = make_worker_specs(n_initial, rcfg.journal_dir,
+                                      config_args, engine_args)
+            autoscale = spec_factory = None
+            if args.fleet_load_step:
+                autoscale = AutoscaleConfig(
+                    min_workers=1,
+                    max_workers=max(rcfg.n_replicas, 2),
+                    up_backlog_per_worker=1.0, up_patience=2,
+                    down_active_per_worker=2.0, down_patience=12,
+                    cooldown_ticks=8)
+                spec_factory = worker_spec_factory(
+                    rcfg.journal_dir, config_args, engine_args)
+            log(f"spawning {n_initial} worker process(es) "
+                f"(private dirs under {rcfg.journal_dir}; RPC "
+                f"registration)")
             tel = None
             if args.trace_out:
                 # the pre-built-router replay exports the ROUTER's own
@@ -698,7 +747,9 @@ def bench_fleet(args) -> None:
                 tel = Telemetry()
             router, sup = spawn_fleet(specs, rcfg,
                                       SupervisorConfig(backoff_s=0.2),
-                                      telemetry=tel)
+                                      telemetry=tel,
+                                      autoscale=autoscale,
+                                      spec_factory=spec_factory)
             try:
                 with plan_ctx:
                     summary = run_fleet_replay(
@@ -713,6 +764,26 @@ def bench_fleet(args) -> None:
                     "crash_restarts": h.crash_restarts,
                     "state": h.state,
                 } for h in sup.handles]
+                if args.fleet_load_step:
+                    from replicatinggpt_tpu.faults.procsup import RUNNING
+                    # let the post-trace lull land: the scale-DOWN
+                    # decision needs its patience window of idle ticks
+                    # after the last session finished
+                    lull_deadline = time.time() + 30.0
+                    while (sup.scale_downs == 0 and sup.scale_ups > 0
+                           and time.time() < lull_deadline):
+                        router.step()
+                        sup.tick()
+                        time.sleep(0.01)
+                    scale = {
+                        "scale_ups": sup.scale_ups,
+                        "scale_downs": sup.scale_downs,
+                        "workers_peak": sup.peak_workers,
+                        "workers_final": sum(
+                            h.state == RUNNING for h in sup.handles),
+                        "zero_drop": (summary["n_completed"]
+                                      == summary["n_requests"]),
+                    }
             finally:
                 sup.stop_all()
                 router.close()
@@ -737,6 +808,11 @@ def bench_fleet(args) -> None:
         f"{summary['aggregate_prefix_hit_rate']}, requeued "
         f"{summary['router'].get('fleet_requeued_requests', 0)}, "
         f"{summary['recompiles_after_warmup']} recompiles after warmup")
+    if scale is not None:
+        log(f"autoscale: {scale['scale_ups']} up / "
+            f"{scale['scale_downs']} down, peak "
+            f"{scale['workers_peak']} workers, final "
+            f"{scale['workers_final']}, zero_drop={scale['zero_drop']}")
     emit({
         "metric": "fleet_replay_aggregate_tokens_per_sec",
         "value": round(agg, 1),
@@ -774,11 +850,10 @@ def bench_fleet(args) -> None:
             "finished": r["finished"],
         } for r in summary["replicas"]],
         **({"multiproc": True, "workers": workers}
-           if args.multiproc else {}),
-        **({"chaos": ("proc_kill" if args.multiproc
-                      else "replica_kill"),
-            "kill_at": args.fleet_kill_at}
-           if args.fleet_kill_at >= 0 else {}),
+           if multiproc else {}),
+        **({"chaos": chaos_kind, "kill_at": args.fleet_kill_at}
+           if chaos_kind is not None else {}),
+        **({"load_step": True, **scale} if scale is not None else {}),
         **({"artifacts": summary["artifacts"]}
            if "artifacts" in summary else {}),
     })
@@ -1157,8 +1232,26 @@ def main() -> None:
                    help="--mode fleet: run the replicas as real worker "
                         "PROCESSES (serve-worker subprocesses over "
                         "serve/rpc.py under the faults/procsup.py "
-                        "supervisor); the artifact gains per-worker "
+                        "supervisor, RPC registration, private journal "
+                        "dirs); the artifact gains per-worker "
                         "pid/restart counts and requeue latency")
+    p.add_argument("--fleet-host-loss", action="store_true",
+                   help="--mode fleet --multiproc: upgrade "
+                        "--fleet-kill-at to host_loss chaos (SIGKILL "
+                        "+ the worker's journal/workdir DELETED) — "
+                        "recovery must come from the router's own "
+                        "request ledger, nothing on the worker's "
+                        "filesystem survives")
+    p.add_argument("--fleet-load-step", action="store_true",
+                   help="--mode fleet: the autoscaler preset (implies "
+                        "--multiproc): start ONE worker, run the "
+                        "load-step session trace (arrival rate "
+                        "doubles mid-run, then halves), autoscale up "
+                        "to --fleet-replicas workers on sustained "
+                        "backlog and drain back down on the lull; the "
+                        "artifact emits scale-up/scale-down counts, "
+                        "peak/final worker counts and the zero-drop "
+                        "verification")
     p.add_argument("--fleet-journal-dir", default="",
                    help="--mode fleet: per-replica crash journals "
                         "(default: a temp dir)")

@@ -28,6 +28,7 @@ missing an exotic spelling over drowning real findings in noise.
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -1321,6 +1322,132 @@ class Engine:
         return np.asarray(w.toks)    # the ONE sync, at the boundary
 """,
     checker=_check_windowed_host_block))
+
+
+# ---------------------------------------------------------------------------
+# GL016 — shared-filesystem assumptions on the router side of the fleet
+# ---------------------------------------------------------------------------
+
+#: reader calls that imply the caller can see the target file
+_GL016_READERS = {"open", "load_jsonl_if_exists",
+                  "RequestJournal.unfinished"}
+#: attribute/name spellings of PER-WORKER artifact paths: a router
+#: holding one of these and reading through it assumes the worker's
+#: disk is mounted here
+_GL016_PATH_NAMES = {"journal_path", "ready_file"}
+#: string literals shaped like per-replica artifacts: flat
+#: replica{i}.jsonl / worker{i}.jsonl names, the per-worker-dir
+#: layout worker{i}/journal.jsonl, and ready files
+_GL016_PATH_LITERAL = re.compile(
+    r"(?:replica|worker)\d*[^/]*\.jsonl$"
+    r"|(?:^|/)worker\d*/journal\.jsonl$"
+    r"|\.ready(?:\.json)?$")
+
+
+def _gl016_class_is_local(node: ast.ClassDef) -> bool:
+    """A class declaring ``is_local = True`` at class level is the
+    local-mode backend: its replica shares the router's filesystem by
+    construction, so reading its own journal path is legitimate."""
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if (isinstance(t, ast.Name) and t.id == "is_local"
+                        and isinstance(stmt.value, ast.Constant)
+                        and stmt.value.value is True):
+                    return True
+    return False
+
+
+def _gl016_worker_path_arg(call: ast.Call) -> Optional[str]:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for n in ast.walk(arg):
+            if (isinstance(n, ast.Attribute)
+                    and n.attr in _GL016_PATH_NAMES):
+                return n.attr
+            if isinstance(n, ast.Name) and n.id in _GL016_PATH_NAMES:
+                return n.id
+            if (isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                    and _GL016_PATH_LITERAL.search(n.value)):
+                return repr(n.value)
+    return None
+
+
+def _check_fleet_shared_fs(tree: ast.Module, lines: Sequence[str],
+                           path: str) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, exempt: bool) -> None:
+        if isinstance(node, ast.ClassDef):
+            exempt = exempt or _gl016_class_is_local(node)
+        if isinstance(node, ast.Call) and not exempt:
+            f = dotted(node.func)
+            is_reader = (f in _GL016_READERS
+                         or (isinstance(node.func, ast.Attribute)
+                             and node.func.attr == "unfinished"))
+            if is_reader:
+                hit = _gl016_worker_path_arg(node)
+                if hit is not None:
+                    findings.append(_finding(
+                        "GL016", node,
+                        f"`{f or node.func.attr}(...)` reads a "
+                        f"per-worker artifact ({hit}) on the router "
+                        f"side of the fleet — a shared-filesystem "
+                        f"assumption: the worker's disk may be on "
+                        f"another machine (or gone entirely, the "
+                        f"host-loss case). Reconcile through the "
+                        f"backend's `journal_state()` (journal_drain "
+                        f"RPC for remote replicas) or the router's "
+                        f"own ledger; only the local-mode backend "
+                        f"(`is_local = True`) may touch a replica "
+                        f"path directly",
+                        path, lines))
+        for child in ast.iter_child_nodes(node):
+            visit(child, exempt)
+
+    visit(tree, False)
+    return findings
+
+
+_register(Rule(
+    id="GL016", name="fleet-shared-filesystem",
+    rationale=(
+        "The multi-host fleet's contract is that NO component reads "
+        "another component's disk: workers journal locally, the "
+        "router journals its own ledger, and reconciliation state "
+        "crosses the RPC channel (register handshake, journal_drain "
+        "frames). Router-side code that opens a worker's journal or "
+        "a ready file works perfectly on one machine and silently "
+        "pins the whole fleet to one filesystem — the moment a worker "
+        "lands on another host (or its host vanishes, taking the "
+        "journal with it), recovery reads an empty/missing file and "
+        "requests are dropped or double-decoded. The in-process "
+        "backend (`is_local = True`) is exempt: its replica shares "
+        "the router's filesystem by construction."),
+    bad="""\
+class Router:
+    def reconcile(self, rep):
+        # the worker's journal may live on ANOTHER MACHINE
+        return RequestJournal.unfinished(rep.journal_path)
+
+    def await_worker(self, spec):
+        with open(spec.ready_file) as f:   # ready-file handshake
+            return json.load(f)
+""",
+    good="""\
+class Replica:
+    is_local = True                        # in-process: same disk
+
+    def journal_state(self):
+        return RequestJournal.unfinished(self.journal_path)
+
+class Router:
+    def reconcile(self, rep):
+        # the BACKEND owns journal access: local file or
+        # journal_drain RPC — the router never sees a path
+        return rep.journal_state()
+""",
+    checker=_check_fleet_shared_fs))
 
 
 def all_rule_ids() -> List[str]:

@@ -420,8 +420,15 @@ def cmd_serve(args) -> int:
     from .serve.http import ServeApp
     from .serve.router import RouterConfig
 
+    import os
+
+    ledger = args.ledger
+    if ledger is None and args.multiproc and args.journal_dir:
+        ledger = os.path.join(args.journal_dir, "router_ledger.jsonl")
     rcfg = RouterConfig(n_replicas=args.replicas,
                         journal_dir=args.journal_dir,
+                        ledger_path=ledger,
+                        ledger_fsync=args.ledger_fsync,
                         affinity=not args.no_affinity,
                         wedge_budget_s=args.wedge_budget_s,
                         wedge_patience=args.wedge_patience,
@@ -433,12 +440,14 @@ def cmd_serve(args) -> int:
     supervisor = None
     if args.multiproc:
         if not args.journal_dir:
-            print("--multiproc requires --journal-dir (shared journal "
-                  "storage is the cross-process source of truth)",
-                  file=sys.stderr)
+            print("--multiproc requires --journal-dir (the base "
+                  "directory for per-worker PRIVATE journal dirs and "
+                  "the router's own ledger — nothing in it is shared "
+                  "between processes)", file=sys.stderr)
             return 2
-        from .faults.procsup import (SupervisorConfig,
-                                     make_worker_specs, spawn_fleet)
+        from .faults.procsup import (AutoscaleConfig, SupervisorConfig,
+                                     make_worker_specs, spawn_fleet,
+                                     worker_spec_factory)
         # the workers must build the SAME model the operator asked
         # for: forward every set model-override flag (the serve-worker
         # parser takes the full add_config_flags set too) — silently
@@ -462,17 +471,41 @@ def cmd_serve(args) -> int:
             engine_args += ["--checkpoint-dir", args.checkpoint_dir]
         specs = make_worker_specs(args.replicas, args.journal_dir,
                                   config_args, engine_args)
+        # pin the fleet's expected engine shape from THIS process's
+        # parse of the same flags the workers receive: a worker whose
+        # build resolves a different model/engine is rejected at
+        # registration with RpcProtocolError, never served traffic
+        from .serve.rpc import engine_shape_hash
+        expect = engine_shape_hash(config_from_args(args).model,
+                                   engine_config_from_args(args))
+        autoscale = spec_factory = None
+        if args.autoscale_max > 0:
+            autoscale = AutoscaleConfig(min_workers=args.autoscale_min,
+                                        max_workers=args.autoscale_max)
+            spec_factory = worker_spec_factory(args.journal_dir,
+                                               config_args, engine_args)
         print(f"spawning {args.replicas} worker process(es); waiting "
-              f"for warmup + ready files in {args.journal_dir}",
+              f"for warmup + RPC registration (expect shape {expect})",
               file=sys.stderr)
         router, supervisor = spawn_fleet(
             specs, rcfg,
-            SupervisorConfig(restart_budget=args.restart_budget),
-            telemetry=telemetry)
+            SupervisorConfig(restart_budget=args.restart_budget,
+                             expect_shape_hash=expect),
+            telemetry=telemetry, autoscale=autoscale,
+            spec_factory=spec_factory, listen_host=args.listen_host)
+        if args.listen_host not in ("127.0.0.1", "localhost"):
+            print(f"fleet up: workers on other hosts join via "
+                  f"`serve-worker --router-addr "
+                  f"<this-host>:{supervisor.listener.port}`",
+                  file=sys.stderr)
+        else:
+            print(f"fleet up: registration on "
+                  f"{supervisor.router_addr} (loopback — pass "
+                  f"`--listen-host 0.0.0.0` to accept workers from "
+                  f"other hosts)", file=sys.stderr)
     else:
         import jax
 
-        from .config import config_from_args
         from .serve import Router
         from .train.state import create_train_state
         cfg = config_from_args(args)
@@ -714,9 +747,26 @@ def main(argv=None) -> int:
     pv.add_argument("--replicas", type=int, default=1,
                     help="engine replicas behind the router")
     pv.add_argument("--journal-dir", default=None,
-                    help="per-replica crash journals live here; "
-                         "required for cross-replica requeue after a "
-                         "replica death (docs/robustness.md)")
+                    help="in-process mode: per-replica crash journals "
+                         "live here (cross-replica requeue after a "
+                         "replica death); --multiproc: the LAUNCHER's "
+                         "base dir for per-worker PRIVATE dirs "
+                         "(worker{i}/journal.jsonl + log) — nothing "
+                         "is shared between processes "
+                         "(docs/robustness.md)")
+    pv.add_argument("--ledger", default=None,
+                    help="the ROUTER's own crash journal: submits at "
+                         "fleet acceptance, finishes at terminal "
+                         "results; a restarted router requeues its "
+                         "accepted-but-unfinished set from here — "
+                         "recovery that reads NO worker filesystem "
+                         "(survives total worker-host loss). Default "
+                         "under --multiproc: "
+                         "<journal-dir>/router_ledger.jsonl")
+    pv.add_argument("--ledger-fsync", action="store_true",
+                    help="fsync the router ledger's finish records "
+                         "(narrows the torn-tail window to the submit "
+                         "side, which only ever re-decodes)")
     pv.add_argument("--no-affinity", action="store_true",
                     help="disable radix-prefix-affinity routing "
                          "(pure least-loaded)")
@@ -736,7 +786,25 @@ def main(argv=None) -> int:
                          "streams; requires --journal-dir")
     pv.add_argument("--restart-budget", type=int, default=3,
                     help="--multiproc: crash restarts per worker before "
-                         "quarantine (journal requeued onto survivors)")
+                         "quarantine (in-flight work requeued onto "
+                         "survivors from the router's ledger)")
+    pv.add_argument("--autoscale-max", type=int, default=0,
+                    help="--multiproc: enable the autoscaler with this "
+                         "many workers as the ceiling (0 = fixed "
+                         "fleet). --replicas is the STARTING size; "
+                         "sustained backlog spawns workers up to the "
+                         "ceiling, sustained lull drains them down to "
+                         "--autoscale-min through the rolling-restart "
+                         "drain path (zero dropped requests)")
+    pv.add_argument("--autoscale-min", type=int, default=1,
+                    help="--multiproc autoscaler floor")
+    pv.add_argument("--listen-host", default="127.0.0.1",
+                    help="--multiproc: interface the worker "
+                         "registration listener binds (default "
+                         "loopback — the zero-egress posture; "
+                         "0.0.0.0 accepts `serve-worker "
+                         "--router-addr` registrations from other "
+                         "hosts)")
     pv.add_argument("--step-timeout-s", type=float, default=10.0,
                     help="--multiproc: RPC budget for one worker step; "
                          "a hung (SIGSTOPped) worker costs the router "
@@ -770,19 +838,31 @@ def main(argv=None) -> int:
     pw.add_argument("--host", default="127.0.0.1")
     pw.add_argument("--port", type=int, default=0,
                     help="RPC port (0 = ephemeral; the bound port is "
-                         "published in --ready-file and the stderr "
-                         "banner)")
+                         "announced in the --router-addr register "
+                         "frame and the stderr banner)")
     pw.add_argument("--journal", default=None,
                     help="crash journal path (exclusively flock-ed; "
-                         "replayed at startup)")
-    pw.add_argument("--ready-file", default=None,
-                    help="atomically write {port, pid, gen, replayed} "
-                         "here once warmed + replayed (the supervisor's "
-                         "readiness handshake)")
+                         "replayed at startup; WORKER-LOCAL — the "
+                         "router reconciles over the journal_drain "
+                         "RPC, never this file)")
+    pw.add_argument("--router-addr", default=None,
+                    help="host:port of the fleet's registration "
+                         "listener: once warmed + replayed + bound, "
+                         "the worker announces itself there with one "
+                         "register frame (port/pid/gen/replayed + "
+                         "protocol version + engine shape hash) and "
+                         "becomes routable — the no-shared-filesystem "
+                         "handshake; run a worker on ANY host that "
+                         "can reach this address. A protocol/shape "
+                         "mismatch exits 3 (RpcProtocolError)")
+    pw.add_argument("--worker-idx", type=int, default=-1,
+                    help="supervisor-managed replica index (-1 = "
+                         "unmanaged: register as a brand-new replica "
+                         "and grow the fleet)")
     pw.add_argument("--gen", type=int, default=0,
-                    help="spawn generation (stamped into --ready-file "
-                         "so the supervisor never attaches a stale "
-                         "incarnation)")
+                    help="spawn generation (carried in the register "
+                         "frame so the supervisor never attaches a "
+                         "stale incarnation)")
     pw.add_argument("--no-fsync", action="store_true",
                     help="disable fsync-per-finish journal durability")
     add_engine_flags(pw)

@@ -38,6 +38,14 @@ Sites (consulted once per router step / per generated session):
   SIGCONT. From the router's side this is indistinguishable from a
   wedged device: RPC calls time out while the process stays "alive" —
   exactly what the wedge probe and hedged re-route must handle.
+- ``fleet/step`` with kind ``host_loss``: the WHOLE HOST vanishes —
+  SIGKILL worker ``int(arg)``'s process AND delete its working
+  directory, crash journal included (the spot-VM / TPU-maintenance
+  preemption scenario). Unlike ``proc_kill``, the restarted worker
+  replays NOTHING: recovery is the router's own request ledger —
+  every accepted-but-unfinished request requeues from the router side
+  and the delivery ledger keeps the streams exactly-once. The fault
+  nothing on the worker's filesystem can survive, by construction.
 """
 
 from __future__ import annotations
@@ -59,6 +67,9 @@ KIND_HOT_KEY_SKEW = "hot_key_skew"
 #: process-level chaos (multi-process fleet only; needs a supervisor)
 KIND_PROC_KILL = "proc_kill"
 KIND_PROC_HANG = "proc_hang"
+#: host-level chaos: SIGKILL + journal/workdir deletion — the worker's
+#: machine is gone, not just its process
+KIND_HOST_LOSS = "host_loss"
 
 
 def fleet_step_fault(step: int) -> Optional[Fault]:

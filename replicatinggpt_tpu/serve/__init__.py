@@ -26,14 +26,19 @@
   re-route off wedged replicas (docs/serving.md). Replicas are either
   in-process engines (``Replica``) or worker PROCESSES
   (``RemoteReplica`` over the ``rpc`` protocol);
-- ``rpc``: length-prefixed JSON RPC over loopback sockets — the wire
-  between the router and worker processes (submit/step/stream-drain/
-  cancel/drain/health verbs, ack-based finish redelivery);
+- ``rpc``: length-prefixed JSON RPC over sockets — the wire between
+  the router and worker processes (register/submit/step/stream-drain/
+  journal-drain/cancel/drain/health verbs, ack-based finish
+  redelivery, protocol-version + engine-shape-hash handshake with
+  typed ``RpcProtocolError`` rejection, and the poll-driven
+  ``RpcListener`` registration endpoint);
 - ``worker``: the worker process (`serve-worker` CLI) — one engine +
-  an exclusively-locked crash journal, replayed at startup so a
-  ``kill -9`` mid-decode costs nothing the journal + the router's
-  delivery ledger cannot reconstruct (faults/procsup.py supervises
-  restarts);
+  an exclusively-locked PRIVATE crash journal, replayed at startup
+  and streamed to the router over RPC, so a ``kill -9`` mid-decode
+  costs nothing the journal + the router's delivery ledger cannot
+  reconstruct — and a lost HOST (journal gone too) costs nothing the
+  router's own ledger cannot (faults/procsup.py supervises restarts
+  and autoscaling);
 - ``loadgen``: multi-turn session load generator + fleet replay driver
   (`bench.py --mode fleet`, the fleet chaos soak);
 - ``http``: the asyncio HTTP/SSE front door (`serve` CLI) —

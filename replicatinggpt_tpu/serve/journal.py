@@ -69,6 +69,19 @@ class RequestJournal:
         self.path = os.path.abspath(path)
         self.fsync_finish = fsync_finish
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # a previous incarnation may have died MID-RECORD (the torn
+        # tail the readers tolerate) — appending straight after it
+        # would merge this writer's first record into the torn
+        # fragment, corrupting a GOOD record. Terminate the fragment
+        # first: it becomes one complete invalid line the tolerant
+        # reader skips, and every new record stays intact.
+        needs_nl = False
+        try:
+            with open(self.path, "rb") as rf:
+                rf.seek(-1, os.SEEK_END)
+                needs_nl = rf.read(1) != b"\n"
+        except (OSError, ValueError):
+            pass                   # missing or empty file
         self._f: Optional[TextIO] = open(self.path, "a")
         if lock:
             import fcntl
@@ -81,6 +94,9 @@ class RequestJournal:
                 raise JournalBusyError(
                     f"journal {self.path} is locked by another live "
                     f"process") from e
+        if needs_nl:               # after the flock: only the ONE
+            self._f.write("\n")    # legitimate writer repairs the tail
+            self._f.flush()
 
     def _write(self, obj: dict, fsync: bool = False) -> None:
         assert self._f is not None, "journal is closed"
@@ -98,6 +114,10 @@ class RequestJournal:
             "rng_seed": int(req.rng_seed),
             "temperature": float(sp.temperature), "top_k": int(sp.top_k),
             "top_p": float(sp.top_p), "greedy": bool(sp.greedy),
+            # eos is part of the stop condition: a replay that decodes
+            # past it would NOT be token-identical to the original
+            **({"eos": int(req.eos_token_id)}
+               if req.eos_token_id is not None else {}),
         })
 
     def record_finish(self, request_id: str, reason: str) -> None:
@@ -136,7 +156,8 @@ class RequestJournal:
                         temperature=rec["temperature"],
                         top_k=rec["top_k"], top_p=rec["top_p"],
                         greedy=rec["greedy"]),
-                    rng_seed=rec["rng_seed"])
+                    rng_seed=rec["rng_seed"],
+                    eos_token_id=rec.get("eos"))
             elif rec.get("ev") == "finish":
                 submits.pop(rec["id"], None)
         # an id can appear in `order` twice (finished, then a fresh

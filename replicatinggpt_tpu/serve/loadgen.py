@@ -61,6 +61,14 @@ class SessionLoadConfig:
     think_time_s: float = 0.0      # finish -> next-turn gap
     greedy: bool = True
     seed: int = 0
+    #: the autoscaler acceptance trace: session arrivals run in three
+    #: phases — the first third at ``rate``, the middle third at
+    #: 2x ``rate`` (the load DOUBLES mid-run: sustained backlog, the
+    #: scale-up signal), the final third at ``rate``/2 (the load
+    #: HALVES: sustained lull, the scale-down signal). Same Poisson
+    #: draws, phase-scaled — seeded and deterministic like everything
+    #: else here.
+    load_step: bool = False
 
 
 @dataclass
@@ -172,8 +180,14 @@ def make_sessions(mcfg: ModelConfig, lcfg: SessionLoadConfig
                 for _ in range(lcfg.n_prefix_groups)]
     # all scalar randomness drawn vectorized up front (host numpy, but
     # keeps the per-session loop free of float()/asarray per GL004)
-    starts = np.cumsum(rng.exponential(1.0 / max(lcfg.rate, 1e-9),
-                                       lcfg.n_sessions))
+    gaps = rng.exponential(1.0 / max(lcfg.rate, 1e-9), lcfg.n_sessions)
+    if lcfg.load_step:
+        # base -> 2x -> 0.5x arrival rate by thirds: a gap at k times
+        # the rate is the base gap divided by k
+        third = max(lcfg.n_sessions // 3, 1)
+        gaps[third:2 * third] /= 2.0
+        gaps[2 * third:] *= 2.0
+    starts = np.cumsum(gaps)
     groups = rng.integers(0, lcfg.n_prefix_groups, lcfg.n_sessions)
     skew_draws = rng.random(lcfg.n_sessions)
     out: List[_Session] = []

@@ -18,15 +18,20 @@ promises when replicas misbehave:
   *wedged* — quarantined from new routes with its in-flight work
   re-routed (below).
 - **Requeue across death**: a killed replica's accepted-but-unfinished
-  requests are rebuilt from its crash journal
-  (``RequestJournal.unfinished`` over the shared torn-tail-tolerant
-  ``utils.jsonl`` reader) and resubmitted to survivors with bounded
-  retry + exponential backoff. Regeneration is deterministic (prompt +
-  sampling + per-request rng_seed), so greedy output is token-identical
-  to an uninterrupted run; the router's delivery ledger
-  (:meth:`Router.take_new_tokens`) dedupes the stream so a client sees
-  every token exactly once across a migration — no drops, no
-  duplicates.
+  requests are rebuilt and resubmitted to survivors with bounded retry
+  + exponential backoff. For an in-process replica the rebuild reads
+  its crash journal (``Replica.journal_state`` — same filesystem by
+  construction); for a worker PROCESS the router never opens a worker
+  path: the in-memory ledger (mirrored to the router's own crash
+  journal, ``RouterConfig.ledger_path``) is the source of truth, so a
+  worker HOST can vanish entirely — journal and all, the
+  spot-VM/TPU-preemption scenario (``host_loss`` chaos) — and every
+  accepted request still finishes. Regeneration is deterministic
+  (prompt + sampling + per-request rng_seed), so greedy output is
+  token-identical to an uninterrupted run; the router's delivery
+  ledger (:meth:`Router.take_new_tokens`) dedupes the stream so a
+  client sees every token exactly once across a migration — no drops,
+  no duplicates.
 - **Hedged re-route on wedge**: a wedged (but not dead) replica's
   in-flight requests are cancelled with ``migrated=True`` (the engine
   releases their slots/pages immediately and tags the telemetry
@@ -40,20 +45,36 @@ Two replica backends implement one interface (:class:`ReplicaBase`):
 - :class:`Replica` — the in-process engine of PR 8 (one interpreter,
   simulated faults);
 - :class:`RemoteReplica` — a **worker process** (serve/worker.py)
-  reached over the serve/rpc.py socket protocol. The router drives it
-  with the same verbs (submit/step/cancel), reads its committed-token
-  streams out of the step response (the stream-drain piggyback), and
-  treats transport failures honestly: an RPC *timeout* is a slow step
-  the wedge probe sees (SIGSTOP, wedged device), a *refused/reset
-  connection* marks the replica down for the process supervisor
-  (faults/procsup.py) to restart. A restarted worker replays its own
-  journal; :meth:`Router.attach_replica` then reconciles the router's
-  in-flight ledger against what the worker actually recovered —
+  reached over the serve/rpc.py socket protocol, on this machine or
+  any other (workers register over the network — faults/procsup.py's
+  ``RpcListener`` handshake; the router holds only a host:port). The
+  router drives it with the same verbs (submit/step/cancel), reads its
+  committed-token streams out of the step response (the stream-drain
+  piggyback), and treats transport failures honestly: an RPC *timeout*
+  is a slow step the wedge probe sees (SIGSTOP, wedged device), a
+  *refused/reset connection* marks the replica down for the process
+  supervisor to restart. A restarted worker replays its own journal;
+  :meth:`Router.attach_replica` then reconciles the router's in-flight
+  ledger against what the worker actually recovered — the worker's
+  journal state arrives through the ``journal_drain`` RPC in bounded
+  frames (the journal file never leaves the worker's machine):
   surviving requests continue (the delivery ledger suppresses the
   regenerated prefix, so streams stay exactly-once through a real
   ``kill -9``), journaled-finished-but-undelivered ones surface their
   journaled reason, and ghost entries the worker replayed but nobody
   owns are cancelled before they waste a decode.
+
+**The router's own crash journal** (``RouterConfig.ledger_path``)
+mirrors the in-memory request ledger to disk: one submit record at
+fleet acceptance, one finish record at each terminal result — the same
+torn-tail-tolerant ``RequestJournal`` format the workers use. A
+restarted router rebuilds its accepted-but-unfinished set from it and
+requeues (a finish record torn mid-write replays as unfinished — the
+request re-decodes and re-delivers rather than dropping, pinned in
+tests/test_fleet_elastic.py). With workers journaling locally AND the
+router journaling its own view, no component ever reads another
+component's disk — the fleet has no shared-filesystem assumption left
+(graftlint GL016 guards the router side against regressions).
 
 Rolling restarts ride the same machinery: :meth:`Router.drain_replica`
 marks a replica draining (unroutable, ``/readyz`` excluded), migrates
@@ -73,9 +94,9 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..faults.fleet import (KIND_PROC_HANG, KIND_PROC_KILL,
-                            KIND_REPLICA_KILL, KIND_REPLICA_WEDGE,
-                            fleet_step_fault)
+from ..faults.fleet import (KIND_HOST_LOSS, KIND_PROC_HANG,
+                            KIND_PROC_KILL, KIND_REPLICA_KILL,
+                            KIND_REPLICA_WEDGE, fleet_step_fault)
 from ..utils.jsonl import load_jsonl_if_exists
 from ..utils.logging import Metrics
 from ..utils.telemetry import (ENGINE_TRACK, NULL, REPLICA_TRACK_STRIDE,
@@ -85,7 +106,8 @@ from .requests import (FINISH_CANCELLED, FINISH_DEADLINE,
                        REJECT_BAD_REQUEST, REJECT_PROMPT_TOO_LONG,
                        REJECT_QUEUE_FULL, Request, RequestResult)
 from .rpc import (REJECT_REPLICA_DOWN, RpcClient, RpcDown, RpcError,
-                  RpcTimeout, request_to_wire, result_from_wire)
+                  RpcTimeout, request_from_wire, request_to_wire,
+                  result_from_wire)
 
 #: finish_reason when bounded retry exhausts without a replica
 #: accepting the requeued request
@@ -123,12 +145,22 @@ class RouterConfig:
     """Fleet sizing + routing/recovery knobs (docs/serving.md)."""
 
     n_replicas: int = 2
-    #: per-replica crash journals live here (replica{i}.jsonl); None
-    #: disables journals — and with them cross-replica requeue. In
-    #: multi-process mode this is the SHARED journal directory: each
-    #: worker writes worker{i}.jsonl (exclusively locked), the router
-    #: reads them for requeue/reconciliation.
+    #: IN-PROCESS mode: per-replica crash journals live here
+    #: (replica{i}.jsonl); None disables journals — and with them
+    #: cross-replica requeue. Worker PROCESSES own their journals
+    #: privately (per-worker dirs, any machine) — the router never
+    #: reads them; reconciliation rides the journal_drain RPC and the
+    #: router's own ledger below.
     journal_dir: Optional[str] = None
+    #: the ROUTER's own crash journal: submits at fleet acceptance,
+    #: finishes at terminal results. A restarted router requeues its
+    #: accepted-but-unfinished set from here — the recovery path that
+    #: needs no worker filesystem at all (host_loss survivability).
+    #: None disables router-side persistence (in-memory ledger only).
+    ledger_path: Optional[str] = None
+    #: fsync the ledger's finish records (the torn-tail window narrows
+    #: to the submit side, which only ever re-decodes, never drops)
+    ledger_fsync: bool = False
     #: route by longest cached prefix (False: pure least-loaded)
     affinity: bool = True
     #: requeue/submit retry ladder: a rejected resubmission retries up
@@ -250,6 +282,16 @@ class ReplicaBase:
         """(prefix_hit_tokens, prompt_tokens) for the fleet aggregate."""
         raise NotImplementedError
 
+    def journal_state(self, telemetry=None
+                      ) -> Tuple[Dict[str, str], List[Request]]:
+        """``(finished_reasons, unfinished_requests)`` from this
+        replica's crash journal — the reconciliation inputs. The
+        BACKEND owns how the journal is reached: the in-process
+        replica reads its local file (same filesystem by
+        construction), the remote replica pages the ``journal_drain``
+        RPC. Router code never opens a replica path (GL016)."""
+        return {}, []
+
     def health(self) -> dict:
         raise NotImplementedError
 
@@ -309,6 +351,20 @@ class Replica(ReplicaBase):
         a = self.engine.pool.alloc
         return a.prefix_hit_tokens, a.prompt_tokens
 
+    def journal_state(self, telemetry=None
+                      ) -> Tuple[Dict[str, str], List[Request]]:
+        """Local-mode backend: the journal is this process's own file
+        (is_local — the one place the fleet may touch a replica path
+        directly)."""
+        if self.journal_path is None:
+            return {}, []
+        finished = {r["id"]: r.get("reason", "")
+                    for r in load_jsonl_if_exists(self.journal_path)
+                    if r.get("ev") == "finish"}
+        pending = RequestJournal.unfinished(self.journal_path,
+                                            telemetry=telemetry)
+        return finished, pending
+
     def health(self) -> dict:
         """The per-replica health probe: router-side state + the
         engine's own telemetry counters/gauges (PR-7 Metrics)."""
@@ -349,10 +405,13 @@ class RemoteReplica(ReplicaBase):
 
     is_local = False
 
-    def __init__(self, idx: int, journal_path: Optional[str],
+    def __init__(self, idx: int, journal_path: Optional[str] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  rpc_timeout_s: float = 10.0,
                  step_timeout_s: float = 10.0):
+        # journal_path is NOT read by the router for remote replicas
+        # (the worker's disk may be on another machine) — it is kept
+        # only as operator-facing metadata in health blocks
         super().__init__(idx, journal_path)
         self.host = host
         self.client: Optional[RpcClient] = None
@@ -376,9 +435,15 @@ class RemoteReplica(ReplicaBase):
     # ------------------------------------------------------- connection
 
     def connect(self, port: int, pid: Optional[int] = None,
-                gen: Optional[int] = None) -> None:
+                gen: Optional[int] = None,
+                host: Optional[str] = None) -> None:
         if self.client is not None:
             self.client.close()
+        if host:
+            # the registration handshake told us which HOST the worker
+            # lives on (its connection's peer address) — a respawned
+            # worker may come back on a different machine entirely
+            self.host = host
         self.client = RpcClient(self.host, port,
                                 timeout_s=self.rpc_timeout_s)
         if pid is not None:
@@ -463,6 +528,44 @@ class RemoteReplica(ReplicaBase):
         resp = self._call("stream_drain")
         self._partials.update({rid: list(toks) for rid, toks
                                in resp.get("partials", {}).items()})
+
+    def journal_state(self, telemetry=None,
+                      kinds: Tuple[str, ...] = ("finished",)
+                      ) -> Tuple[Dict[str, str], List[Request]]:
+        """Page the worker's LOCAL journal state through the
+        ``journal_drain`` RPC (bounded frames): the file stays on the
+        worker's machine, its content crosses the wire. An unreachable
+        worker yields an empty view — the caller falls back to the
+        router's own ledger (which is precisely the host-loss path).
+        ``kinds`` defaults to finish records only: attach
+        reconciliation gets the unfinished set from the worker's
+        ``in_flight`` (health RPC), so shipping block_size-scale
+        prompts it would discard is pure waste — pass
+        ``("finished", "unfinished")`` to rebuild from nothing."""
+        finished: Dict[str, str] = {}
+        unfinished: List[Request] = []
+        cursor = 0
+        while True:
+            try:
+                resp = self._call("journal_drain", cursor=cursor,
+                                  kinds=list(kinds))
+            except (ReplicaDownError, RpcTimeout, RpcError):
+                break
+            for rec in resp.get("records", []):
+                if rec.get("kind") == "finished":
+                    finished[rec["id"]] = rec.get("reason", "")
+                elif rec.get("kind") == "unfinished":
+                    unfinished.append(request_from_wire(
+                        rec["req"], time.monotonic()))
+            nxt = int(resp.get("cursor", cursor))
+            if resp.get("eof", True) or nxt <= cursor:
+                break
+            cursor = nxt
+        if telemetry is not None and telemetry.enabled:
+            telemetry.instant("journal_drain", ROUTER_TRACK,
+                              replica=self.idx, finished=len(finished),
+                              unfinished=len(unfinished))
+        return finished, unfinished
 
     def _absorb(self, resp: dict) -> None:
         for k in self._gauges:
@@ -639,6 +742,30 @@ class Router:
         self._router_finished: List[RequestResult] = []
         self.results: Dict[str, RequestResult] = {}
         self.events: List[str] = []
+        #: the router's own crash journal (ledger_path): the recovery
+        #: source that needs no worker filesystem. Recover FIRST (read
+        #: the previous incarnation's tail), then open for append —
+        #: lock=True so two routers can never interleave one ledger.
+        self.ledger: Optional[RequestJournal] = None
+        if rcfg.ledger_path is not None:
+            recovered = RequestJournal.unfinished(rcfg.ledger_path,
+                                                  telemetry=self.tel)
+            self.ledger = RequestJournal(rcfg.ledger_path,
+                                         fsync_finish=rcfg.ledger_fsync,
+                                         lock=True)
+            now = self.clock()
+            for req in recovered:
+                # deadlines died with the previous router's clock; the
+                # request re-decodes deadline-free (docs/robustness.md)
+                self._requeue.append(_Requeue(
+                    req=req, t_submit=now, attempts=0, due_step=0,
+                    t_requeued=now))
+            if recovered:
+                self.metrics.inc("fleet_ledger_recovered",
+                                 len(recovered))
+                self._event(f"ledger recovery: {len(recovered)} "
+                            f"unfinished request(s) requeued from "
+                            f"{rcfg.ledger_path}")
         self._gauges()     # /metrics carries per-replica gauges from step 0
 
     # ---------------------------------------------------------------- API
@@ -655,7 +782,13 @@ class Router:
             self.metrics.inc("fleet_dedup_rejects")
             return RequestResult(id=req.id, tokens=[],
                                  finish_reason=REJECT_BAD_REQUEST)
-        return self._submit_routed(req, self.clock(), attempts=0)
+        rej = self._submit_routed(req, self.clock(), attempts=0)
+        if rej is None and self.ledger is not None:
+            # one submit record per id at FLEET acceptance (requeue
+            # resubmits never re-record): the router-side half of the
+            # every-accepted-request-finishes promise
+            self.ledger.record_submit(req)
+        return rej
 
     def cancel(self, request_id: str) -> bool:
         fi = self._inflight.get(request_id)
@@ -713,6 +846,12 @@ class Router:
                                                int(flt.arg))
                 else:
                     self._event(f"step {step_idx}: proc_hang ignored "
+                                f"(no supervisor attached)")
+            elif flt.kind == KIND_HOST_LOSS:
+                if self.supervisor is not None:
+                    self.supervisor.chaos_host_loss(int(flt.arg))
+                else:
+                    self._event(f"step {step_idx}: host_loss ignored "
                                 f"(no supervisor attached)")
 
         out: List[RequestResult] = []
@@ -815,6 +954,9 @@ class Router:
     def close(self) -> None:
         for rep in self.replicas:
             rep.close()
+        if self.ledger is not None:
+            self.ledger.close()
+            self.ledger = None
 
     # ------------------------------------------------------- supervision
 
@@ -834,12 +976,50 @@ class Router:
                     + (f" ({reason})" if reason else ""))
         self.tel.instant("worker_down", ROUTER_TRACK, replica=idx)
 
+    def add_replica(self, rep: ReplicaBase) -> int:
+        """Grow the fleet at runtime (autoscale scale-up, or an
+        unmanaged worker registering from another host): append the
+        backend and return its index. The replica joins NOT-alive —
+        :meth:`attach_replica` flips it routable once its registration
+        handshake completes, so a half-started worker is never
+        routed."""
+        assert rep.idx == len(self.replicas), (
+            f"replica indices are append-only: got {rep.idx}, "
+            f"expected {len(self.replicas)}")
+        rep.alive = False
+        rep.skip_steps = self.rcfg.wedge_skip_steps
+        self.replicas.append(rep)
+        if self.tel.enabled and not rep.is_local:
+            self.tel.name_track(self._worker_track(rep.idx),
+                                f"worker{rep.idx}")
+        self.metrics.inc("fleet_replicas_added")
+        self._event(f"step {self.n_steps}: replica {rep.idx} added "
+                    f"(fleet grows to {len(self.replicas)})")
+        return rep.idx
+
+    def offered_load(self) -> dict:
+        """The autoscaler's input signal, from gauges the router
+        already tracks: queued work (admission queues of routable
+        replicas + the between-replicas requeue), active decode slots,
+        and how many replicas can take traffic. Exported so the
+        supervisor never reaches into replica internals."""
+        routable = [r for r in self.replicas if r.routable]
+        return {
+            "queued": (sum(r.queue_depth for r in routable)
+                       + len(self._requeue)),
+            "active": sum(r.slots_active for r in routable),
+            "n_routable": len(routable),
+        }
+
     def attach_replica(self, idx: int, port: int,
                        pid: Optional[int] = None,
-                       gen: Optional[int] = None) -> dict:
+                       gen: Optional[int] = None,
+                       host: Optional[str] = None) -> dict:
         """(Re)connect a remote replica and reconcile the router's
         in-flight ledger against what the restarted worker actually
-        recovered from its journal:
+        recovered from its journal (shipped over the ``journal_drain``
+        RPC — the worker's filesystem is never touched from here, so
+        the worker can live on any machine):
 
         - ids the worker replayed keep their ledger entries — the
           worker regenerates them from token 0 and the delivery ledger
@@ -847,26 +1027,22 @@ class Router:
           ``kill -9``);
         - ids the journal says *finished* (the result died undelivered
           with the process) surface their journaled reason;
-        - ids the worker lost entirely (torn submit record) requeue
-          onto the fleet;
+        - ids the worker lost entirely (torn submit record, or a
+          vanished HOST whose fresh replacement has an empty journal)
+          requeue onto the fleet from the router's own ledger;
         - ids the worker replayed that the router does NOT own (stale
           journal ghosts, previously-migrated work) are cancelled
           before they waste a decode.
         """
         rep = self.replicas[idx]
         assert isinstance(rep, RemoteReplica), "attach is remote-only"
-        rep.connect(port, pid=pid, gen=gen)
+        rep.connect(port, pid=pid, gen=gen, host=host)
         h = rep.refresh_health()
         rep.stream_drain()
         worker_ids = set(h.get("in_flight", []))
         mine = [rid for rid, fi in self._inflight.items()
                 if fi.replica == idx]
-        finished_reasons: Dict[str, str] = {}
-        if rep.journal_path is not None:
-            finished_reasons = {
-                r["id"]: r.get("reason", "")
-                for r in load_jsonl_if_exists(rep.journal_path)
-                if r.get("ev") == "finish"}
+        finished_reasons, _ = rep.journal_state(telemetry=self.tel)
         kept = lost = 0
         now = self.clock()
         for rid in mine:
@@ -1148,6 +1324,8 @@ class Router:
                         reason=res.finish_reason,
                         n_tokens=len(res.tokens))
         self.metrics.inc("fleet_requests_finished")
+        if self.ledger is not None:
+            self.ledger.record_finish(res.id, res.finish_reason)
         self.results[res.id] = res
         return res
 
@@ -1178,6 +1356,8 @@ class Router:
                          request=res.id, reason=res.finish_reason,
                          n_tokens=len(res.tokens))
         self.metrics.inc("fleet_requests_finished")
+        if self.ledger is not None:
+            self.ledger.record_finish(res.id, res.finish_reason)
         self.results[res.id] = res
         self._router_finished.append(res)
 
@@ -1298,13 +1478,19 @@ class Router:
         rep.close()
         pending: List[Request] = []
         finished_reasons: Dict[str, str] = {}
-        if rep.journal_path is not None:
-            pending = RequestJournal.unfinished(rep.journal_path,
-                                                telemetry=self.tel)
-            finished_reasons = {
-                r["id"]: r.get("reason", "")
-                for r in load_jsonl_if_exists(rep.journal_path)
-                if r.get("ev") == "finish"}
+        if rep.is_local:
+            finished_reasons, pending = rep.journal_state(
+                telemetry=self.tel)
+        else:
+            # a dead worker PROCESS — possibly a vanished HOST, journal
+            # and all (host_loss chaos, spot-VM preemption): the
+            # router's OWN ledger is the source of truth. Every
+            # in-flight id on this replica requeues and re-decodes;
+            # the delivery ledger suppresses the already-streamed
+            # prefix, so a finish that died unacked re-delivers in
+            # full instead of surfacing a tokenless journaled reason.
+            pending = [fi.req for rid, fi in self._inflight.items()
+                       if fi.replica == idx]
         # the router's in-memory ledger is authoritative for THIS run:
         # only replay journal entries for ids the router has in flight
         # ON THE DEAD REPLICA. Anything else is a ghost — a stale
@@ -1417,3 +1603,6 @@ class Router:
                                rep.pages_in_use if rep.alive else 0)
         self.metrics.gauge("fleet_requeue_depth", len(self._requeue))
         self.metrics.gauge("fleet_inflight", len(self._inflight))
+        self.metrics.gauge("fleet_replicas", len(self.replicas))
+        self.metrics.gauge("fleet_replicas_routable",
+                           sum(r.routable for r in self.replicas))

@@ -16,6 +16,17 @@ image adds no dependency for its own fleet.
 
 Verbs (dispatched in serve/worker.py):
 
+- ``register`` — sent BY the worker TO the router's registration
+  listener (:class:`RpcListener`, owned by faults/procsup.py) right
+  after it binds its serving socket: ``{port, pid, gen, replayed,
+  worker_idx, proto, shape_hash}``. This replaces PR 9's ready files —
+  the handshake crosses the network, not a shared filesystem, so a
+  worker is placeable on any host that can reach ``--router-addr``.
+  ``proto`` (:data:`PROTO_VERSION`) and ``shape_hash``
+  (:func:`engine_shape_hash`) are checked at registration: a
+  mismatched worker build is rejected with a typed
+  :class:`RpcProtocolError` *before* it takes traffic, instead of
+  failing mid-stream on a codec or engine-shape drift;
 - ``submit``   — route one request into the worker's engine;
 - ``step``     — run ONE engine scheduling iteration; the response
   carries every not-yet-acknowledged finished result (redelivered
@@ -32,6 +43,13 @@ Verbs (dispatched in serve/worker.py):
   in-flight request ``migrated`` — the rolling-restart drain;
 - ``health``   — liveness/readiness probe: pid, warmed, idle, queue
   depth, slots, pages, prefix-hit counters, in-flight ids;
+- ``journal_drain`` — stream the worker's LOCAL crash-journal state to
+  the router in bounded frames (``cursor``/``limit`` paging, ``eof``
+  flag): condensed finish records ``{id, reason}`` plus the
+  still-unfinished requests as wire docs. This is how
+  ``Router.attach_replica`` reconciles across machines — the journal
+  never leaves the worker's filesystem; its *content* rides the RPC
+  channel;
 - ``summary``  — the engine ``metrics_summary()`` block the fleet
   summary aggregates;
 - ``shutdown`` — close the journal and exit 0 (the graceful half of a
@@ -61,6 +79,18 @@ from .requests import Request, RequestResult, SamplingParams
 #: gigabytes); generous for block_size-scale prompt lists
 MAX_FRAME = 16 << 20
 
+#: wire protocol version, carried in every ``register`` handshake: the
+#: router rejects a worker speaking a different framing/codec dialect
+#: at registration time (RpcProtocolError) instead of corrupting a
+#: stream mid-traffic. Bump on any incompatible change to the frame
+#: layout or the request/result wire codecs.
+PROTO_VERSION = 1
+
+#: journal_drain paging bound: records per frame (a frame of 256
+#: condensed records stays far under MAX_FRAME at block_size-scale
+#: prompts)
+JOURNAL_DRAIN_LIMIT = 256
+
 
 class RpcError(Exception):
     """The worker answered with ok=false (an application error)."""
@@ -73,6 +103,35 @@ class RpcTimeout(RpcError):
 
 class RpcDown(RpcError):
     """Connection refused/reset/closed — the worker process is gone."""
+
+
+class RpcProtocolError(RpcError):
+    """Registration handshake rejected: protocol version or engine
+    shape hash mismatch. The worker build cannot safely join this
+    fleet — it must exit (and be rebuilt), not retry."""
+
+
+def engine_shape_hash(mcfg, ecfg) -> str:
+    """Fingerprint of everything that must agree between the router's
+    expectation and a worker's engine for the fleet to be coherent:
+    the full model architecture plus the engine-shape knobs that size
+    the pool/pages/window. Two builds with the same hash produce
+    token-identical streams for the same request; a worker whose hash
+    differs is a DIFFERENT model or engine and is rejected at
+    registration (docs/serving.md#deployment)."""
+    import dataclasses
+    import hashlib
+    doc = {
+        "proto": PROTO_VERSION,
+        "model": {k: str(v) for k, v in
+                  sorted(dataclasses.asdict(mcfg).items())},
+        "engine": {k: str(getattr(ecfg, k)) for k in
+                   ("pool_size", "max_queue", "prefill_chunk",
+                    "page_size", "max_pages", "n_pages", "prefix_cache",
+                    "decode_window", "mesh_data", "mesh_model")},
+    }
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()[:16]
 
 
 # --------------------------------------------------------------- framing
@@ -222,6 +281,9 @@ class RpcClient:
             self.close()
             raise RpcDown(f"{op}: undecodable response: {e}") from e
         if not doc.get("ok"):
+            if doc.get("kind") == "protocol":
+                raise RpcProtocolError(
+                    doc.get("error", "protocol mismatch"))
             raise RpcError(doc.get("error", "unknown worker error"))
         return doc
 
@@ -254,6 +316,10 @@ async def serve_connection(reader, writer, dispatch) -> None:
                 # death
                 resp = {"ok": False,
                         "error": f"{type(e).__name__}: {e}"}
+                if isinstance(e, RpcProtocolError):
+                    # typed on the wire so the far client re-raises
+                    # RpcProtocolError (terminal) rather than RpcError
+                    resp["kind"] = "protocol"
             try:
                 writer.write(encode_frame(resp))
                 await writer.drain()
@@ -264,6 +330,99 @@ async def serve_connection(reader, writer, dispatch) -> None:
             writer.close()
         except (ConnectionError, OSError):
             pass
+
+
+# ------------------------------------------------------ poll listener
+
+class RpcListener:
+    """Poll-driven frame endpoint for the fleet's registration channel.
+
+    The supervisor's control loop is single-threaded by design (ticked
+    from the router's driver), so the registration endpoint cannot be
+    a blocking server: this listener accepts whatever connections are
+    pending, reads ONE frame from each, answers with the handler's
+    response, and returns — all inside one :meth:`poll` call. A worker
+    sends its ``register`` frame immediately after connecting and
+    blocks on the response, so a short per-connection read budget
+    suffices; anything slower is dropped and the worker retries.
+
+    The handler receives ``(doc, peer_host)`` — the peer address is
+    how the router learns which HOST a remote worker lives on (the
+    worker only knows its bound port; the network knows the rest).
+    A handler raising :class:`RpcProtocolError` answers with
+    ``kind="protocol"`` so the worker's client raises the typed error
+    and exits instead of retrying."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 read_timeout_s: float = 2.0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self._sock.setblocking(False)
+        self.read_timeout_s = read_timeout_s
+
+    @property
+    def addr(self) -> str:
+        h, p = self._sock.getsockname()
+        return f"{h}:{p}"
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise RpcDown("connection closed mid-frame")
+            buf += chunk
+        return buf
+
+    def poll(self, handler) -> int:
+        """Serve every pending connection one request/response frame;
+        returns how many frames were handled. Never blocks longer than
+        ``read_timeout_s`` per ready connection; transport failures
+        drop that connection only."""
+        handled = 0
+        while True:
+            try:
+                conn, peer = self._sock.accept()
+            except (BlockingIOError, InterruptedError):
+                return handled
+            except OSError:
+                return handled
+            try:
+                conn.settimeout(self.read_timeout_s)
+                n = decode_length(self._recv_exact(conn, 4))
+                doc = json.loads(self._recv_exact(conn, n))
+                try:
+                    resp = {"ok": True, **(handler(doc, peer[0]) or {})}
+                except RpcProtocolError as e:
+                    resp = {"ok": False, "kind": "protocol",
+                            "error": str(e)}
+                except Exception as e:  # noqa: BLE001 — same boundary
+                    # as serve_connection: a handler failure must frame
+                    # an error, not drop the worker's handshake socket
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                conn.sendall(encode_frame(resp))
+            except (OSError, ValueError, RpcError):
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            handled += 1
 
 
 #: a submit refused because the worker is unreachable or draining —

@@ -1,14 +1,21 @@
 """Worker process: one Engine behind the serve/rpc.py socket protocol.
 
 ``python -m replicatinggpt_tpu serve-worker`` is the unit a real
-deployment schedules: it owns one engine (its own params, KV pool,
-compile caches — a whole interpreter whose death takes nothing else
-with it), an exclusively-locked crash journal on shared storage, and a
-loopback RPC socket the router drives. The router process
-(serve/router.py, :class:`~.router.RemoteReplica`) holds the in-flight
-ledger; the supervisor (faults/procsup.py) holds the restart policy;
-this process holds the only thing that is actually expensive — the
+deployment schedules — on THIS machine or any other that can reach
+the router: it owns one engine (its own params, KV pool, compile
+caches — a whole interpreter whose death takes nothing else with it),
+an exclusively-locked crash journal on its own PRIVATE disk, and an
+RPC socket the router drives. Nothing here assumes a filesystem
+shared with the router: the worker announces itself over the network
+(``register``), and its journal's content crosses the wire
+(``journal_drain``). The router process (serve/router.py,
+:class:`~.router.RemoteReplica`) holds the in-flight ledger (mirrored
+to the router's OWN crash journal); the supervisor
+(faults/procsup.py) holds the restart + autoscale policy; this
+process holds the only thing that is actually expensive — the
 compiled model — and the journal that makes losing it survivable.
+Losing the journal TOO (host loss) is survivable one level up, from
+the router's ledger.
 
 Startup sequence (the order is the crash-recovery contract):
 
@@ -17,7 +24,9 @@ Startup sequence (the order is the crash-recovery contract):
    no compile";
 2. open the journal with ``lock=True`` (flock: a not-quite-dead
    previous incarnation still holding it fails THIS process loudly
-   rather than interleaving two writers) and ``fsync_finish`` on;
+   rather than interleaving two writers) and ``fsync_finish`` on. The
+   journal is **worker-local** storage: the router never opens it —
+   its content crosses the network through the ``journal_drain`` RPC;
 3. **replay** the journal: every accepted-but-unfinished request from
    the previous incarnation is resubmitted into the fresh engine — it
    regenerates deterministically from token 0, and the router's
@@ -25,9 +34,17 @@ Startup sequence (the order is the crash-recovery contract):
    (exactly-once across ``kill -9``, pinned in
    tests/test_fleet_multiproc.py). Requests the admission queue cannot
    hold yet stay in a pending list retried before every step;
-4. bind the RPC server (port 0 = ephemeral) and atomically write the
-   **ready file** (`{"port", "pid", "gen", "replayed"}`) the
-   supervisor polls — only now is the worker routable.
+4. bind the RPC server (port 0 = ephemeral) and **register** with the
+   fleet over the network: one ``register`` frame to ``--router-addr``
+   carrying ``{port, pid, gen, replayed, worker_idx, proto,
+   shape_hash}`` (serve/rpc.py). The supervisor's
+   :class:`~..serve.rpc.RpcListener` answers and attaches the router —
+   only now is the worker routable. No ready files, no shared
+   filesystem: this is the handshake that makes the worker placeable
+   on any host that can reach the router. A protocol-version or
+   engine-shape mismatch is rejected HERE with a typed
+   :class:`~..serve.rpc.RpcProtocolError` (exit code 3), never
+   mid-traffic.
 
 The worker never steps itself: the router's ``step`` RPC is the one
 driver, so fleet scheduling stays single-threaded and deterministic
@@ -50,7 +67,9 @@ from typing import Dict, List, Optional
 from .engine import Engine
 from .journal import RequestJournal
 from .requests import FINISH_CANCELLED, Request, RequestResult
-from .rpc import (REJECT_REPLICA_DOWN, request_from_wire,
+from .rpc import (JOURNAL_DRAIN_LIMIT, PROTO_VERSION,
+                  REJECT_REPLICA_DOWN, RpcProtocolError, decode_length,
+                  encode_frame, request_from_wire, request_to_wire,
                   result_to_wire, serve_connection)
 
 
@@ -74,6 +93,9 @@ class WorkerServer:
         #: journal-replayed requests the admission queue could not hold
         #: yet (retried before every step)
         self._replay_pending: List[Request] = []
+        #: journal_drain paging snapshot (one disk read per drain
+        #: session; reset at eof / a fresh cursor-0 call)
+        self._drain_snapshot: Optional[List[dict]] = None
         self.n_replayed = 0
 
     # ------------------------------------------------------------ replay
@@ -199,6 +221,59 @@ class WorkerServer:
         from .engine import engine_summary_block
         return {"block": engine_summary_block(self.engine)}
 
+    def _journal_view(self) -> List[dict]:
+        """Condensed journal state for ``journal_drain``: the last
+        finish reason per id (in journal order), then the
+        still-unfinished requests as wire docs. Computed fresh per
+        drain — the file is worker-local and the reader is the shared
+        torn-tail-tolerant one, so a drain racing an append sees a
+        consistent prefix."""
+        if self.journal is None:
+            return []
+        from ..utils.jsonl import load_jsonl_if_exists
+        reasons: Dict[str, str] = {}
+        for rec in load_jsonl_if_exists(self.journal.path):
+            if rec.get("ev") == "finish":
+                reasons[rec["id"]] = rec.get("reason", "")
+        now = self.clock()
+        return ([{"kind": "finished", "id": rid, "reason": reason}
+                 for rid, reason in reasons.items()]
+                + [{"kind": "unfinished",
+                    "req": request_to_wire(req, now)}
+                   for req in RequestJournal.unfinished(
+                       self.journal.path)])
+
+    def op_journal_drain(self, doc: dict) -> dict:
+        """Stream the local journal's condensed state in bounded
+        frames: the router pages with ``cursor`` until ``eof``. This
+        replaces the shared-filesystem journal read PR 9's
+        ``attach_replica`` did — reconciliation state crosses the RPC
+        channel, so the worker's disk can live on another machine.
+
+        The view is SNAPSHOTTED at ``cursor == 0`` and later frames
+        page over that snapshot: one disk read per drain session (not
+        per frame — a long journal would make reconcile O(R^2)), and
+        a record appended mid-drain can never shift the paging under
+        the reader. ``kinds`` filters the snapshot (the router's
+        attach only needs the finish records; the unfinished half
+        exists for a router rebuilding from nothing)."""
+        cursor = max(int(doc.get("cursor", 0)), 0)
+        limit = max(1, min(int(doc.get("limit", JOURNAL_DRAIN_LIMIT)),
+                           JOURNAL_DRAIN_LIMIT))
+        kinds = doc.get("kinds")
+        if cursor == 0 or self._drain_snapshot is None:
+            records = self._journal_view()
+            if kinds:
+                records = [r for r in records if r["kind"] in kinds]
+            self._drain_snapshot = records
+        records = self._drain_snapshot
+        frame = records[cursor:cursor + limit]
+        eof = cursor + len(frame) >= len(records)
+        if eof:
+            self._drain_snapshot = None
+        return {"records": frame, "cursor": cursor + len(frame),
+                "eof": eof}
+
     def op_drain(self, doc: dict) -> dict:
         """Rolling-restart drain: refuse new submits, cancel everything
         in flight as migrated (the journal records the finishes, so the
@@ -218,15 +293,43 @@ class WorkerServer:
         return {"stopping": True}
 
 
-def _write_ready_file(path: str, doc: dict) -> None:
-    """Atomic (tmp + rename): the supervisor polling this file must
-    never read a torn JSON."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+async def _register_with_router(router_addr: str, doc: dict,
+                                budget_s: float = 120.0) -> dict:
+    """Announce this worker to the fleet: one ``register`` frame to the
+    supervisor's RpcListener, retried with backoff until the listener
+    answers (it polls from the router's single-threaded loop, so the
+    response may lag a tick). Transport failures retry; an ok=false
+    with ``kind="protocol"`` raises :class:`RpcProtocolError` — a
+    version/shape-mismatched build must exit, not retry."""
+    host, _, port = router_addr.rpartition(":")
+    deadline = time.monotonic() + budget_s
+    last = "no attempt"
+    while time.monotonic() < deadline:
+        writer = None
+        try:
+            reader, writer = await asyncio.open_connection(
+                host or "127.0.0.1", int(port))
+            writer.write(encode_frame({"op": "register", **doc}))
+            await writer.drain()
+            header = await asyncio.wait_for(reader.readexactly(4), 15.0)
+            body = await asyncio.wait_for(
+                reader.readexactly(decode_length(header)), 15.0)
+            resp = json.loads(body)
+            if resp.get("ok"):
+                return resp
+            if resp.get("kind") == "protocol":
+                raise RpcProtocolError(
+                    resp.get("error", "protocol mismatch"))
+            last = resp.get("error", "rejected")
+        except (OSError, ValueError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError, ConnectionError) as e:
+            last = f"{type(e).__name__}: {e}"
+        finally:
+            if writer is not None:
+                writer.close()
+        await asyncio.sleep(0.2)
+    raise RuntimeError(
+        f"registration with {router_addr} failed: {last}")
 
 
 def warm_engine(engine: Engine) -> None:
@@ -249,7 +352,8 @@ def warm_engine(engine: Engine) -> None:
 
 
 async def _run_async(worker: WorkerServer, host: str, port: int,
-                     ready_file: Optional[str], gen: int) -> int:
+                     router_addr: Optional[str], gen: int,
+                     worker_idx: int, shape_hash: str) -> int:
     server = await asyncio.start_server(
         lambda r, w: serve_connection(r, w, worker.dispatch),
         host, port)
@@ -261,12 +365,26 @@ async def _run_async(worker: WorkerServer, host: str, port: int,
         except NotImplementedError:   # non-Unix event loops
             pass
     print(f"worker listening on {bound[0]}:{bound[1]} "
-          f"pid={os.getpid()} gen={gen} "
-          f"replayed={worker.n_replayed}", file=sys.stderr)
-    if ready_file:
-        _write_ready_file(ready_file, {
-            "port": bound[1], "pid": os.getpid(), "gen": gen,
-            "replayed": worker.n_replayed})
+          f"pid={os.getpid()} gen={gen} idx={worker_idx} "
+          f"shape={shape_hash} replayed={worker.n_replayed}",
+          file=sys.stderr)
+    if router_addr:
+        # the server is ALREADY live: the supervisor's attach
+        # (health/stream_drain/journal_drain RPCs) is served by this
+        # same loop while the register coroutine awaits its response
+        try:
+            await _register_with_router(router_addr, {
+                "port": bound[1], "pid": os.getpid(), "gen": gen,
+                "worker_idx": worker_idx,
+                "replayed": worker.n_replayed,
+                "proto": PROTO_VERSION, "shape_hash": shape_hash})
+        except RpcProtocolError as e:
+            print(f"registration REJECTED (protocol/shape mismatch): "
+                  f"{e}", file=sys.stderr)
+            server.close()
+            await server.wait_closed()
+            return 3
+        print(f"registered with {router_addr}", file=sys.stderr)
     await worker.stop_event.wait()
     server.close()
     await server.wait_closed()
@@ -315,9 +433,12 @@ def run_worker(args) -> int:
         if n:
             print(f"journal replay: {n} unfinished request(s) "
                   f"resubmitted", file=sys.stderr)
+    from .rpc import engine_shape_hash
+    shape = engine_shape_hash(cfg.model, ecfg)
     try:
-        return asyncio.run(_run_async(worker, args.host, args.port,
-                                      args.ready_file, args.gen))
+        return asyncio.run(_run_async(
+            worker, args.host, args.port, args.router_addr, args.gen,
+            args.worker_idx, shape))
     finally:
         if journal is not None:
             journal.close()

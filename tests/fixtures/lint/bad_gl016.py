@@ -1,0 +1,25 @@
+"""GL016 bad: router-side code reading per-worker files — a
+shared-filesystem assumption the multi-host fleet cannot keep."""
+
+import json
+
+
+class Router:
+    def reconcile(self, rep):
+        # the worker's journal may live on ANOTHER MACHINE
+        return RequestJournal.unfinished(rep.journal_path)
+
+    def await_worker(self, spec):
+        with open(spec.ready_file) as f:       # ready-file handshake
+            return json.load(f)
+
+    def requeue_from_disk(self, idx):
+        return load_jsonl_if_exists(f_path("replica0.jsonl"))
+
+    def requeue_from_worker_dir(self, base):
+        # the per-worker-dir layout is just as shared-filesystem
+        return load_jsonl_if_exists(base + "/worker0/journal.jsonl")
+
+
+def f_path(name):
+    return name

@@ -1,0 +1,24 @@
+"""GL016 good: the local-mode backend (is_local = True) may read its
+own journal — same filesystem by construction; the router reconciles
+through the backend's journal_state() and its OWN ledger."""
+
+
+class Replica:
+    is_local = True                            # in-process backend
+
+    def __init__(self, journal_path):
+        self.journal_path = journal_path
+
+    def journal_state(self):
+        return RequestJournal.unfinished(self.journal_path)
+
+
+class Router:
+    def __init__(self, ledger_path):
+        # the router's OWN crash journal is its own disk — fine
+        self.recovered = RequestJournal.unfinished(ledger_path)
+
+    def reconcile(self, rep):
+        # the BACKEND owns journal access: local file or the
+        # journal_drain RPC — the router never sees a worker path
+        return rep.journal_state()

@@ -699,7 +699,8 @@ def test_bench_fleet_mode_emits_artifact(tmp_path, capsys, monkeypatch):
         fleet_replicas=2, fleet_sessions=5, fleet_turns=2,
         fleet_prefix_groups=2, fleet_prefix_len=8, fleet_kill_at=6,
         fleet_journal_dir=str(tmp_path), trace_out=None,
-        metrics_timeline=None, metrics_out=None, multiproc=False)
+        metrics_timeline=None, metrics_out=None, multiproc=False,
+        fleet_load_step=False, fleet_host_loss=False)
     bench.bench_fleet(args)
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]

@@ -449,8 +449,7 @@ def test_supervisor_restart_budget_ends_in_quarantine(tmp_path):
     requeued onto survivors (abandon_replica)."""
     spec = WorkerSpec(
         idx=0, cmd=[sys.executable, "-c", "import sys; sys.exit(3)"],
-        journal_path=str(tmp_path / "q.jsonl"),
-        ready_file=str(tmp_path / "q.ready.json"))
+        journal_path=str(tmp_path / "q.jsonl"))
     sup = ProcSupervisor([spec], SupervisorConfig(
         restart_budget=2, backoff_s=0.01, backoff_mult=2.0,
         probe_every=0))
@@ -476,8 +475,7 @@ def test_supervisor_reviving_reflects_backoff_and_intentional_stop(
         tmp_path):
     spec = WorkerSpec(
         idx=0, cmd=[sys.executable, "-c", "import sys; sys.exit(1)"],
-        journal_path=str(tmp_path / "r.jsonl"),
-        ready_file=str(tmp_path / "r.ready.json"))
+        journal_path=str(tmp_path / "r.jsonl"))
     sup = ProcSupervisor([spec], SupervisorConfig(
         restart_budget=5, backoff_s=30.0, probe_every=0))
     sup.attach_router(_StubRouter(1))
@@ -604,15 +602,21 @@ def test_worker_process_smoke_parity(tmp_path):
     """One real serve-worker subprocess behind the router: greedy
     parity vs offline generate, the cross-process journal flock (a
     second writer in THIS process gets JournalBusyError while the
-    worker lives), ready-file handshake contents, and a clean
+    worker lives), the RPC registration handshake (no ready files
+    anywhere — the workdir is the worker's PRIVATE dir), and a clean
     shutdown that frees the lock and leaves submit+finish records."""
     router, sup = _spawn(tmp_path, 1)
     try:
         h = sup.handles[0]
-        ready = json.loads(
-            pathlib.Path(h.spec.ready_file).read_text())
-        assert ready["pid"] == h.pid and ready["gen"] == 0
-        assert ready["replayed"] == 0
+        # registration attached the router: pid/gen/host flowed over
+        # the RPC handshake, not a filesystem artifact
+        rep = router.replicas[0]
+        assert rep.pid == h.pid and rep.gen == 0
+        assert h.state == "running"
+        assert sup.expect_shape_hash     # pinned by the registration
+        # no ready files exist anywhere in the worker's private dir
+        assert not [p for p in pathlib.Path(h.spec.workdir).iterdir()
+                    if "ready" in p.name]
         # the worker holds the exclusive flock on its journal
         with pytest.raises(JournalBusyError):
             RequestJournal(h.spec.journal_path, lock=True)
@@ -851,7 +855,8 @@ def test_bench_fleet_multiproc_emits_tagged_artifact(tmp_path, capsys):
         fleet_replicas=2, fleet_sessions=5, fleet_turns=2,
         fleet_prefix_groups=2, fleet_prefix_len=8, fleet_kill_at=8,
         fleet_journal_dir=str(tmp_path), trace_out=None,
-        metrics_timeline=None, metrics_out=None, multiproc=True)
+        metrics_timeline=None, metrics_out=None, multiproc=True,
+        fleet_load_step=False, fleet_host_loss=False)
     bench.bench_fleet(args)
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]

@@ -574,11 +574,16 @@ def test_serve_cli_multiproc_subprocess_smoke(tmp_path):
         proc.send_signal(signal.SIGINT)
         rc = proc.wait(timeout=60)
         assert rc == 0
-        recs = (jdir / "worker0.jsonl").read_text()
+        # per-worker PRIVATE dir (no shared journal files): the
+        # worker's journal + the router's own ledger both flushed
+        wjournal = jdir / "worker0" / "journal.jsonl"
+        recs = wjournal.read_text()
         assert '"ev": "submit"' in recs and '"ev": "finish"' in recs
+        ledger = (jdir / "router_ledger.jsonl").read_text()
+        assert '"ev": "submit"' in ledger and '"ev": "finish"' in ledger
         # the worker process died with the tree: its flock is free
         from replicatinggpt_tpu.serve import RequestJournal
-        RequestJournal(str(jdir / "worker0.jsonl"), lock=True).close()
+        RequestJournal(str(wjournal), lock=True).close()
     finally:
         if proc.poll() is None:
             proc.kill()

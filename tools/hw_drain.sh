@@ -25,6 +25,17 @@ timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
   --mesh-shape 2x2 \
   > benchmarks/BENCH_serve_window_2x2.json 2>> "$LOG"
 echo "=== serve-window-2x2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+# elastic-fleet rows (ISSUE 14): host_loss chaos mid-run (journal +
+# workdir deleted, router-ledger recovery) and the autoscaler
+# load-step preset (scale-up/scale-down with zero drops)
+timeout -s INT --kill-after=60 1800 python bench.py --mode fleet \
+  --multiproc --fleet-replicas 2 --fleet-kill-at 60 --fleet-host-loss \
+  > benchmarks/BENCH_fleet_host_loss.json 2>> "$LOG"
+echo "=== fleet-host-loss rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+timeout -s INT --kill-after=60 1800 python bench.py --mode fleet \
+  --fleet-load-step --fleet-replicas 3 \
+  > benchmarks/BENCH_fleet_load_step.json 2>> "$LOG"
+echo "=== fleet-load-step rc=$? $(date -u +%FT%TZ)" >> "$LOG"
 mkdir -p benchmarks/converged_gpt2
 timeout -s INT --kill-after=60 5400 python -m replicatinggpt_tpu train \
   --preset gpt2-large --dataset datasets/shakespeare.txt \
