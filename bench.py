@@ -390,13 +390,18 @@ def bench_serve(args) -> None:
                                         len(jax.devices()), warn=log)
     if mesh_d * mesh_m > 1:
         log(f"serving mesh: {mesh_d}x{mesh_m} (data x model)")
+    if args.kv_quant != "none" or args.weight_quant != "none":
+        log(f"quantization: kv {args.kv_quant}, weights "
+            f"{args.weight_quant}")
     ecfg = EngineConfig(pool_size=args.serve_pool,
                         max_queue=2 * args.serve_requests,
                         page_size=args.serve_page_size,
                         n_pages=args.serve_n_pages,
                         decode_window=args.decode_window,
                         decode_window_auto=args.decode_window_auto,
-                        mesh_data=mesh_d, mesh_model=mesh_m)
+                        mesh_data=mesh_d, mesh_model=mesh_m,
+                        kv_quant=args.kv_quant,
+                        weight_quant=args.weight_quant)
     summary = run_replay(state.params, cfg.model, rcfg, ecfg,
                          draft_params=draft_params, draft_cfg=draft_cfg,
                          resilience=DEFAULT_SERVE_RESILIENCE,
@@ -509,6 +514,86 @@ def bench_serve(args) -> None:
             f"under the storm vs {a_idle:.2f}x idle -> "
             f"{storm_block['retention']:.1%} retained "
             f"(breaks {storm_w['window_breaks']})")
+    quant_ab: dict = {}
+    if args.quant_ab:
+        # bf16-vs-int8 KV at FIXED HBM on the shared-prefix trace
+        # (ISSUE 15 acceptance): one byte budget, each arm sized in ITS
+        # pages (pages.n_pages_for_hbm) — page count is the admission
+        # currency, so the int8 arm admits ~2x the concurrent requests
+        # the budget allows the baseline. The budget is deliberately
+        # HALF the default pool so pages (not slots) are the binding
+        # constraint and the capacity win shows up as queue wait, not
+        # just a bigger idle pool. Divergence rides the same block:
+        # both arms replay an identical greedy trace through fresh
+        # engines and the streams are compared token-for-token.
+        import dataclasses
+        from replicatinggpt_tpu.serve import Engine
+        from replicatinggpt_tpu.serve.pages import (n_pages_for_hbm,
+                                                    page_bytes,
+                                                    pool_geometry)
+        from replicatinggpt_tpu.serve.replay import make_trace
+        psz, mp, n_default = pool_geometry(
+            cfg.model, args.serve_pool, args.serve_page_size, 0,
+            args.serve_n_pages)
+        pb_base = page_bytes(cfg.model, psz)
+        pb_int8 = page_bytes(cfg.model, psz, "int8")
+        hbm = pb_base * max(n_default // 2, mp)
+        ab_rcfg = dataclasses.replace(
+            rcfg, prompt_mode="shared_prefix", greedy=True, spec="off",
+            rate=max(rcfg.rate, 10_000.0))
+        arms = {}
+        streams = {}
+        for label, kvq in (("base", "none"), ("int8", "int8")):
+            n_p = max(n_pages_for_hbm(hbm, cfg.model, psz, kvq), mp)
+            e = dataclasses.replace(ecfg, kv_quant=kvq, n_pages=n_p,
+                                    weight_quant="none")
+            arms[label] = (run_replay(state.params, cfg.model, ab_rcfg,
+                                      e,
+                                      resilience=DEFAULT_SERVE_RESILIENCE),
+                           n_p)
+            # divergence arm: the SAME greedy request set through a
+            # fresh engine, streams compared token-for-token
+            eng = Engine(state.params, cfg.model,
+                         dataclasses.replace(e, max_queue=4096))
+            div_trace = make_trace(cfg.model, dataclasses.replace(
+                ab_rcfg, n_requests=min(16, args.serve_requests)))
+            for _, r in div_trace:
+                eng.submit(dataclasses.replace(r, deadline=None))
+            streams[label] = {r.id: list(r.tokens)
+                              for r in eng.drain()}
+        matches = [streams["base"][rid] == streams["int8"][rid]
+                   for rid in streams["base"]]
+        sb, n_b = arms["base"]
+        si, n_i = arms["int8"]
+
+        def _pick(s):
+            h2 = s["histograms"]
+            return {
+                "queue_wait_p50_ms": round(
+                    h2.get("queue_wait_s", {}).get("p50", 0) * 1e3, 2),
+                "ttft_p50_ms": round(
+                    h2.get("ttft_s", {}).get("p50", 0) * 1e3, 2),
+                "prefix_hit_rate": s["pages"]["prefix_hit_rate"],
+                "recompiles_after_warmup": s["recompiles_after_warmup"],
+            }
+
+        quant_ab = {
+            "kv_dtype": "int8",
+            "hbm_budget_bytes": hbm,
+            "bytes_per_page": {"base": pb_base, "int8": pb_int8},
+            "n_pages": {"base": n_b, "int8": n_i},
+            "capacity_ratio": round(n_i / n_b, 3),
+            "greedy_stream_match_rate": round(
+                sum(matches) / len(matches), 3),
+            "base": _pick(sb),
+            "int8": _pick(si),
+        }
+        log(f"quant A/B (fixed {hbm / 1e6:.2f} MB KV budget): "
+            f"{n_b} pages base vs {n_i} pages int8 "
+            f"({quant_ab['capacity_ratio']}x capacity), greedy stream "
+            f"match {quant_ab['greedy_stream_match_rate']:.0%}, queue "
+            f"wait p50 {quant_ab['base']['queue_wait_p50_ms']} -> "
+            f"{quant_ab['int8']['queue_wait_p50_ms']} ms")
     prefix_ab: dict = {}
     if args.serve_prefix_trace:
         # same trace, radix prefix cache OFF: the TTFT delta isolates
@@ -585,10 +670,15 @@ def bench_serve(args) -> None:
         # windows in the headline replay (admit/deadline/cancel should
         # be zero — only spec reasons may move), and the autotuned k
         "window_breaks": summary.get("window_breaks", {}),
+        # quantization (ISSUE 15): the pool's storage mode + the
+        # capacity denominator ride every serve artifact
+        "kv_quant": pg["kv_quant"],
+        "bytes_per_page": pg["bytes_per_page"],
         **({"speculative": sp} if sp else {}),
         **({"dispatch_split": dispatch_split} if dispatch_split else {}),
         **({"admission_storm": storm_block} if storm_block else {}),
         **({"prefix_ab": prefix_ab} if prefix_ab else {}),
+        **({"quant_ab": quant_ab} if quant_ab else {}),
         # observability artifacts (utils.telemetry): paths + counts of
         # the Perfetto trace / metrics timeline / Prometheus text this
         # run emitted, so the dashboard can link the evidence
@@ -1286,6 +1376,24 @@ def main() -> None:
                         "the live dispatch split (bounded additive "
                         "increase over warm power-of-two buckets up "
                         "to --decode-window; never recompiles)")
+    p.add_argument("--kv-quant", default="none",
+                   choices=["none", "int8", "fp8"],
+                   help="--mode serve: paged KV page storage precision "
+                        "(quant/ — int8/fp8 pages + per-row scales "
+                        "halve bytes/page; see --quant-ab for the "
+                        "fixed-HBM capacity A/B)")
+    p.add_argument("--weight-quant", default="none",
+                   choices=["none", "int8", "fp8"],
+                   help="--mode serve: block matmul kernel precision "
+                        "(absmax-per-channel, dequant fused into the "
+                        "matmuls)")
+    p.add_argument("--quant-ab", action="store_true",
+                   help="--mode serve: bf16-vs-int8 KV capacity + "
+                        "divergence A/B at a FIXED HBM budget on the "
+                        "shared-prefix trace — each arm's pool sized "
+                        "in its own pages (the admission currency), "
+                        "greedy streams compared token-for-token; "
+                        "emits the quant_ab artifact block")
     p.add_argument("--serve-storm-trace", action="store_true",
                    help="--mode serve: also replay the admission-heavy "
                         "saturating storm (short prompts, mixed "

@@ -1450,5 +1450,186 @@ class Router:
     checker=_check_fleet_shared_fs))
 
 
+# ---------------------------------------------------------------------------
+# GL017 — dtype drift: implicit upcasts in kernel bodies, uncast pool writes
+# ---------------------------------------------------------------------------
+
+#: a function whose parameter list carries this many ``*_ref`` names is
+#: treated as a Pallas kernel body (the convention every kernel in
+#: ops/ follows)
+_GL017_MIN_REF_PARAMS = 2
+#: root names of KV-pool-shaped arrays a scatter/dynamic_update_slice
+#: may write into: the paged pool arrays (ck/cv), their quantization
+#: scale arrays (cks/cvs), and anything called cache/pool
+_GL017_POOL_NAME = re.compile(r"^(c[kv]s?|cc|cache|.*pool.*)$")
+
+
+def _gl017_is_kernel_body(fn) -> bool:
+    args = fn.args
+    names = [a.arg for a in (args.posonlyargs + args.args
+                             + args.kwonlyargs)]
+    if args.vararg is not None:
+        names.append(args.vararg.arg)
+    return sum(n.endswith("_ref") for n in names) >= _GL017_MIN_REF_PARAMS
+
+
+def _gl017_ref_load(node) -> Optional[str]:
+    """The ``name_ref[...]`` spelling of a raw ref load, or None."""
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id.endswith("_ref")):
+        return node.value.id
+    return None
+
+
+def _gl017_is_astype_call(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype")
+
+
+def _gl017_pool_root(node) -> Optional[str]:
+    """Root NAME of a pool-shaped write target: ``ck``, ``cache["k"]``
+    (root ``cache``), ... — None when the base is not a plain name or
+    does not look pool-shaped."""
+    base = node
+    while isinstance(base, ast.Subscript):
+        base = base.value
+    if isinstance(base, ast.Name) and _GL017_POOL_NAME.match(base.id):
+        return base.id
+    return None
+
+
+def _gl017_value_casts_to_target_dtype(value: ast.AST) -> bool:
+    """True when the written value contains an ``.astype(<x>.dtype)``
+    call — the explicit store-dtype cast every pool write must carry."""
+    for n in ast.walk(value):
+        if _gl017_is_astype_call(n) and n.args:
+            for a in ast.walk(n.args[0]):
+                if isinstance(a, ast.Attribute) and a.attr == "dtype":
+                    return True
+    return False
+
+
+def _check_dtype_drift(tree: ast.Module, lines: Sequence[str],
+                       path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    # half 1: implicit upcasts in Pallas kernel bodies — a raw
+    # ``x_ref[...]`` load mixed with an explicitly-cast operand in one
+    # arithmetic expression promotes by the REF's (implicit) dtype
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _gl017_is_kernel_body(node):
+            continue
+        for op in ast.walk(node):
+            if not isinstance(op, ast.BinOp):
+                continue
+            sides = (op.left, op.right)
+            for raw, cast in (sides, sides[::-1]):
+                ref = _gl017_ref_load(raw)
+                if ref is not None and _gl017_is_astype_call(cast):
+                    findings.append(_finding(
+                        "GL017", op,
+                        f"raw `{ref}[...]` load mixed with an "
+                        f"explicitly-cast operand in one expression "
+                        f"inside kernel body `{node.name}` — the "
+                        f"result dtype silently follows the ref's "
+                        f"storage dtype (an int8/bf16 pool block "
+                        f"upcasts or truncates here without a trace); "
+                        f"bind the load to a name with an explicit "
+                        f"`.astype(...)` first so the compute "
+                        f"precision is visible at the use site",
+                        path, lines))
+                    break
+    # half 2: mixed-dtype scatter / dynamic_update_slice writes into
+    # pool-shaped arrays — quantized pools made the store dtype (int8/
+    # fp8 rows, f32 scales) diverge from the compute dtype, so an
+    # uncast write either promotes the whole pool buffer or silently
+    # rounds through the wrong dtype
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        target = value = None
+        f = dotted(call.func)
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("set", "add")
+                and isinstance(call.func.value, ast.Subscript)
+                and isinstance(call.func.value.value, ast.Attribute)
+                and call.func.value.value.attr == "at"):
+            # <target>.at[...].set(value)
+            target = _gl017_pool_root(call.func.value.value.value)
+            value = call.args[0] if call.args else None
+        elif f in ("jax.lax.dynamic_update_slice",
+                   "lax.dynamic_update_slice",
+                   "dynamic_update_slice") and len(call.args) >= 2:
+            target = _gl017_pool_root(call.args[0])
+            value = call.args[1]
+            # ONE exemption, for this spelling only: a bare-name value
+            # into dynamic_update_slice is the COW page-copy idiom
+            # (re-writing a slice OF the same pool — the dtype is
+            # carried by construction). Scatter writes get no such
+            # pass: `.at[...].set(k_m)` is the uncast fresh-row write
+            # the rule exists to flag.
+            if isinstance(value, ast.Name):
+                continue
+        if target is None or value is None:
+            continue
+        if not _gl017_value_casts_to_target_dtype(value):
+            findings.append(_finding(
+                "GL017", call,
+                f"write into pool-shaped array `{target}` without an "
+                f"explicit `.astype({target}.dtype)` on the value — "
+                f"with quantized pools the store dtype (int8/fp8 rows, "
+                f"f32 scales) differs from the compute dtype, and an "
+                f"uncast scatter either type-promotes the whole pool "
+                f"buffer (silent 2-4x HBM regression) or rounds "
+                f"through the wrong dtype; cast the value to the "
+                f"target's dtype at the write site",
+                path, lines))
+    return findings
+
+
+_register(Rule(
+    id="GL017", name="dtype-drift",
+    rationale=(
+        "Quantized KV pools (quant/) store int8/fp8 rows next to f32 "
+        "scale arrays while compute runs in bf16/f32 — the one place "
+        "in the codebase where three dtypes meet in a single "
+        "expression. Two silent failure shapes: (1) inside a Pallas "
+        "kernel body, a raw `x_ref[...]` load mixed into an "
+        "expression whose other operand is explicitly `.astype(...)`-"
+        "cast promotes by the ref's STORAGE dtype — an int8 page "
+        "block scores attention in int arithmetic, or a bf16 block "
+        "silently upcasts per element instead of once; (2) a scatter "
+        "or dynamic_update_slice into a pool-shaped array whose value "
+        "lacks `.astype(<target>.dtype)` relies on implicit casting — "
+        "under type promotion the WRITE can promote the whole pool "
+        "buffer (a silent 2-4x HBM regression), and with a quantized "
+        "pool it rounds through the wrong dtype without an error. "
+        "Both are one explicit cast away from unambiguous."),
+    bad="""\
+def _my_kernel(q_ref, kp_ref, out_ref, *, scale):
+    # raw int8 ref load mixed with a cast operand: implicit upcast
+    s = kp_ref[...] * q_ref[...].astype(jnp.float32)
+    out_ref[...] = s
+
+def write(ck, k_m, layer, phys, woff):
+    # uncast scatter into the pool: promotes or mis-rounds the buffer
+    return ck.at[layer, phys, woff, :].set(k_m, mode="drop")
+""",
+    good="""\
+def _my_kernel(q_ref, kp_ref, out_ref, *, scale):
+    kc = kp_ref[...].astype(jnp.float32)     # precision visible here
+    s = kc * q_ref[...].astype(jnp.float32)
+    out_ref[...] = s.astype(out_ref.dtype)
+
+def write(ck, k_m, layer, phys, woff):
+    return ck.at[layer, phys, woff, :].set(
+        k_m.astype(ck.dtype), mode="drop")   # store dtype explicit
+""",
+    checker=_check_dtype_drift))
+
+
 def all_rule_ids() -> List[str]:
     return sorted(RULES)

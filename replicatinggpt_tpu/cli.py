@@ -37,6 +37,9 @@ ENGINE_FORWARD_FLAGS = (
     ("n_pages", "--n-pages"),
     ("decode_window", "--decode-window"),
     ("mesh_shape", "--mesh-shape"),
+    ("kv_quant", "--kv-quant"),
+    ("weight_quant", "--weight-quant"),
+    ("quant_granularity", "--quant-granularity"),
 )
 #: store_true engine switches, forwarded only when set
 ENGINE_FORWARD_SWITCHES = (("no_prefix_cache", "--no-prefix-cache"),
@@ -90,6 +93,30 @@ def add_engine_flags(p: argparse.ArgumentParser) -> None:
                         "TP over model (attention/MLP FLOPs per "
                         "step). 1x1 = single device. See "
                         "docs/serving.md#sharded-serving")
+    p.add_argument("--kv-quant", default="none",
+                   choices=["none", "int8", "fp8"],
+                   help="paged KV page storage precision: int8/fp8 "
+                        "pages + per-row scale metadata roughly halve "
+                        "bytes/page, so at fixed HBM --n-pages can "
+                        "roughly double (pages are the admission "
+                        "currency; size with "
+                        "serve.pages.n_pages_for_hbm). Dequant runs "
+                        "inside the paged decode kernels / the XLA "
+                        "gather. See docs/serving.md#quantization")
+    p.add_argument("--weight-quant", default="none",
+                   choices=["none", "int8", "fp8"],
+                   help="block matmul kernel precision: absmax-per-"
+                        "output-channel scales with dequant fused "
+                        "into the matmuls (quant/weights.py); a "
+                        "serialized calibration next to "
+                        "--checkpoint-dir is applied when present, "
+                        "else computed (and saved) at startup")
+    p.add_argument("--quant-granularity", default="page",
+                   choices=["page", "head"],
+                   help="KV scale granularity: 'page' = one f32 scale "
+                        "per written row (kernel-compatible), 'head' "
+                        "= one per (row, head) — tighter for outlier "
+                        "heads at H x the metadata (XLA gather route)")
 
 
 def engine_forward_args(args: argparse.Namespace) -> list:
@@ -125,7 +152,10 @@ def engine_config_from_args(args: argparse.Namespace):
                         prefix_cache=not args.no_prefix_cache,
                         decode_window=args.decode_window,
                         decode_window_auto=args.decode_window_auto,
-                        mesh_data=d, mesh_model=m)
+                        mesh_data=d, mesh_model=m,
+                        kv_quant=args.kv_quant,
+                        weight_quant=args.weight_quant,
+                        quant_granularity=args.quant_granularity)
 
 
 def _build_mesh_if_needed(cfg):
@@ -360,6 +390,14 @@ def cmd_serve_replay(args) -> int:
         shared_prefix_len=args.shared_prefix_len,
         spec=args.spec, spec_k=args.spec_k, spec_ngram=args.spec_ngram)
     ecfg = engine_config_from_args(args)
+    if ecfg.weight_quant != "none":
+        # the serialized-calibration workflow: reuse the scales next to
+        # the checkpoint, or calibrate + save them now (quant/weights)
+        from .quant.weights import prepare_params
+        state = state._replace(params=prepare_params(
+            state.params, cfg.model, ecfg.weight_quant,
+            checkpoint_dir=args.checkpoint_dir,
+            log=lambda m: print(m, file=sys.stderr)))
     draft_params = draft_cfg = None
     if rcfg.spec == "model":
         from .models.gpt import init_params, param_count
@@ -520,8 +558,14 @@ def cmd_serve(args) -> int:
                       file=sys.stderr)
             else:
                 state = restored
-        router = Router(state.params, cfg.model, rcfg,
-                        engine_config_from_args(args),
+        in_ecfg = engine_config_from_args(args)
+        if in_ecfg.weight_quant != "none":
+            from .quant.weights import prepare_params
+            state = state._replace(params=prepare_params(
+                state.params, cfg.model, in_ecfg.weight_quant,
+                checkpoint_dir=args.checkpoint_dir,
+                log=lambda m: print(m, file=sys.stderr)))
+        router = Router(state.params, cfg.model, rcfg, in_ecfg,
                         telemetry=telemetry)
     app = ServeApp(router, idle_timeout_s=args.idle_timeout_s,
                    supervisor=supervisor)
@@ -865,6 +909,13 @@ def main(argv=None) -> int:
                          "stale incarnation)")
     pw.add_argument("--no-fsync", action="store_true",
                     help="disable fsync-per-finish journal durability")
+    pw.add_argument("--reregister-idle-s", type=float, default=5.0,
+                    help="router-silence threshold before this worker "
+                         "re-sends its register frame (bounded "
+                         "exponential backoff): a RESTARTED router's "
+                         "fresh listener re-attaches the worker "
+                         "without operator action — registration is "
+                         "no longer once-at-startup")
     add_engine_flags(pw)
     pw.set_defaults(fn=cmd_serve_worker)
 

@@ -123,6 +123,24 @@ def _activation(x: jnp.ndarray, kind: str) -> jnp.ndarray:
     return jax.nn.gelu(x) if kind == "gelu" else jax.nn.relu(x)
 
 
+def _wmm(h: jnp.ndarray, lp: Dict[str, jnp.ndarray], name: str,
+         cd) -> jnp.ndarray:
+    """``h @ lp[name]`` with weight-quantization dequant fused into the
+    matmul: quantized params (quant/weights.py) store the kernel in
+    int8/fp8 plus a per-OUTPUT-channel f32 ``<name>_scale`` vector, and
+    per-output-channel scales commute through the contraction — so the
+    dequant is one multiply on the output row, never a rematerialized
+    full-precision weight. Unquantized params take the identical
+    ``h @ W.astype(cd)`` path (the scale key is simply absent, a static
+    pytree property — no recompile churn, one program per params
+    structure)."""
+    y = h @ lp[name].astype(cd)
+    s = lp.get(name + "_scale")
+    if s is not None:
+        y = y * s.astype(cd)
+    return y
+
+
 def _split_heads(x: jnp.ndarray, n_head: int) -> jnp.ndarray:
     B, T, C = x.shape
     return x.reshape(B, T, n_head, C // n_head).transpose(0, 2, 1, 3)
@@ -145,7 +163,7 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
     r_attn, r_drop1, r_drop2 = (jax.random.split(rng, 3)
                                 if rng is not None else (None, None, None))
     h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_eps)
-    qkv = h @ lp["qkv_kernel"].astype(cd) + lp["qkv_bias"].astype(cd)
+    qkv = _wmm(h, lp, "qkv_kernel", cd) + lp["qkv_bias"].astype(cd)
     attn = None
     impl = cfg.attention_impl
     if attention_fn is not None:
@@ -192,14 +210,14 @@ def _block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
                 q, k, v, dropout_rate=cfg.attn_dropout, rng=r_attn,
                 train=train, impl=impl)
         attn = _merge_heads(attn)
-    attn = attn @ lp["attn_out_kernel"].astype(cd) + lp["attn_out_bias"].astype(cd)
+    attn = _wmm(attn, lp, "attn_out_kernel", cd) + lp["attn_out_bias"].astype(cd)
     # Projection dropout: declared-but-unapplied in the reference
     # (GPT1.py:132,136, SURVEY.md §8-Q2); correct-by-default here.
     x = x + _dropout(attn, cfg.dropout, r_drop1, train)
     h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_eps)
-    h = _activation(h @ lp["mlp_up_kernel"].astype(cd)
+    h = _activation(_wmm(h, lp, "mlp_up_kernel", cd)
                     + lp["mlp_up_bias"].astype(cd), cfg.activation)
-    h = h @ lp["mlp_down_kernel"].astype(cd) + lp["mlp_down_bias"].astype(cd)
+    h = _wmm(h, lp, "mlp_down_kernel", cd) + lp["mlp_down_bias"].astype(cd)
     return x + _dropout(h, cfg.dropout, r_drop2, train)
 
 
@@ -339,7 +357,7 @@ def _cached_qkv_merged(h_in, lp, cfg: ModelConfig, cd):
     prefill). The packed cache layout writes these rows untouched."""
     h = _layer_norm(h_in, lp["ln1_scale"], lp["ln1_bias"],
                     cfg.layernorm_eps)
-    qkv = h @ lp["qkv_kernel"].astype(cd) + lp["qkv_bias"].astype(cd)
+    qkv = _wmm(h, lp, "qkv_kernel", cd) + lp["qkv_bias"].astype(cd)
     return jnp.split(qkv, 3, axis=-1)
 
 
@@ -354,14 +372,14 @@ def _cached_block_tail(h_in, attn_merged, lp, cfg: ModelConfig, cd):
     """Output projection + residual + ln2 + MLP + residual — the
     cache-path back half of a block, shared by decode_step and prefill
     (no dropout: decode paths never train)."""
-    attn = (attn_merged @ lp["attn_out_kernel"].astype(cd)
+    attn = (_wmm(attn_merged, lp, "attn_out_kernel", cd)
             + lp["attn_out_bias"].astype(cd))
     h_mid = h_in + attn
     h = _layer_norm(h_mid, lp["ln2_scale"], lp["ln2_bias"],
                     cfg.layernorm_eps)
-    h = _activation(h @ lp["mlp_up_kernel"].astype(cd)
+    h = _activation(_wmm(h, lp, "mlp_up_kernel", cd)
                     + lp["mlp_up_bias"].astype(cd), cfg.activation)
-    h = h @ lp["mlp_down_kernel"].astype(cd) + lp["mlp_down_bias"].astype(cd)
+    h = _wmm(h, lp, "mlp_down_kernel", cd) + lp["mlp_down_bias"].astype(cd)
     return h_mid + h
 
 
@@ -484,6 +502,10 @@ def decode_step(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     use_fused = (allow_pallas
                  and _fused_decode_backend_ok()
                  and cache["k"].dtype == cd
+                 # quantized params carry per-channel scales the fused
+                 # kernel's weight stream does not consume — the XLA
+                 # path below applies them via _wmm
+                 and "qkv_kernel_scale" not in params["blocks"]
                  and fused_decode_supported(
                      cfg, B, jnp.dtype(cd).itemsize, seq_len=S_actual))
     if use_fused:
@@ -883,22 +905,56 @@ def _constrain(x, s):
     return jax.lax.with_sharding_constraint(x, s)
 
 
+def pool_entry_sharding(shardings, name: str):
+    """Per-entry sharding of a paged pool dict: the K/V page arrays
+    take the (data, model) pool spec, the quantization scale arrays
+    (``ks``/``vs`` — different rank, no model dim) their own page-axis
+    spec (``ServeShardings.scale``). One mapping shared by the traced
+    constraints here and the engine's COW page copy."""
+    if shardings is None:
+        return None
+    if name in ("k", "v"):
+        return shardings.cache
+    return shardings.scale
+
+
 def _constrain_cache(cache: Dict[str, jnp.ndarray], shardings
                      ) -> Dict[str, jnp.ndarray]:
     if shardings is None:
         return cache
-    return {n: _constrain(a, shardings.cache) for n, a in cache.items()}
+    return {n: _constrain(a, pool_entry_sharding(shardings, n))
+            for n, a in cache.items()}
 
 
 def init_paged_kv_pool(cfg: ModelConfig, n_pages: int, page_size: int,
-                       dtype=None) -> Dict[str, jnp.ndarray]:
+                       dtype=None, quant=None) -> Dict[str, jnp.ndarray]:
     """Paged KV storage for the serving engine (serve/pages.py): the
     batch/slot axis of ``init_kv_cache`` becomes a PHYSICAL PAGE axis —
     (L, n_pages, page, C) for the packed layout, (L, n_pages, H, page, D)
     for heads. A slot's logical sequence is the concatenation of the
     pages its (host-side) page table maps, so HBM is sized by pages in
     use, not slots*block_size, and pages holding a shared prompt prefix
-    appear in many tables while existing once."""
+    appear in many tables while existing once.
+
+    ``quant`` (a quant.QuantConfig with ``kv_dtype`` set) stores the
+    pages in int8/fp8 and adds ``ks``/``vs`` scale arrays indexed by
+    the same (layer, page, offset) coordinates — halving bytes/page
+    (the admission-capacity doubler) at the cost of tiny per-row scale
+    metadata. The paged programs derive the quant mode from the dict
+    itself (quant.kv.pool_quant_mode), so their traced signatures
+    never change."""
+    if quant is not None and quant.kv_enabled:
+        from ..quant.kv import init_scales, kv_store_dtype
+        dt = kv_store_dtype(quant.kv_dtype)
+        if cfg.decode_cache_layout == "packed":
+            shape = (cfg.n_layer, n_pages, page_size, cfg.n_embd)
+        else:
+            shape = (cfg.n_layer, n_pages, cfg.n_head, page_size,
+                     cfg.head_dim)
+        pool = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        pool.update(init_scales(cfg, n_pages, page_size,
+                                quant.granularity))
+        return pool
     dt = dtype or _dtype(cfg.dtype)
     if cfg.decode_cache_layout == "packed":
         shape = (cfg.n_layer, n_pages, page_size, cfg.n_embd)
@@ -916,7 +972,8 @@ def paged_page_size(cfg: ModelConfig, cache: Dict[str, jnp.ndarray]) -> int:
 
 
 def _gather_pages(c_layer: jnp.ndarray, tables: jnp.ndarray,
-                  packed: bool, n_head: int) -> jnp.ndarray:
+                  packed: bool, n_head: int, s_layer=None,
+                  cd=None) -> jnp.ndarray:
     """Assemble per-slot logical K or V from one layer's page pool.
 
     c_layer: (N, page, C) packed or (N, H, page, D) heads; tables:
@@ -926,13 +983,108 @@ def _gather_pages(c_layer: jnp.ndarray, tables: jnp.ndarray,
     (B, H, max_pages*page, D) logical view the attention cores consume.
     This materialized gather streams the same bytes per step as the old
     contiguous (B, S, ...) slot read; the Pallas fast path
-    (ops/paged_pallas.py) is the route that skips unmapped pages."""
+    (ops/paged_pallas.py) is the route that skips unmapped pages.
+
+    ``s_layer`` (one layer of a quantized pool's ``ks``/``vs`` scale
+    arrays) dequantizes the gathered view to ``cd`` right here — the
+    XLA half of the in-kernel dequant contract: every route reads
+    quantized pages natively and multiplies scales at the gather, never
+    materializing a full-precision pool."""
     g = c_layer[tables]
+    if s_layer is not None:
+        from ..quant.kv import dequant_gathered
+        g = dequant_gathered(g, s_layer[tables], packed, n_head, cd)
     if packed:
         B, mp, psz, C = g.shape
         return _split_heads(g.reshape(B, mp * psz, C), n_head)
     B, mp, H, psz, D = g.shape
     return g.transpose(0, 2, 1, 3, 4).reshape(B, H, mp * psz, D)
+
+
+def _scatter_kv(cc: Dict[str, jnp.ndarray], layer_idx, phys, woff,
+                k_m: jnp.ndarray, v_m: jnp.ndarray, packed: bool,
+                n_head: int) -> Dict[str, jnp.ndarray]:
+    """Scatter merged fresh K/V rows into one layer of the paged pool
+    at (phys, woff) — ONE write discipline for the decode / verify /
+    prefill programs, both layouts, quantized or not.
+
+    ``k_m``/``v_m`` carry shape ``phys.shape + (C,)``; out-of-range
+    ``woff`` entries (inactive slots, padding, past-``limit``
+    positions) route to mode='drop' exactly as before. On a quantized
+    pool (``ks`` present) the rows quantize-on-write
+    (quant.kv.quantize_rows) and their scales land at the SAME
+    coordinates in the ``ks``/``vs`` arrays with the same drop
+    routing — a dropped row drops its scale with it."""
+    from ..quant.kv import pool_quant_mode, quantize_rows
+    kv_dtype, gran = pool_quant_mode(cc)
+    ck, cv = cc["k"], cc["v"]
+    H = n_head
+    if kv_dtype is None:
+        if packed:
+            ck = ck.at[layer_idx, phys, woff, :].set(
+                k_m.astype(ck.dtype), mode="drop")
+            cv = cv.at[layer_idx, phys, woff, :].set(
+                v_m.astype(cv.dtype), mode="drop")
+        else:
+            shp = phys.shape + (H, k_m.shape[-1] // H)
+            ck = ck.at[layer_idx, phys, :, woff, :].set(
+                k_m.reshape(shp).astype(ck.dtype), mode="drop")
+            cv = cv.at[layer_idx, phys, :, woff, :].set(
+                v_m.reshape(shp).astype(cv.dtype), mode="drop")
+        return {**cc, "k": ck, "v": cv}
+    kq, ksc = quantize_rows(k_m, kv_dtype, H, gran)
+    vq, vsc = quantize_rows(v_m, kv_dtype, H, gran)
+    cks, cvs = cc["ks"], cc["vs"]
+    if packed:
+        ck = ck.at[layer_idx, phys, woff, :].set(
+            kq.astype(ck.dtype), mode="drop")
+        cv = cv.at[layer_idx, phys, woff, :].set(
+            vq.astype(cv.dtype), mode="drop")
+        if gran == "head":
+            cks = cks.at[layer_idx, phys, woff, :].set(
+                ksc.astype(cks.dtype), mode="drop")
+            cvs = cvs.at[layer_idx, phys, woff, :].set(
+                vsc.astype(cvs.dtype), mode="drop")
+        else:
+            cks = cks.at[layer_idx, phys, woff].set(
+                ksc.astype(cks.dtype), mode="drop")
+            cvs = cvs.at[layer_idx, phys, woff].set(
+                vsc.astype(cvs.dtype), mode="drop")
+    else:
+        shp = phys.shape + (H, k_m.shape[-1] // H)
+        ck = ck.at[layer_idx, phys, :, woff, :].set(
+            kq.reshape(shp).astype(ck.dtype), mode="drop")
+        cv = cv.at[layer_idx, phys, :, woff, :].set(
+            vq.reshape(shp).astype(cv.dtype), mode="drop")
+        if gran == "head":
+            cks = cks.at[layer_idx, phys, :, woff].set(
+                ksc.astype(cks.dtype), mode="drop")
+            cvs = cvs.at[layer_idx, phys, :, woff].set(
+                vsc.astype(cvs.dtype), mode="drop")
+        else:
+            cks = cks.at[layer_idx, phys, woff].set(
+                ksc.astype(cks.dtype), mode="drop")
+            cvs = cvs.at[layer_idx, phys, woff].set(
+                vsc.astype(cvs.dtype), mode="drop")
+    return {**cc, "k": ck, "v": cv, "ks": cks, "vs": cvs}
+
+
+def _gather_kv(cc: Dict[str, jnp.ndarray], layer_idx, tables,
+               packed: bool, n_head: int, cd):
+    """Per-layer logical K/V views through ``_gather_pages``, with the
+    scale layers threaded for quantized pools (dequant at the gather —
+    the XLA fallback's half of the in-kernel dequant contract)."""
+    quantized = "ks" in cc
+    k_l = jax.lax.dynamic_index_in_dim(cc["k"], layer_idx, 0, False)
+    v_l = jax.lax.dynamic_index_in_dim(cc["v"], layer_idx, 0, False)
+    ks_l = vs_l = None
+    if quantized:
+        ks_l = jax.lax.dynamic_index_in_dim(cc["ks"], layer_idx, 0, False)
+        vs_l = jax.lax.dynamic_index_in_dim(cc["vs"], layer_idx, 0, False)
+    return (_gather_pages(k_l, tables, packed, n_head, s_layer=ks_l,
+                          cd=cd),
+            _gather_pages(v_l, tables, packed, n_head, s_layer=vs_l,
+                          cd=cd))
 
 
 def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
@@ -971,6 +1123,7 @@ def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     phys = tables[bidx, jnp.minimum(pos_eff // psz, mp - 1)]
     woff = jnp.where(active, pos_eff % psz, psz)   # inactive -> dropped
 
+    quantized = "ks" in cache
     if use_fused:
         # ONE Pallas launch for the whole layer stack: the page table
         # rides scalar-prefetch SMEM so each (layer, slot) grid step
@@ -978,80 +1131,101 @@ def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
         # fused_paged_decode_layers). Packed layout only; the caller
         # gates on fused_paged_decode_supported. The kernel attends the
         # STALE pool + fresh column (bit-equivalent to write-then-
-        # attend), so every layer's fresh K/V row scatters afterwards —
-        # drop-routed exactly like the XLA path's per-layer writes.
+        # attend; on a quantized pool it dequants pages in-kernel and
+        # fake-quantizes the fresh column to exactly what the store
+        # below will dequant to), so every layer's fresh K/V row
+        # scatters afterwards — drop-routed exactly like the XLA
+        # path's per-layer writes, quantize-on-write included.
         from ..ops.decode_pallas import fused_paged_decode_layers
         x_row, newk, newv = fused_paged_decode_layers(
             x[:, 0, :], params["blocks"], pos_eff, tables, cache, cfg)
-        ck = cache["k"].at[:, phys, woff, :].set(
-            newk.astype(cache["k"].dtype), mode="drop")
-        cv = cache["v"].at[:, phys, woff, :].set(
-            newv.astype(cache["v"].dtype), mode="drop")
-        return (_decode_head(x_row[:, None, :], params, cfg, cd),
-                {"k": ck, "v": cv})
+        cc = dict(cache)
+        if quantized:
+            from ..quant.kv import pool_quant_mode, quantize_rows
+            kv_dtype, gran = pool_quant_mode(cache)
+            kq, ksc = quantize_rows(newk, kv_dtype, H, gran)
+            vq, vsc = quantize_rows(newv, kv_dtype, H, gran)
+            cc["k"] = cc["k"].at[:, phys, woff, :].set(
+                kq.astype(cc["k"].dtype), mode="drop")
+            cc["v"] = cc["v"].at[:, phys, woff, :].set(
+                vq.astype(cc["v"].dtype), mode="drop")
+            cc["ks"] = cc["ks"].at[:, phys, woff].set(
+                ksc.astype(cc["ks"].dtype), mode="drop")
+            cc["vs"] = cc["vs"].at[:, phys, woff].set(
+                vsc.astype(cc["vs"].dtype), mode="drop")
+        else:
+            cc["k"] = cc["k"].at[:, phys, woff, :].set(
+                newk.astype(cc["k"].dtype), mode="drop")
+            cc["v"] = cc["v"].at[:, phys, woff, :].set(
+                newv.astype(cc["v"].dtype), mode="drop")
+        return _decode_head(x_row[:, None, :], params, cfg, cd), cc
 
     def body(carry, inputs):
-        h_in, ck, cv = carry
+        h_in, cc = carry
         lp, layer_idx = inputs
         if packed:
             q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)
             if use_pallas:
                 # kernel attends the STALE pages + fresh column (bit-
-                # equivalent to write-then-attend); write lands after
+                # equivalent to write-then-attend); write lands after.
+                # Quantized pools hand the kernel their scale layers
+                # (dequant inside the accumulation loop) and a fresh
+                # column pre-quantize-dequantized to the exact value
+                # the scatter below stores.
                 from ..ops.paged_pallas import paged_decode_attention
-                k_layer = jax.lax.dynamic_index_in_dim(ck, layer_idx, 0,
-                                                       keepdims=False)
-                v_layer = jax.lax.dynamic_index_in_dim(cv, layer_idx, 0,
-                                                       keepdims=False)
+                k_layer = jax.lax.dynamic_index_in_dim(cc["k"], layer_idx,
+                                                       0, keepdims=False)
+                v_layer = jax.lax.dynamic_index_in_dim(cc["v"], layer_idx,
+                                                       0, keepdims=False)
+                k_new, v_new = k_m[:, 0, :], v_m[:, 0, :]
+                ks_layer = vs_layer = None
+                if quantized:
+                    from ..quant.kv import (fake_quantize_rows,
+                                            pool_quant_mode)
+                    kv_dtype, gran = pool_quant_mode(cc)
+                    k_new = fake_quantize_rows(k_new, kv_dtype, H,
+                                               gran).astype(cd)
+                    v_new = fake_quantize_rows(v_new, kv_dtype, H,
+                                               gran).astype(cd)
+                    ks_layer = jax.lax.dynamic_index_in_dim(
+                        cc["ks"], layer_idx, 0, keepdims=False)
+                    vs_layer = jax.lax.dynamic_index_in_dim(
+                        cc["vs"], layer_idx, 0, keepdims=False)
                 attn_merged = paged_decode_attention(
-                    q_m[:, 0, :], k_m[:, 0, :], v_m[:, 0, :],
-                    k_layer, v_layer, tables, pos_eff, n_head=H)[:, None, :]
-                ck = ck.at[layer_idx, phys, woff, :].set(
-                    k_m[:, 0, :].astype(ck.dtype), mode="drop")
-                cv = cv.at[layer_idx, phys, woff, :].set(
-                    v_m[:, 0, :].astype(cv.dtype), mode="drop")
+                    q_m[:, 0, :], k_new, v_new,
+                    k_layer, v_layer, tables, pos_eff, n_head=H,
+                    k_scales=ks_layer, v_scales=vs_layer)[:, None, :]
+                cc = _scatter_kv(cc, layer_idx, phys, woff,
+                                 k_m[:, 0, :], v_m[:, 0, :], packed, H)
             else:
-                ck = ck.at[layer_idx, phys, woff, :].set(
-                    k_m[:, 0, :].astype(ck.dtype), mode="drop")
-                cv = cv.at[layer_idx, phys, woff, :].set(
-                    v_m[:, 0, :].astype(cv.dtype), mode="drop")
-                k_all = _gather_pages(
-                    jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
-                    tables, packed, H)
-                v_all = _gather_pages(
-                    jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
-                    tables, packed, H)
+                cc = _scatter_kv(cc, layer_idx, phys, woff,
+                                 k_m[:, 0, :], v_m[:, 0, :], packed, H)
+                k_all, v_all = _gather_kv(cc, layer_idx, tables, packed,
+                                          H, cd)
                 attn_merged = _merge_heads(cached_attention(
                     _split_heads(q_m, H), k_all, v_all, pos_eff))
         else:
-            q, k, v = _cached_qkv(h_in, lp, cfg, cd)  # (B, H, 1, D)
-            ck = ck.at[layer_idx, phys, :, woff, :].set(
-                k[:, :, 0, :].astype(ck.dtype), mode="drop")
-            cv = cv.at[layer_idx, phys, :, woff, :].set(
-                v[:, :, 0, :].astype(cv.dtype), mode="drop")
-            k_all = _gather_pages(
-                jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
-                tables, packed, H)
-            v_all = _gather_pages(
-                jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
-                tables, packed, H)
-            attn_merged = _merge_heads(
-                cached_attention(q, k_all, v_all, pos_eff))
+            q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)
+            cc = _scatter_kv(cc, layer_idx, phys, woff,
+                             k_m[:, 0, :], v_m[:, 0, :], packed, H)
+            k_all, v_all = _gather_kv(cc, layer_idx, tables, packed,
+                                      H, cd)
+            attn_merged = _merge_heads(cached_attention(
+                _split_heads(q_m, H), k_all, v_all, pos_eff))
         return (_cached_block_tail(h_in, attn_merged, lp, cfg, cd),
-                ck, cv), None
+                cc), None
 
     if cfg.use_layer_scan:
         layer_ids = jnp.arange(cfg.n_layer)
-        (x, new_k, new_v), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["blocks"], layer_ids))
+        (x, cc), _ = jax.lax.scan(
+            body, (x, dict(cache)), (params["blocks"], layer_ids))
     else:
-        carry = (x, cache["k"], cache["v"])
+        carry = (x, dict(cache))
         for i in range(cfg.n_layer):
             lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
             carry, _ = body(carry, (lp, i))
-        x, new_k, new_v = carry
-    return _decode_head(x, params, cfg, cd), {"k": new_k, "v": new_v}
+        x, cc = carry
+    return _decode_head(x, params, cfg, cd), cc
 
 
 def decode_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
@@ -1261,54 +1435,36 @@ def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
     woff = jnp.where(valid & (abs_pos < Smax), abs_pos % psz, psz)
 
     def body(carry, inputs):
-        h_in, ck, cv = carry
+        h_in, cc = carry
         lp, layer_idx = inputs
-        if packed:
-            q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)  # (B, W, C)
-            ck = ck.at[layer_idx, phys, woff, :].set(
-                k_m.astype(ck.dtype), mode="drop")
-            cv = cv.at[layer_idx, phys, woff, :].set(
-                v_m.astype(cv.dtype), mode="drop")
-            q_h = _split_heads(q_m, H)
-        else:
-            q, k, v = _cached_qkv(h_in, lp, cfg, cd)    # (B, H, W, D)
-            # scatter value laid out (B, W, H, D): advanced indices
-            # (phys, woff) broadcast to (B, W) and land first
-            ck = ck.at[layer_idx, phys, :, woff, :].set(
-                k.transpose(0, 2, 1, 3).astype(ck.dtype), mode="drop")
-            cv = cv.at[layer_idx, phys, :, woff, :].set(
-                v.transpose(0, 2, 1, 3).astype(cv.dtype), mode="drop")
-            q_h = q
-        k_all = _gather_pages(
-            jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
-            tables, packed, H)
-        v_all = _gather_pages(
-            jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
-            tables, packed, H)
+        q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)  # (B, W, C)
+        # scatter values laid out phys.shape-major: advanced indices
+        # (phys, woff) broadcast to (B, W) and land first
+        cc = _scatter_kv(cc, layer_idx, phys, woff, k_m, v_m, packed, H)
+        q_h = _split_heads(q_m, H)
+        k_all, v_all = _gather_kv(cc, layer_idx, tables, packed, H, cd)
         attn = windowed_cached_attention(q_h, k_all, v_all, pos_eff)
-        ck = _constrain(ck, None if shardings is None else shardings.cache)
-        cv = _constrain(cv, None if shardings is None else shardings.cache)
+        cc = _constrain_cache(cc, shardings)
         return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
-                ck, cv), None
+                cc), None
 
     if cfg.use_layer_scan:
         layer_ids = jnp.arange(cfg.n_layer)
-        (x, new_k, new_v), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["blocks"], layer_ids))
+        (x, cc), _ = jax.lax.scan(
+            body, (x, dict(cache)), (params["blocks"], layer_ids))
     else:
-        carry = (x, cache["k"], cache["v"])
+        carry = (x, dict(cache))
         for i in range(cfg.n_layer):
             lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
             carry, _ = body(carry, (lp, i))
-        x, new_k, new_v = carry
+        x, cc = carry
     if logits_rows is not None:
         x = x[:, :logits_rows, :]
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                     cfg.layernorm_eps)
     head = (params["wte"].astype(cd).T if cfg.tied_head
             else params["lm_head"].astype(cd))
-    return (x @ head).astype(jnp.float32), {"k": new_k, "v": new_v}
+    return (x @ head).astype(jnp.float32), cc
 
 
 def prefill_chunk_paged(params: Params, idx: jnp.ndarray,
@@ -1353,46 +1509,30 @@ def prefill_chunk_paged(params: Params, idx: jnp.ndarray,
     base = jnp.reshape(offset, (1,))
 
     def body(carry, inputs):
-        h_in, ck, cv = carry
+        h_in, cc = carry
         lp, layer_idx = inputs
         q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)  # (1, Pc, C)
-        if packed:
-            ck = ck.at[layer_idx, phys, woff, :].set(
-                k_m[0].astype(ck.dtype), mode="drop")
-            cv = cv.at[layer_idx, phys, woff, :].set(
-                v_m[0].astype(cv.dtype), mode="drop")
-        else:
-            k0 = _split_heads(k_m, H)[0].transpose(1, 0, 2)  # (Pc, H, D)
-            v0 = _split_heads(v_m, H)[0].transpose(1, 0, 2)
-            ck = ck.at[layer_idx, phys, :, woff, :].set(
-                k0.astype(ck.dtype), mode="drop")
-            cv = cv.at[layer_idx, phys, :, woff, :].set(
-                v0.astype(cv.dtype), mode="drop")
-        k_all = _gather_pages(
-            jax.lax.dynamic_index_in_dim(ck, layer_idx, 0, False),
-            table_row[None], packed, H)
-        v_all = _gather_pages(
-            jax.lax.dynamic_index_in_dim(cv, layer_idx, 0, False),
-            table_row[None], packed, H)
+        cc = _scatter_kv(cc, layer_idx, phys, woff, k_m[0], v_m[0],
+                         packed, H)
+        k_all, v_all = _gather_kv(cc, layer_idx, table_row[None],
+                                  packed, H, cd)
         attn = windowed_cached_attention(_split_heads(q_m, H), k_all,
                                          v_all, base)
-        ck = _constrain(ck, None if shardings is None else shardings.cache)
-        cv = _constrain(cv, None if shardings is None else shardings.cache)
+        cc = _constrain_cache(cc, shardings)
         return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
-                ck, cv), None
+                cc), None
 
     if cfg.use_layer_scan:
         layer_ids = jnp.arange(cfg.n_layer)
-        (_, ck, cv), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["blocks"], layer_ids))
+        (_, cc), _ = jax.lax.scan(
+            body, (x, dict(cache)), (params["blocks"], layer_ids))
     else:
-        carry = (x, cache["k"], cache["v"])
+        carry = (x, dict(cache))
         for i in range(cfg.n_layer):
             lp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
             carry, _ = body(carry, (lp, i))
-        _, ck, cv = carry
-    return {"k": ck, "v": cv}
+        _, cc = carry
+    return cc
 
 
 def prefill_chunk_into_slot(params: Params, idx: jnp.ndarray,

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ..quant.kv import fake_quantize_row_f32 as _fake_quant_row
 from .flash_pallas import (LANES, NEG_INF, _compiler_params,
                            _interpret_mode, _smem_spec, _vmem_spec, pltpu)
 
@@ -350,7 +351,9 @@ def fused_decode_layers(x0: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
 # ---------------------------------------------------------------------------
 
 def fused_paged_decode_supported(cfg, n_slots: int, page_size: int,
-                                 itemsize: int = 2, mesh=None) -> bool:
+                                 itemsize: int = 2, mesh=None,
+                                 kv_quant: str = "none",
+                                 granularity: str = "page") -> bool:
     """Envelope for ``fused_paged_decode_layers``: packed cache layout,
     lane-sliceable heads, sublane-aligned pages, per-head accumulator
     lanes available, and one layer's weights + a double-buffered page
@@ -359,9 +362,15 @@ def fused_paged_decode_supported(cfg, n_slots: int, page_size: int,
     (ops/paged_pallas.py) whenever it fits — one launch per decode step
     instead of one per layer. On a >1-device serving mesh the route is
     OFF (``ops.paged_pallas.paged_kernel_mesh_ok``): a bare pallas_call
-    cannot be GSPMD-partitioned, so sharded engines take the XLA path."""
+    cannot be GSPMD-partitioned, so sharded engines take the XLA path.
+    Quantized KV pools (quant/): int8 at PAGE granularity streams the
+    (page, 1) scale blocks and dequants in the accumulation loop —
+    fp8 / head granularity route the XLA gather path (same reasoning
+    as ``paged_pallas.paged_decode_supported``)."""
     from .paged_pallas import paged_kernel_mesh_ok
     if not paged_kernel_mesh_ok(mesh):
+        return False
+    if kv_quant not in ("none", "int8") or granularity != "page":
         return False
     if cfg.decode_cache_layout != "packed":
         return False
@@ -384,10 +393,9 @@ def fused_paged_decode_supported(cfg, n_slots: int, page_size: int,
 def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
                         wqkv_ref, bqkv_ref, wproj_ref, bproj_ref, ln2s_ref,
                         ln2b_ref, wup_ref, bup_ref, wdown_ref, bdown_ref,
-                        kp_ref, vp_ref, xout_ref, newk_ref, newv_ref,
-                        x_scr, q_scr, knew_scr, vnew_scr, acc_ref, m_ref,
-                        l_ref, *, n_layer, n_head, head_dim, page_size,
-                        n_pages_per_slot, eps, scale, activation):
+                        kp_ref, vp_ref, *rest, n_layer, n_head, head_dim,
+                        page_size, n_pages_per_slot, eps, scale,
+                        activation, quantized):
     """Grid (layer, slot, logical page), all sequential: the residual
     row of every slot is carried across layer steps in VMEM scratch
     (exactly ``_decode_kernel``'s trick, widened to B rows), each
@@ -397,7 +405,21 @@ def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
     the DMA — ops/paged_pallas.clamped_live_page), and the block tail
     (proj/ln2/MLP/residual) lands at the last page step. Layer weights
     keep a constant block index across the whole (slot, page) subgrid,
-    so they stream exactly once per layer."""
+    so they stream exactly once per layer.
+
+    ``quantized`` (int8 pool, page-granularity scales): two extra
+    (psz, 1) f32 scale blocks ride the page index map and dequant the
+    K/V pages inside the accumulation loop, and the fresh K/V rows are
+    FAKE-QUANTIZED (``_fake_quant_row`` — bit-identical math to
+    quant.kv) before attending, so the fresh column scores exactly
+    what the caller's quantize-on-write scatter will store; the raw
+    rows still leave through newk/newv for that scatter."""
+    if quantized:
+        (ksp_ref, vsp_ref, xout_ref, newk_ref, newv_ref, x_scr, q_scr,
+         knew_scr, vnew_scr, acc_ref, m_ref, l_ref) = rest
+    else:
+        (xout_ref, newk_ref, newv_ref, x_scr, q_scr, knew_scr,
+         vnew_scr, acc_ref, m_ref, l_ref) = rest
     l = pl.program_id(0)
     b = pl.program_id(1)
     p = pl.program_id(2)
@@ -416,10 +438,19 @@ def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
         h = _ln_row(x, ln1s_ref[...], ln1b_ref[...], eps)
         qkv = _row_matmul(h, wqkv_ref[...], bqkv_ref[...])   # (1, 3C)
         q_scr[...] = qkv[:, :C]
-        knew_scr[...] = qkv[:, C:2 * C]
-        vnew_scr[...] = qkv[:, 2 * C:]
-        newk_ref[...] = qkv[:, C:2 * C]
-        newv_ref[...] = qkv[:, 2 * C:]
+        k_row = qkv[:, C:2 * C]
+        v_row = qkv[:, 2 * C:]
+        if quantized:
+            # attend the value the pool will actually hold (docstring)
+            kdq = _fake_quant_row(k_row, 127.0)
+            vdq = _fake_quant_row(v_row, 127.0)
+            knew_scr[...] = kdq.astype(knew_scr.dtype)
+            vnew_scr[...] = vdq.astype(vnew_scr.dtype)
+        else:
+            knew_scr[...] = k_row
+            vnew_scr[...] = v_row
+        newk_ref[...] = k_row
+        newv_ref[...] = v_row
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
@@ -427,12 +458,20 @@ def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
     @pl.when(p < live)
     def _accumulate():
         kpos = jax.lax.broadcasted_iota(jnp.int32, (psz, 1), 0) + p * psz
+        if quantized:
+            ksc = ksp_ref[...]                                   # (psz, 1)
+            vsc = vsp_ref[...]
         for i in range(H):
             sl = slice(i * D, (i + 1) * D)
             q = q_scr[:, sl].astype(jnp.float32)                 # (1, D)
             kc = kp_ref[:, sl]                                   # (psz, D)
             vc = vp_ref[:, sl]
-            s = jnp.sum(kc.astype(jnp.float32) * q, axis=-1,
+            kcf = kc.astype(jnp.float32)
+            vcf = vc.astype(jnp.float32)
+            if quantized:
+                kcf = kcf * ksc
+                vcf = vcf * vsc
+            s = jnp.sum(kcf * q, axis=-1,
                         keepdims=True) * scale                   # (psz, 1)
             s = jnp.where(kpos < pos, s, NEG_INF)
             m_prev = m_ref[0, i]
@@ -443,7 +482,7 @@ def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
             pexp = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
             l_ref[0, i] = l_ref[0, i] * alpha + jnp.sum(pexp)
             acc_ref[:, sl] = (acc_ref[:, sl] * alpha
-                              + jnp.sum(pexp * vc.astype(jnp.float32),
+                              + jnp.sum(pexp * vcf,
                                         axis=0, keepdims=True))
             m_ref[0, i] = m_new
 
@@ -496,12 +535,14 @@ def fused_paged_decode_layers(x0: jnp.ndarray,
     D = C // H
     B, mp = tables.shape
     cd = x0.dtype
+    quantized = "ks" in cache
     w = {k: v.astype(cd) for k, v in blocks.items()}
     vec = lambda name: w[name].reshape(L, 1, -1)
     kernel = functools.partial(
         _paged_fused_kernel, n_layer=L, n_head=H, head_dim=D,
         page_size=psz, n_pages_per_slot=mp, eps=cfg.layernorm_eps,
-        scale=D ** -0.5, activation=cfg.activation)
+        scale=D ** -0.5, activation=cfg.activation,
+        quantized=quantized)
     lrow = lambda width: _vmem_spec((None, 1, width),
                                     lambda l, b, p, t, q: (l, 0, 0))
     lmat = lambda a, c: _vmem_spec((None, a, c),
@@ -527,14 +568,29 @@ def fused_paged_decode_layers(x0: jnp.ndarray,
     cp = _compiler_params(0, 3)
     if cp is not None:
         kw["compiler_params"] = cp
+    in_specs = [brow,
+                lrow(C), lrow(C), lmat(C, 3 * C), lrow(3 * C),
+                lmat(C, C), lrow(C), lrow(C), lrow(C),
+                lmat(C, 4 * C), lrow(4 * C), lmat(4 * C, C), lrow(C),
+                page_spec, page_spec]
+    inputs = [x0[:, None, :],
+              vec("ln1_scale"), vec("ln1_bias"), w["qkv_kernel"],
+              vec("qkv_bias"), w["attn_out_kernel"],
+              vec("attn_out_bias"), vec("ln2_scale"), vec("ln2_bias"),
+              w["mlp_up_kernel"], vec("mlp_up_bias"),
+              w["mlp_down_kernel"], vec("mlp_down_bias"),
+              cache["k"], cache["v"]]
+    if quantized:
+        # (L, N, psz) page-granularity scales -> (psz, 1) blocks per
+        # (layer, physical page), same fetch-skip index map as K/V
+        scale_spec = _vmem_spec((None, None, psz, 1), page_map)
+        in_specs += [scale_spec, scale_spec]
+        inputs += [cache["ks"].reshape(L, N, psz, 1),
+                   cache["vs"].reshape(L, N, psz, 1)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(L, B, mp),
-        in_specs=[brow,
-                  lrow(C), lrow(C), lmat(C, 3 * C), lrow(3 * C),
-                  lmat(C, C), lrow(C), lrow(C), lrow(C),
-                  lmat(C, 4 * C), lrow(4 * C), lmat(4 * C, C), lrow(C),
-                  page_spec, page_spec],
+        in_specs=in_specs,
         out_specs=[brow,
                    _vmem_spec((None, None, 1, C),
                               lambda l, b, p, t, q: (l, b, 0, 0)),
@@ -549,9 +605,5 @@ def fused_paged_decode_layers(x0: jnp.ndarray,
                    jax.ShapeDtypeStruct((L, B, 1, C), cd)],
         interpret=_interpret_mode(), **kw,
     )(jnp.asarray(tables, jnp.int32), jnp.asarray(pos, jnp.int32),
-      x0[:, None, :],
-      vec("ln1_scale"), vec("ln1_bias"), w["qkv_kernel"], vec("qkv_bias"),
-      w["attn_out_kernel"], vec("attn_out_bias"), vec("ln2_scale"),
-      vec("ln2_bias"), w["mlp_up_kernel"], vec("mlp_up_bias"),
-      w["mlp_down_kernel"], vec("mlp_down_bias"), cache["k"], cache["v"])
+      *inputs)
     return xout[:, 0, :], newk[:, :, 0, :], newv[:, :, 0, :]

@@ -95,11 +95,21 @@ def clamped_live_page(p, pos, page_size: int):
 
 
 def paged_decode_supported(n_head: int, head_dim: int, page_size: int,
-                           itemsize: int = 2, mesh=None) -> bool:
+                           itemsize: int = 2, mesh=None,
+                           kv_quant: str = "none",
+                           granularity: str = "page") -> bool:
     """Envelope: lane-sliceable heads, sublane-aligned page length,
     per-head accumulator lanes available, both page blocks in budget —
-    and no serving mesh (``paged_kernel_mesh_ok``)."""
+    and no serving mesh (``paged_kernel_mesh_ok``). Quantized pools
+    (quant/): int8 at PAGE granularity streams its (page, 1) scale
+    blocks alongside the K/V pages and dequants in the accumulation
+    loop; fp8 and head granularity route the XLA gather path (fp8
+    in-kernel casts and per-head scale lane selection are not lowered
+    here yet — the gather fallback is the sharding-style escape
+    hatch, decided once per engine)."""
     if not paged_kernel_mesh_ok(mesh):
+        return False
+    if kv_quant not in ("none", "int8") or granularity != "page":
         return False
     if head_dim not in (32, 64, 128, 256) or n_head > LANES:
         return False
@@ -112,8 +122,16 @@ def paged_decode_supported(n_head: int, head_dim: int, page_size: int,
 
 
 def _paged_kernel(tables_ref, pos_ref, q_ref, knew_ref, vnew_ref,
-                  kp_ref, vp_ref, out_ref, acc_ref, m_ref, l_ref, *,
-                  n_head, head_dim, page_size, n_pages_per_slot, scale):
+                  kp_ref, vp_ref, *rest, n_head, head_dim, page_size,
+                  n_pages_per_slot, scale, quantized):
+    # quantized pools append two (psz, 1) f32 scale blocks streamed
+    # through the same page index map as the K/V blocks — dequant is
+    # one broadcast multiply inside the accumulation loop (the
+    # "in-kernel dequant" half of quant/kv.py's contract)
+    if quantized:
+        ksp_ref, vsp_ref, out_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        out_ref, acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     p = pl.program_id(1)
     D, psz = head_dim, page_size
@@ -129,12 +147,20 @@ def _paged_kernel(tables_ref, pos_ref, q_ref, knew_ref, vnew_ref,
     @pl.when(p < live)
     def _accumulate():
         kpos = jax.lax.broadcasted_iota(jnp.int32, (psz, 1), 0) + p * psz
+        if quantized:
+            ksc = ksp_ref[...]                               # (psz, 1)
+            vsc = vsp_ref[...]
         for i in range(n_head):
             sl = slice(i * D, (i + 1) * D)
             q = q_ref[:, sl].astype(jnp.float32)                 # (1, D)
             kc = kp_ref[:, sl]                                   # (psz, D)
             vc = vp_ref[:, sl]
-            s = jnp.sum(kc.astype(jnp.float32) * q, axis=-1,
+            kcf = kc.astype(jnp.float32)
+            vcf = vc.astype(jnp.float32)
+            if quantized:
+                kcf = kcf * ksc
+                vcf = vcf * vsc
+            s = jnp.sum(kcf * q, axis=-1,
                         keepdims=True) * scale                   # (psz, 1)
             s = jnp.where(kpos < pos, s, NEG_INF)
             m_prev = m_ref[0, i]
@@ -145,8 +171,7 @@ def _paged_kernel(tables_ref, pos_ref, q_ref, knew_ref, vnew_ref,
             pexp = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
             l_ref[0, i] = l_ref[0, i] * alpha + jnp.sum(pexp)
             acc_ref[:, sl] = (acc_ref[:, sl] * alpha
-                              + jnp.sum(pexp.astype(jnp.float32)
-                                        * vc.astype(jnp.float32),
+                              + jnp.sum(pexp * vcf,
                                         axis=0, keepdims=True))
             m_ref[0, i] = m_new
 
@@ -169,7 +194,8 @@ def _paged_kernel(tables_ref, pos_ref, q_ref, knew_ref, vnew_ref,
 def paged_decode_attention(q: jnp.ndarray, k_new: jnp.ndarray,
                            v_new: jnp.ndarray, k_pages: jnp.ndarray,
                            v_pages: jnp.ndarray, tables: jnp.ndarray,
-                           pos: jnp.ndarray, *, n_head: int) -> jnp.ndarray:
+                           pos: jnp.ndarray, *, n_head: int,
+                           k_scales=None, v_scales=None) -> jnp.ndarray:
     """Decode attention for one layer of a paged packed pool.
 
     q, k_new, v_new: (B, C) fresh merged rows; k_pages/v_pages:
@@ -177,13 +203,21 @@ def paged_decode_attention(q: jnp.ndarray, k_new: jnp.ndarray,
     tables: (B, max_pages) int32; pos: (B,) int32 logical positions.
     Returns the merged (B, C) attention output — bit-equivalent to
     scattering k_new/v_new at ``pos`` and attending positions <= pos.
+
+    ``k_scales``/``v_scales`` ((n_pages, page) f32, page granularity)
+    mark a QUANTIZED pool: the scale blocks ride the same page index
+    map and dequant inside the accumulation loop, and the caller
+    passes ``k_new``/``v_new`` already fake-quantized
+    (quant.kv.fake_quantize_rows) so the fresh column attends exactly
+    what the post-kernel scatter will store.
     """
     N, psz, C = k_pages.shape
     B, mp = tables.shape
     D = C // n_head
+    quantized = k_scales is not None
     kernel = functools.partial(
         _paged_kernel, n_head=n_head, head_dim=D, page_size=psz,
-        n_pages_per_slot=mp, scale=D ** -0.5)
+        n_pages_per_slot=mp, scale=D ** -0.5, quantized=quantized)
 
     def row_map(b, p, tables, pos):
         return (b, 0, 0)
@@ -202,12 +236,20 @@ def paged_decode_attention(q: jnp.ndarray, k_new: jnp.ndarray,
         scratch = [pltpu.VMEM((1, C), jnp.float32),
                    pltpu.VMEM((1, LANES), jnp.float32),
                    pltpu.VMEM((1, LANES), jnp.float32)]
+        in_specs = [row, row, row,
+                    _vmem_spec((None, psz, C), page_map),
+                    _vmem_spec((None, psz, C), page_map)]
+        inputs = [q[:, None, :], k_new[:, None, :], v_new[:, None, :],
+                  k_pages, v_pages]
+        if quantized:
+            in_specs += [_vmem_spec((None, psz, 1), page_map),
+                         _vmem_spec((None, psz, 1), page_map)]
+            inputs += [k_scales.reshape(N, psz, 1),
+                       v_scales.reshape(N, psz, 1)]
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, mp),
-            in_specs=[row, row, row,
-                      _vmem_spec((None, psz, C), page_map),
-                      _vmem_spec((None, psz, C), page_map)],
+            in_specs=in_specs,
             out_specs=row,
             scratch_shapes=scratch,
         )
@@ -216,8 +258,7 @@ def paged_decode_attention(q: jnp.ndarray, k_new: jnp.ndarray,
             out_shape=jax.ShapeDtypeStruct((B, 1, C), q.dtype),
             interpret=_interpret_mode(), **kw,
         )(jnp.asarray(tables, jnp.int32), jnp.asarray(pos, jnp.int32),
-          q[:, None, :], k_new[:, None, :], v_new[:, None, :],
-          k_pages, v_pages)
+          *inputs)
     else:  # pragma: no cover — pltpu-less installs are gated out by
         # paged_decode_supported; kept so an explicit call still errors
         # with a clear message instead of a pallas internals traceback

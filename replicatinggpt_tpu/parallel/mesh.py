@@ -272,6 +272,22 @@ def page_pool_pspec(cfg: ModelConfig, n_pages: int, data: int,
     return P(*axes)
 
 
+def page_scale_pspec(n_pages: int, data: int) -> P:
+    """PartitionSpec of a quantized pool's ``ks``/``vs`` scale arrays
+    ((L, n_pages, page[, H]) — quant/kv.py): the page axis shards over
+    'data' EXACTLY like the pool itself (``page_pool_pspec``'s d_ax
+    rule, divisibility drop included), so each chip stores the scale
+    rows of precisely the pages it stores; the remaining axes
+    replicate (scale metadata is ~1/C of the pool's bytes — sharding
+    its model dim buys nothing). Trailing Nones trimmed for the same
+    jit-cache-representation reason as the pool spec."""
+    d_ax = "data" if data > 1 and n_pages % data == 0 else None
+    axes = (None, d_ax)
+    while axes and axes[-1] is None:
+        axes = axes[:-1]
+    return P(*axes)
+
+
 @dataclass(frozen=True)
 class ServeShardings:
     """The sharding bundle threaded through every device program the
@@ -295,6 +311,11 @@ class ServeShardings:
     cache: NamedSharding
     rep: NamedSharding
     rep2: NamedSharding
+    #: quantized-pool scale arrays (``ks``/``vs`` — page axis over
+    #: 'data' via page_scale_pspec); present on every plan so the
+    #: static bundle's hash does not depend on whether quantization is
+    #: on (the pool dict's KEYS already key the programs)
+    scale: NamedSharding = None
 
 
 def serve_shardings(mesh: Mesh, cfg: ModelConfig, n_pages: int,
@@ -303,13 +324,23 @@ def serve_shardings(mesh: Mesh, cfg: ModelConfig, n_pages: int,
         cache=NamedSharding(mesh, page_pool_pspec(cfg, n_pages, data,
                                                   model)),
         rep=NamedSharding(mesh, P()),
-        rep2=NamedSharding(mesh, P(None, None)))
+        rep2=NamedSharding(mesh, P(None, None)),
+        scale=NamedSharding(mesh, page_scale_pspec(n_pages, data)))
 
 
-def serve_param_shardings(cfg: ModelConfig, mesh: Mesh, model: int) -> Any:
+def serve_param_shardings(cfg: ModelConfig, mesh: Mesh, model: int,
+                          params: Any = None) -> Any:
     """Decode-time parameter layout: Megatron TP over 'model',
     replicated over 'data' (the `shard_for_decode` rationale — no FSDP,
-    no pipe at decode)."""
+    no pipe at decode). ``params`` computes the specs from an ACTUAL
+    tree instead of the init_params abstract structure — the
+    weight-quantized tree (quant/weights.py) carries extra
+    ``<name>_scale`` leaves (replicated: no TP name match) and int8
+    kernels that keep their column/row TP dims by name."""
+    if params is not None:
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            state_pspecs(params, MeshConfig(model=model)))
     return jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s),
         param_pspecs(cfg, MeshConfig(model=model)))

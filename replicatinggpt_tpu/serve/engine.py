@@ -179,10 +179,41 @@ class EngineConfig:
                                 # (attention+MLP FLOPs multiplier);
                                 # params shard by the training TP specs,
                                 # replicated over 'data'
+    # --- quantization (replicatinggpt_tpu/quant/, the --kv-quant /
+    # --weight-quant knobs) ----------------------------------------------
+    kv_quant: str = "none"      # paged KV page storage: none|int8|fp8.
+                                # int8/fp8 pages + per-row scale
+                                # metadata halve bytes/page — at fixed
+                                # HBM that doubles n_pages, the
+                                # admission currency (size the pool
+                                # with pages.n_pages_for_hbm)
+    weight_quant: str = "none"  # block matmul kernels: none|int8|fp8,
+                                # absmax-per-output-channel scales with
+                                # dequant fused into the matmuls
+                                # (quant/weights.py; params quantize at
+                                # engine construction unless already
+                                # carrying scales from a serialized
+                                # calibration)
+    quant_granularity: str = "page"
+                                # KV scale granularity: 'page' = one
+                                # f32 scale per written row, 'head' =
+                                # one per (row, head) — tighter for
+                                # outlier heads at H x the metadata
+                                # (head granularity routes the XLA
+                                # gather path; kernels are page-gran)
 
     @property
     def mesh_shape(self) -> tuple:
         return (self.mesh_data, self.mesh_model)
+
+    def quant(self):
+        """The QuantConfig this engine runs under (validated)."""
+        from ..quant import QuantConfig
+        q = QuantConfig(kv_dtype=self.kv_quant,
+                        weight_dtype=self.weight_quant,
+                        granularity=self.quant_granularity)
+        q.validate()
+        return q
 
     def chunk(self, block_size: int) -> int:
         """Effective prefill chunk — see ``cache_pool.prefill_chunk_size``
@@ -430,20 +461,25 @@ def _engine_verify(params, window, pos, m, active, tables, cache, rngs,
          donate_argnames=("cache",))
 def _engine_page_copy(cache, src, dst, shardings=None):
     """Copy-on-write page split: duplicate physical page ``src`` into
-    ``dst`` across all layers of both pool arrays. One program for any
-    (src, dst) — both traced scalars — warmed at engine construction so
-    the first real COW mid-replay cannot cost a compile. The caller
-    bounds dst host-side (check_in_bounds below no-ops on tracers). On
-    a serving mesh the copy crosses data shards when src and dst land
-    on different chips — GSPMD inserts the collective; the output stays
-    pinned to the pool spec so the donated buffers alias."""
+    ``dst`` across all layers of EVERY pool array — the quantized
+    pool's ``ks``/``vs`` scale arrays share the page axis (axis 1), so
+    a COW split carries a page's scales with its rows for free. One
+    program for any (src, dst) — both traced scalars — warmed at
+    engine construction so the first real COW mid-replay cannot cost a
+    compile. The caller bounds dst host-side (check_in_bounds below
+    no-ops on tracers). On a serving mesh the copy crosses data shards
+    when src and dst land on different chips — GSPMD inserts the
+    collective; each output stays pinned to its entry's spec
+    (models.gpt.pool_entry_sharding) so the donated buffers alias."""
+    from ..models.gpt import pool_entry_sharding
     out = {}
     for name, arr in cache.items():
         check_in_bounds(dst, 1, arr.shape[1], what="COW page copy")
         page = jax.lax.dynamic_index_in_dim(arr, src, 1, keepdims=True)
         new = jax.lax.dynamic_update_slice_in_dim(arr, page, dst, axis=1)
         if shardings is not None:
-            new = jax.lax.with_sharding_constraint(new, shardings.cache)
+            new = jax.lax.with_sharding_constraint(
+                new, pool_entry_sharding(shardings, name))
         out[name] = new
     return out
 
@@ -525,6 +561,15 @@ class Engine:
         self.params = params
         self.cfg = cfg
         self.ecfg = ecfg
+        # quantization (replicatinggpt_tpu/quant/): weight-side params
+        # quantize HERE, before any mesh placement, unless the caller
+        # handed in an already-quantized tree (a serialized calibration
+        # applied at the CLI layer — quant/weights.py load_calibration)
+        self.qcfg = ecfg.quant()
+        if self.qcfg.weight_enabled:
+            from ..quant.weights import quantize_params
+            self.params = quantize_params(self.params,
+                                          self.qcfg.weight_dtype)
         self.clock = clock
         self.drafter = drafter
         self.tel = telemetry or NULL
@@ -567,7 +612,8 @@ class Engine:
                                          ecfg.mesh_data, ecfg.mesh_model)
             self.params = jax.device_put(
                 self.params,
-                serve_param_shardings(cfg, self.mesh, ecfg.mesh_model))
+                serve_param_shardings(cfg, self.mesh, ecfg.mesh_model,
+                                      params=self.params))
         self._rep = self._plan.rep if self._plan is not None else None
         self.pool = PagedCachePool(
             cfg, ecfg.pool_size, page_size=ecfg.page_size,
@@ -575,7 +621,10 @@ class Engine:
             prefix_cache=ecfg.prefix_cache, telemetry=self.tel,
             sharding=(self._plan.cache if self._plan is not None
                       else None),
-            mesh_shape=(ecfg.mesh_data, ecfg.mesh_model))
+            scale_sharding=(self._plan.scale if self._plan is not None
+                            else None),
+            mesh_shape=(ecfg.mesh_data, ecfg.mesh_model),
+            quant=(self.qcfg if self.qcfg.kv_enabled else None))
         self.scheduler = Scheduler(ecfg.max_queue, cfg.block_size,
                                    clock=clock)
         self.metrics = Metrics()
@@ -602,19 +651,28 @@ class Engine:
         # fallback when the layer weights don't fit its VMEM envelope.
         from ..ops import decode_pallas, paged_pallas
         itemsize = jnp.dtype(self.pool.cache["k"].dtype).itemsize
-        # (the mesh gate lives inside the two supported() calls below
-        # — ops.paged_pallas.paged_kernel_mesh_ok is the one seam)
+        # (the mesh AND quant gates live inside the two supported()
+        # calls below — ops.paged_pallas.paged_kernel_mesh_ok is the
+        # mesh seam; int8 page-granularity pools keep the kernels with
+        # in-kernel dequant, fp8/head-granularity route the XLA gather
+        # path. Weight-quantized params gate the kernels off entirely:
+        # their weight streams don't consume the per-channel scales —
+        # _wmm's fused dequant is an XLA-path construct.)
         kernel_ok = (ecfg.paged_kernel
                      and cfg.decode_cache_layout == "packed"
+                     and not self.qcfg.weight_enabled
                      and paged_pallas._paged_attn_backend_ok())
         self._use_fused = bool(
             kernel_ok and decode_pallas.fused_paged_decode_supported(
-                cfg, P, self.pool.page_size, itemsize, mesh=self.mesh))
+                cfg, P, self.pool.page_size, itemsize, mesh=self.mesh,
+                kv_quant=self.qcfg.kv_dtype,
+                granularity=self.qcfg.granularity))
         self._use_pallas = bool(
             kernel_ok and not self._use_fused
             and paged_pallas.paged_decode_supported(
                 cfg.n_head, cfg.head_dim, self.pool.page_size, itemsize,
-                mesh=self.mesh))
+                mesh=self.mesh, kv_quant=self.qcfg.kv_dtype,
+                granularity=self.qcfg.granularity))
         # mixed prefill+decode windows route the XLA gather path no
         # matter what the paged-kernel knob says: the Pallas kernels
         # above are single-token decode kernels

@@ -71,6 +71,34 @@ def pool_geometry(cfg: ModelConfig, n_slots: int, page_size: int = 0,
     return psz, mp, (n_pages or n_slots * mp)
 
 
+def page_bytes(cfg: ModelConfig, page_size: int, kv_quant: str = "none",
+               granularity: str = "page") -> int:
+    """HBM bytes ONE physical page occupies across all layers: K + V
+    rows at the storage dtype, plus the per-row f32 scale metadata a
+    quantized pool carries (quant/kv.py). This is the denominator of
+    the admission-capacity claim: page count is the admission currency,
+    so at a fixed HBM budget ``n_pages = budget // page_bytes`` — int8
+    storage roughly halves this number vs bf16 (2·C bytes/token
+    -> C + 8/page_size... the scale overhead is 8 bytes/token/layer at
+    page granularity), roughly doubling the pool."""
+    from ..quant.kv import kv_itemsize, scale_bytes_per_token
+    per_tok = (2 * cfg.n_embd * kv_itemsize(kv_quant, cfg)
+               + scale_bytes_per_token(kv_quant, granularity,
+                                       cfg.n_head))
+    return cfg.n_layer * page_size * per_tok
+
+
+def n_pages_for_hbm(hbm_bytes: int, cfg: ModelConfig, page_size: int,
+                    kv_quant: str = "none",
+                    granularity: str = "page") -> int:
+    """Physical pages a fixed HBM budget holds at the given KV storage
+    mode — the fixed-HBM capacity comparison the quantization A/B
+    (bench --quant-ab) and the pool-geometry acceptance test size
+    their pools with."""
+    return max(int(hbm_bytes) // page_bytes(cfg, page_size, kv_quant,
+                                            granularity), 1)
+
+
 class _RadixNode:
     __slots__ = ("id", "page", "parent", "key", "n_children", "last_use")
 
@@ -379,7 +407,8 @@ class PagedCachePool:
     def __init__(self, cfg: ModelConfig, n_slots: int, *,
                  page_size: int = 0, max_pages: int = 0, n_pages: int = 0,
                  prefix_cache: bool = True, dtype=None, telemetry=None,
-                 sharding=None, mesh_shape: Tuple[int, int] = (1, 1)):
+                 sharding=None, scale_sharding=None,
+                 mesh_shape: Tuple[int, int] = (1, 1), quant=None):
         """``sharding`` (a NamedSharding from
         ``parallel.mesh.serve_shardings().cache``) commits the page
         pool onto the serving mesh instead of one device: the physical
@@ -387,10 +416,21 @@ class PagedCachePool:
         ceil(n_pages / data) pages — the capacity multiplier) and the
         model dim over 'model'. All HOST state here (allocator, radix,
         tables) is mesh-agnostic: page ids are logical either way.
-        ``mesh_shape`` is carried for stats()/gauges only."""
+        ``mesh_shape`` is carried for stats()/gauges only.
+
+        ``quant`` (a quant.QuantConfig with ``kv_dtype`` set) stores
+        pages in int8/fp8 with per-row scale metadata riding the pool
+        dict (``ks``/``vs``) — halving bytes/page, which at fixed HBM
+        doubles the page count this pool can be sized with
+        (``n_pages_for_hbm``). ``scale_sharding``
+        (``ServeShardings.scale``) commits the scale arrays with their
+        page axis over 'data' alongside the pool's; every host-side
+        invariant (allocator, radix, COW planning) is byte-for-byte
+        unchanged — a page is its rows plus their scales."""
         assert n_slots >= 1, n_slots
         self.cfg = cfg
         self.n_slots = n_slots
+        self.quant = quant
         self.page_size, self.max_pages, self.n_pages = pool_geometry(
             cfg, n_slots, page_size, max_pages, n_pages)
         assert self.max_pages * self.page_size >= cfg.block_size, (
@@ -411,9 +451,15 @@ class PagedCachePool:
         self.alloc = PageAllocator(self.n_pages, self.page_size,
                                    prefix_cache=prefix_cache,
                                    telemetry=telemetry)
-        self.cache: Dict = commit_default(init_paged_kv_pool(
-            cfg, self.n_pages, self.page_size, dtype=dtype),
-            sharding=sharding)
+        pool = init_paged_kv_pool(cfg, self.n_pages, self.page_size,
+                                  dtype=dtype, quant=quant)
+        # per-entry placement: K/V take the pool spec, scale arrays
+        # (different rank) their own page-axis spec
+        self.cache: Dict = {
+            name: commit_default(
+                arr, sharding=(scale_sharding if name in ("ks", "vs")
+                               else sharding))
+            for name, arr in pool.items()}
         # host-mirrored, device-fed each step (fixed shape: the paged
         # programs never retrace on table contents)
         self.tables = np.zeros((n_slots, self.max_pages), np.int32)
@@ -545,10 +591,23 @@ class PagedCachePool:
         d = self._page_shards
         by_chip = a.in_use_by_block(d)
         per_chip = -(-self.n_pages // d)
+        kv_quant = (self.quant.kv_dtype
+                    if self.quant is not None and self.quant.kv_enabled
+                    else "none")
+        gran = (self.quant.granularity if kv_quant != "none" else "page")
         return {
             "page_size": self.page_size,
             "max_pages_per_slot": self.max_pages,
             "n_pages": self.n_pages,
+            # quantization gauges (ISSUE 15): bytes_per_page is the
+            # admission-capacity denominator the fixed-HBM A/B keys on;
+            # kv_quant_bits is the numeric Prometheus-friendly spelling
+            # of the mode (8 = quantized storage)
+            "kv_quant": kv_quant,
+            "quant_granularity": gran,
+            "bytes_per_page": page_bytes(self.cfg, self.page_size,
+                                         kv_quant, gran),
+            "kv_quant_bits": 8 * self.cache["k"].dtype.itemsize,
             "pages_in_use": a.pages_in_use,
             "pages_free": a.pages_free,
             "page_utilization": round(a.pages_in_use / self.n_pages, 4),

@@ -274,7 +274,10 @@ def run_replay(params, mcfg: ModelConfig, rcfg: ReplayConfig,
                 extra_gauges={k: pages[k] for k in
                               ("pages_in_use", "page_utilization",
                                "prefix_hit_rate", "radix_pages",
-                               "pages_per_chip", "aggregate_pages")
+                               "pages_per_chip", "aggregate_pages",
+                               # quantization gauges (ISSUE 15): the
+                               # capacity denominator + numeric mode
+                               "bytes_per_page", "kv_quant_bits")
                               if k in pages}))
         artifacts["metrics_out"] = metrics_out
     if profile_dir:
@@ -327,6 +330,11 @@ def format_summary(s: dict) -> str:
                             "reprobe"))))
     pg = s.get("pages")
     if pg:
+        if pg.get("kv_quant", "none") != "none":
+            lines.insert(2, (
+                f"quant: KV {pg['kv_quant']} "
+                f"({pg['quant_granularity']}-granularity scales), "
+                f"{pg['bytes_per_page']} bytes/page"))
         lines.insert(2, (
             f"pages: {pg['pages_in_use']}/{pg['n_pages']} in use "
             f"({pg['page_size']} tok/page, util "

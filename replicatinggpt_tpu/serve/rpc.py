@@ -128,7 +128,12 @@ def engine_shape_hash(mcfg, ecfg) -> str:
         "engine": {k: str(getattr(ecfg, k)) for k in
                    ("pool_size", "max_queue", "prefill_chunk",
                     "page_size", "max_pages", "n_pages", "prefix_cache",
-                    "decode_window", "mesh_data", "mesh_model")},
+                    "decode_window", "mesh_data", "mesh_model",
+                    # quantization knobs (quant/): a worker serving a
+                    # different KV/weight precision is a DIFFERENT
+                    # model numerically — mismatched fleets must
+                    # reject at registration, never mix streams
+                    "kv_quant", "weight_quant", "quant_granularity")},
     }
     return hashlib.sha256(
         json.dumps(doc, sort_keys=True).encode()).hexdigest()[:16]

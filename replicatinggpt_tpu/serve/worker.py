@@ -73,6 +73,19 @@ from .rpc import (JOURNAL_DRAIN_LIMIT, PROTO_VERSION,
                   result_to_wire, serve_connection)
 
 
+#: re-registration pacing (ROADMAP 3a remainder): a worker that
+#: registered once but then hears NOTHING from the router for
+#: REREGISTER_IDLE_S seconds assumes the router (or its listener)
+#: restarted and lost the attachment — it re-announces itself with
+#: bounded exponential backoff until a listener answers again. A
+#: healthy router drives the worker every step, so silence IS the
+#: signal; re-registering an already-attached worker is idempotent
+#: (the supervisor's handler re-attaches at the same gen).
+REREGISTER_IDLE_S = 5.0
+REREGISTER_BACKOFF_S = 0.5
+REREGISTER_BACKOFF_CAP_S = 10.0
+
+
 class WorkerServer:
     """Dispatch table around one engine (single-threaded: runs inside
     the asyncio loop, which is the worker's only thread of control)."""
@@ -85,6 +98,9 @@ class WorkerServer:
         self.clock = clock
         self.draining = False
         self.warmed = False
+        #: monotonic timestamp of the last inbound router RPC — the
+        #: re-registration loop's silence detector
+        self.last_contact = time.monotonic()
         self.stop_event = asyncio.Event()
         #: finished results not yet acked by the router — redelivered
         #: in every step response until an ack prunes them (a response
@@ -124,6 +140,7 @@ class WorkerServer:
         fn = getattr(self, f"op_{op}", None)
         if fn is None:
             raise ValueError(f"unknown op {op!r}")
+        self.last_contact = time.monotonic()
         return fn(doc)
 
     def _in_flight_ids(self) -> List[str]:
@@ -293,43 +310,97 @@ class WorkerServer:
         return {"stopping": True}
 
 
+async def _register_attempt(router_addr: str, doc: dict) -> dict:
+    """ONE register frame to the fleet's RpcListener. Returns the ok
+    response; raises :class:`RpcProtocolError` on a typed rejection
+    (a version/shape-mismatched build must exit, not retry) and
+    :class:`ConnectionError` on transport failure or any other
+    rejection (the caller owns the retry/backoff policy)."""
+    host, _, port = router_addr.rpartition(":")
+    writer = None
+    try:
+        reader, writer = await asyncio.open_connection(
+            host or "127.0.0.1", int(port))
+        writer.write(encode_frame({"op": "register", **doc}))
+        await writer.drain()
+        header = await asyncio.wait_for(reader.readexactly(4), 15.0)
+        body = await asyncio.wait_for(
+            reader.readexactly(decode_length(header)), 15.0)
+        resp = json.loads(body)
+    except RpcProtocolError:
+        raise
+    except (OSError, ValueError, asyncio.IncompleteReadError,
+            asyncio.TimeoutError, ConnectionError) as e:
+        raise ConnectionError(f"{type(e).__name__}: {e}") from e
+    finally:
+        if writer is not None:
+            writer.close()
+    if resp.get("ok"):
+        return resp
+    if resp.get("kind") == "protocol":
+        raise RpcProtocolError(resp.get("error", "protocol mismatch"))
+    raise ConnectionError(resp.get("error", "rejected"))
+
+
 async def _register_with_router(router_addr: str, doc: dict,
                                 budget_s: float = 120.0) -> dict:
-    """Announce this worker to the fleet: one ``register`` frame to the
-    supervisor's RpcListener, retried with backoff until the listener
-    answers (it polls from the router's single-threaded loop, so the
-    response may lag a tick). Transport failures retry; an ok=false
-    with ``kind="protocol"`` raises :class:`RpcProtocolError` — a
-    version/shape-mismatched build must exit, not retry."""
-    host, _, port = router_addr.rpartition(":")
+    """Startup registration: ``_register_attempt`` retried until the
+    listener answers (it polls from the router's single-threaded loop,
+    so the response may lag a tick). Transport failures retry;
+    :class:`RpcProtocolError` propagates — a mismatched build exits."""
     deadline = time.monotonic() + budget_s
     last = "no attempt"
     while time.monotonic() < deadline:
-        writer = None
         try:
-            reader, writer = await asyncio.open_connection(
-                host or "127.0.0.1", int(port))
-            writer.write(encode_frame({"op": "register", **doc}))
-            await writer.drain()
-            header = await asyncio.wait_for(reader.readexactly(4), 15.0)
-            body = await asyncio.wait_for(
-                reader.readexactly(decode_length(header)), 15.0)
-            resp = json.loads(body)
-            if resp.get("ok"):
-                return resp
-            if resp.get("kind") == "protocol":
-                raise RpcProtocolError(
-                    resp.get("error", "protocol mismatch"))
-            last = resp.get("error", "rejected")
-        except (OSError, ValueError, asyncio.IncompleteReadError,
-                asyncio.TimeoutError, ConnectionError) as e:
-            last = f"{type(e).__name__}: {e}"
-        finally:
-            if writer is not None:
-                writer.close()
+            return await _register_attempt(router_addr, doc)
+        except ConnectionError as e:
+            last = str(e)
         await asyncio.sleep(0.2)
     raise RuntimeError(
         f"registration with {router_addr} failed: {last}")
+
+
+async def _reregister_loop(worker, router_addr: str, doc: dict,
+                           idle_s: float = REREGISTER_IDLE_S,
+                           backoff_s: float = REREGISTER_BACKOFF_S,
+                           backoff_cap_s: float =
+                           REREGISTER_BACKOFF_CAP_S,
+                           on_reregister=None) -> None:
+    """Keep the worker attached across router restarts (ROADMAP 3a
+    remainder): the startup handshake registered exactly once, so a
+    router whose listener restarted (or whose process was replaced —
+    it recovers in-flight work from its OWN ledger, never worker disk)
+    would simply never drive this worker again. This loop watches for
+    SILENCE — no inbound RPC for ``idle_s`` — and re-sends the
+    register frame with bounded exponential backoff until a listener
+    answers; re-registering at the same gen is an idempotent re-attach
+    on the supervisor side. A typed protocol rejection stops the
+    worker (the fleet's expected shape changed under us — serving on
+    would split streams)."""
+    delay = backoff_s
+    while not worker.stop_event.is_set():
+        if time.monotonic() - worker.last_contact < idle_s:
+            # healthy traffic: reset the backoff and poll at half the
+            # idle threshold so silence is detected promptly
+            delay = backoff_s
+            await asyncio.sleep(idle_s / 2)
+            continue
+        try:
+            await _register_attempt(router_addr, doc)
+            worker.last_contact = time.monotonic()
+            delay = backoff_s
+            if on_reregister is not None:
+                on_reregister()
+        except RpcProtocolError as e:
+            print(f"re-registration REJECTED (protocol/shape "
+                  f"mismatch): {e}; stopping", file=sys.stderr)
+            worker.stop_event.set()
+            return
+        except ConnectionError:
+            delay = min(delay * 2, backoff_cap_s)
+        # attempts are spaced by the CURRENT backoff (not the idle
+        # poll), so a long outage really does decay to the cap
+        await asyncio.sleep(delay)
 
 
 def warm_engine(engine: Engine) -> None:
@@ -368,16 +439,17 @@ async def _run_async(worker: WorkerServer, host: str, port: int,
           f"pid={os.getpid()} gen={gen} idx={worker_idx} "
           f"shape={shape_hash} replayed={worker.n_replayed}",
           file=sys.stderr)
+    rereg_task = None
     if router_addr:
         # the server is ALREADY live: the supervisor's attach
         # (health/stream_drain/journal_drain RPCs) is served by this
         # same loop while the register coroutine awaits its response
+        reg_doc = {"port": bound[1], "pid": os.getpid(), "gen": gen,
+                   "worker_idx": worker_idx,
+                   "replayed": worker.n_replayed,
+                   "proto": PROTO_VERSION, "shape_hash": shape_hash}
         try:
-            await _register_with_router(router_addr, {
-                "port": bound[1], "pid": os.getpid(), "gen": gen,
-                "worker_idx": worker_idx,
-                "replayed": worker.n_replayed,
-                "proto": PROTO_VERSION, "shape_hash": shape_hash})
+            await _register_with_router(router_addr, reg_doc)
         except RpcProtocolError as e:
             print(f"registration REJECTED (protocol/shape mismatch): "
                   f"{e}", file=sys.stderr)
@@ -385,7 +457,25 @@ async def _run_async(worker: WorkerServer, host: str, port: int,
             await server.wait_closed()
             return 3
         print(f"registered with {router_addr}", file=sys.stderr)
+        worker.last_contact = time.monotonic()
+        # registration is no longer once-at-startup: the background
+        # loop re-announces this worker (bounded backoff) whenever the
+        # router goes silent — a RESTARTED router's fresh listener
+        # re-attaches us without an operator touching the worker
+        rereg_task = asyncio.ensure_future(_reregister_loop(
+            worker, router_addr, reg_doc,
+            idle_s=getattr(worker, "reregister_idle_s",
+                           REREGISTER_IDLE_S),
+            on_reregister=lambda: print(
+                f"re-registered with {router_addr} (router was "
+                f"silent)", file=sys.stderr)))
     await worker.stop_event.wait()
+    if rereg_task is not None:
+        rereg_task.cancel()
+        try:
+            await rereg_task
+        except asyncio.CancelledError:
+            pass
     server.close()
     await server.wait_closed()
     # let an in-flight shutdown response flush before the process exits
@@ -417,6 +507,15 @@ def run_worker(args) -> int:
     # worker owning its own --mesh-shape slice included
     from ..cli import engine_config_from_args
     ecfg = engine_config_from_args(args)
+    if ecfg.weight_quant != "none":
+        # serialized-calibration workflow (quant/weights.py): reuse
+        # the scales next to the checkpoint so every worker in the
+        # fleet serves the SAME quantized weights bit-for-bit
+        from ..quant.weights import prepare_params
+        state = state._replace(params=prepare_params(
+            state.params, cfg.model, ecfg.weight_quant,
+            checkpoint_dir=args.checkpoint_dir,
+            log=lambda m: print(m, file=sys.stderr)))
     engine = Engine(state.params, cfg.model, ecfg)
     warm_engine(engine)
 
@@ -427,6 +526,7 @@ def run_worker(args) -> int:
                                  lock=True)
         engine.journal = journal
     worker = WorkerServer(engine, journal)
+    worker.reregister_idle_s = getattr(args, "reregister_idle_s", 5.0)
     worker.warmed = True
     if args.journal:
         n = worker.replay_journal(args.journal)

@@ -1,0 +1,29 @@
+"""GL017 bad: dtype drift — a raw ref load mixed with a cast operand
+inside a kernel body (implicit upcast by the ref's storage dtype), and
+uncast scatter/dynamic_update_slice writes into pool-shaped arrays."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _drifty_kernel(q_ref, kp_ref, out_ref, *, scale):
+    # raw (possibly int8/bf16) ref load promoted by the OTHER side's
+    # explicit f32 cast — the compute precision is invisible here
+    s = kp_ref[...] * q_ref[...].astype(jnp.float32)
+    out_ref[...] = s
+
+
+def scatter_uncast(ck, k_m, layer, phys, woff):
+    # quantized pools store int8 rows: an uncast write promotes the
+    # buffer or rounds through the wrong dtype, silently
+    return ck.at[layer, phys, woff, :].set(k_m * 2.0, mode="drop")
+
+
+def scatter_uncast_bare_name(cv, v_m, layer, phys, woff):
+    # the most common spelling — a bare-name fresh row — is just as
+    # uncast (only dynamic_update_slice's page-copy idiom is exempt)
+    return cv.at[layer, phys, woff, :].set(v_m, mode="drop")
+
+
+def dus_uncast(cv, v_m, start):
+    return jax.lax.dynamic_update_slice(cv, v_m[None], start)

@@ -1,0 +1,30 @@
+"""GL017 good: every kernel-body ref load is bound with an explicit
+cast before it meets other operands, and every pool write casts its
+value to the target's dtype at the write site."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _explicit_kernel(q_ref, kp_ref, out_ref, *, scale):
+    kc = kp_ref[...].astype(jnp.float32)      # precision visible here
+    s = kc * q_ref[...].astype(jnp.float32)
+    out_ref[...] = s.astype(out_ref.dtype)
+
+
+def scatter_cast(ck, k_m, layer, phys, woff):
+    return ck.at[layer, phys, woff, :].set(
+        (k_m * 2.0).astype(ck.dtype), mode="drop")
+
+
+def dus_cast(cv, v_m, start):
+    assert start >= 0
+    return jax.lax.dynamic_update_slice(cv, v_m.astype(cv.dtype)[None],
+                                        start)
+
+
+def page_copy(cache, page, dst):
+    # a bare name re-write of the pool's own slice carries the dtype
+    # by construction (the COW page copy shape)
+    assert dst >= 0
+    return jax.lax.dynamic_update_slice(cache, page, dst)

@@ -215,6 +215,75 @@ def test_rpc_protocol_error_typed_over_wire():
     assert "protocol v99" in str(result["exc"])
 
 
+def test_worker_reregisters_after_listener_restart():
+    """ROADMAP 3a remainder, pinned: registration is no longer
+    once-at-startup. A worker whose router has gone SILENT (no inbound
+    RPC for the idle threshold) re-sends its register frame with
+    bounded backoff — and keeps retrying through the window where the
+    listener is DOWN entirely, so a restarted router's fresh listener
+    on the same port re-attaches it without operator action."""
+    import asyncio
+
+    from replicatinggpt_tpu.serve import worker as worker_mod
+
+    async def scenario():
+        lst = RpcListener()
+        port = lst.port
+        got = []
+
+        def handler(doc, peer):
+            got.append(dict(doc))
+            return {"idx": 0}
+
+        w = SimpleNamespace(stop_event=asyncio.Event(),
+                            last_contact=time.monotonic() - 100.0)
+        rereg = []
+        task = asyncio.ensure_future(worker_mod._reregister_loop(
+            w, f"127.0.0.1:{port}",
+            {"port": 1, "pid": 2, "gen": 1, "worker_idx": 0,
+             "replayed": 0, "proto": PROTO_VERSION, "shape_hash": "x"},
+            idle_s=0.2, backoff_s=0.05, backoff_cap_s=0.4,
+            on_reregister=lambda: rereg.append(time.monotonic())))
+        # phase 1: silence alone triggers a re-registration
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            lst.poll(handler)
+            await asyncio.sleep(0.02)
+        assert got, "no re-registration despite router silence"
+        assert got[0]["op"] == "register"
+        assert got[0]["gen"] == 1
+        # phase 2: the listener RESTARTS (close + rebind, same port);
+        # attempts in the gap fail with ConnectionError and back off
+        # (bounded), then the fresh listener gets a new register frame
+        lst.close()
+        w.last_contact = time.monotonic() - 100.0   # router still silent
+        await asyncio.sleep(0.3)                     # a few dead attempts
+        lst2 = RpcListener(port=port)
+        n0 = len(got)
+        deadline = time.monotonic() + 10
+        while len(got) <= n0 and time.monotonic() < deadline:
+            lst2.poll(handler)
+            await asyncio.sleep(0.02)
+            w.last_contact = min(w.last_contact,
+                                 time.monotonic() - 100.0)
+        lst2.close()
+        assert len(got) > n0, \
+            "no re-registration after the listener restarted"
+        assert len(rereg) >= 2
+        # a healthy router (recent contact) quiets the loop again
+        w.last_contact = time.monotonic()
+        n1 = len(got)
+        await asyncio.sleep(0.3)
+        assert len(got) == n1, "re-registered despite healthy traffic"
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(scenario())
+
+
 def test_engine_shape_hash_sensitivity():
     """The hash moves with anything that must agree fleet-wide (model
     arch, pool/page shape) and is stable across processes by

@@ -599,7 +599,15 @@ def test_metrics_summary_key_schema(params):
         # degenerate to the aggregate ones but the SCHEMA is mesh-
         # independent — dashboards and the router gauges never branch
         "mesh_shape", "aggregate_pages", "pages_per_chip",
-        "pages_in_use_by_chip", "page_utilization_by_chip"}
+        "pages_in_use_by_chip", "page_utilization_by_chip",
+        # quantization gauges (ISSUE 15): same schema quantized or not
+        # (values differ — pinned for a quantized engine in
+        # tests/test_quant.py); bytes_per_page is the fixed-HBM
+        # capacity denominator, kv_quant_bits the numeric mode gauge
+        "kv_quant", "quant_granularity", "bytes_per_page",
+        "kv_quant_bits"}
+    assert s["pages"]["kv_quant"] == "none"
+    assert s["pages"]["kv_quant_bits"] == 32      # f32 test pool
     assert s["pages"]["mesh_shape"] == [1, 1]
     assert s["pages"]["aggregate_pages"] == s["pages"]["n_pages"]
     assert s["pages"]["pages_per_chip"] == s["pages"]["n_pages"]

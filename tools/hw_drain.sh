@@ -25,6 +25,21 @@ timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
   --mesh-shape 2x2 \
   > benchmarks/BENCH_serve_window_2x2.json 2>> "$LOG"
 echo "=== serve-window-2x2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+# quantized-serving rows (ISSUE 15): int8 paged KV on the shared-
+# prefix trace at 1x1 and 2x2, plus the bf16-vs-int8 fixed-HBM
+# capacity/divergence A/B (quant_ab artifact block)
+timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
+  --kv-quant int8 --serve-prefix-trace \
+  > benchmarks/BENCH_serve_quant.json 2>> "$LOG"
+echo "=== serve-quant rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
+  --kv-quant int8 --serve-prefix-trace --mesh-shape 2x2 \
+  > benchmarks/BENCH_serve_quant_2x2.json 2>> "$LOG"
+echo "=== serve-quant-2x2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
+  --quant-ab --serve-prefix-trace \
+  > benchmarks/BENCH_serve_quant_ab.json 2>> "$LOG"
+echo "=== serve-quant-ab rc=$? $(date -u +%FT%TZ)" >> "$LOG"
 # elastic-fleet rows (ISSUE 14): host_loss chaos mid-run (journal +
 # workdir deleted, router-ledger recovery) and the autoscaler
 # load-step preset (scale-up/scale-down with zero drops)
