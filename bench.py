@@ -687,6 +687,185 @@ def bench_serve(args) -> None:
     })
 
 
+def _ttft_ms(results, lcfg, want_long, session_is_long, q=0.99):
+    """Percentile TTFT (ms) over the long or short slice of a fleet
+    replay's per-request results (request ids are ``s{sid:03d}t{k}``)."""
+    import numpy as np
+    vals = [r.ttft_s for r in results.values()
+            if r.ok and session_is_long(int(r.id[1:4]), lcfg) == want_long]
+    if not vals:
+        return 0.0
+    return round(float(np.quantile(np.asarray(vals), q)) * 1e3, 2)
+
+
+def bench_fleet_disagg_ab(args, cfg, lcfg, ecfg, dev) -> None:
+    """The disaggregation A/B (``--mode fleet --disagg``): the SAME
+    mixed long+short session trace through two fleets of equal worker
+    count — colocated (every replica prefills and decodes) vs
+    disaggregated (one prefill worker feeds N-1 decode workers over
+    ``page_transfer``). The claim under test: long prompts monopolize
+    colocated batch budget and spike short-prompt TTFT; pulling them
+    onto a prefill tier keeps the decode tier's windows dense, so
+    short-prompt TTFT p99 drops at identical capacity. The artifact's
+    ``disagg_ab`` block carries both arms' short/long TTFT, the
+    transfer-path counters + latency, and the token-identity bit
+    (greedy streams must match across arms — placement must never
+    change results).
+
+    On CPU both arms replay on the fleet's deterministic VIRTUAL step
+    clock (loadgen.StepClock, ``virtual_dt``): this box serializes all
+    replicas through one device (and CI containers are single-core),
+    so wall-clock TTFT here measures compute serialization identically
+    in both arms — not placement. Virtual TTFT counts router
+    scheduling steps — FIFO slot wait, chunked-prefill progress,
+    per-chunk transfer round-trips — which is precisely the structure
+    disaggregation changes, and is reproducible bit-for-bit run to
+    run. The real wall-clock row runs on TPU hardware
+    (tools/hw_drain.sh; benchmarks/RESULTS.md has it queued)."""
+    import dataclasses
+
+    import jax
+
+    from replicatinggpt_tpu.serve import RouterConfig, run_fleet_replay
+    from replicatinggpt_tpu.serve.loadgen import session_is_long
+    from replicatinggpt_tpu.train.state import create_train_state
+
+    block = cfg.model.block_size
+    n = args.fleet_replicas
+    if n < 2:
+        raise SystemExit("--disagg needs --fleet-replicas >= 2 "
+                         "(one prefill tier + at least one decode)")
+    # TTFT is a PROMPT-phase metric, so the A/B trace is prefill-heavy
+    # by construction: short decode budgets (slots turn over on prompt
+    # work, not decode), every 2nd session opening a unique
+    # near-block-size prompt — the largest prefill the trace can carry
+    max_new = min(lcfg.max_new_tokens, 4)
+    user_len = min(lcfg.user_len_max, 4)
+    long_len = max(block - lcfg.turns * (user_len + max_new),
+                   lcfg.prefix_len + 1)
+    lcfg = dataclasses.replace(lcfg, max_new_tokens=max_new,
+                               user_len_max=user_len,
+                               long_every=2, long_prefix_len=long_len)
+    # the two policy knobs that make the A/B measure what it claims:
+    # (1) only LONG prompts divert to the prefill tier — the tail
+    # threshold sits at half the long prompt, far above any short
+    # session's uncached pages; (2) both arms run a deliberately small
+    # pool, because the phenomenon under test IS saturation (an
+    # unsaturated colocated fleet admits every short instantly and
+    # there is nothing for disaggregation to win back)
+    min_tail = max(2, (long_len // ecfg.page_size) // 2)
+    # a small prefill chunk restores the accelerator's compute ratio on
+    # CPU: a real TPU's long-prompt prefill costs ~50x a decode step,
+    # but this CPU model's 64-token chunk costs about ONE decode step —
+    # chunking at 16 makes a near-block-size prompt many dispatches
+    # while shorts stay at 2-3, which is the asymmetry the prefill
+    # tier exists to absorb (both arms run the identical config)
+    # pool headroom on the PAGE axis only: an in-flight transfer pins
+    # the request's full prompt on the decode worker before it owns a
+    # slot, so the decode pool needs pages beyond pool_size * max_pages
+    # or transfers lose the pool race to admission (sink_refused)
+    # the windowed engine (decode_window > 1) paces prefill one chunk
+    # per window iteration — prompt length costs router STEPS in
+    # proportion, which the k=1 path hides (it prefills a whole prompt
+    # inside one step); pool_size=1 makes FIFO slot wait visible
+    # the page pool is sized EVICTION-FREE (worst-case every session
+    # resident on one replica, plus transfer-pin headroom): the two
+    # arms evict in different orders, and under KV quantization an
+    # evicted prefix does not recompute bit-identically (the original
+    # decode-path rows attended dequantized cache; the recomputed
+    # prefill rows attend fresh in-chunk values) — token identity
+    # across placements is only a meaningful invariant when neither
+    # arm evicts, and slot scarcity (pool_size=1), not page scarcity,
+    # is the saturation under test
+    max_pages = -(-block // ecfg.page_size)
+    pool = 1
+    ecfg = dataclasses.replace(ecfg, pool_size=pool, prefill_chunk=16,
+                               n_pages=(lcfg.n_sessions + pool + 2)
+                               * max_pages,
+                               decode_window=2,
+                               kv_quant=args.kv_quant)
+    # saturating arrivals: every session is queued almost immediately
+    # (in virtual time), so TTFT measures queueing structure, not
+    # arrival spacing
+    lcfg = dataclasses.replace(lcfg, rate=2000.0)
+    dt = 0.01                       # one router step = 10 virtual ms
+
+    state = create_train_state(jax.random.PRNGKey(0), cfg.model, cfg.train)
+
+    def arm(tiers, tag):
+        rcfg = RouterConfig(n_replicas=n, tiers=tiers,
+                            disagg_min_tail=min_tail)
+        t0 = time.time()
+        s = run_fleet_replay(state.params, cfg.model, lcfg, rcfg, ecfg,
+                             virtual_dt=dt, collect_streams=True)
+        log(f"{tag}: {s['n_completed']}/{s['n_requests']} turns in "
+            f"{time.time() - t0:.1f}s wall, short TTFT p99 "
+            f"{_ttft_ms(s['results'], lcfg, False, session_is_long)} "
+            f"virtual ms")
+        return s
+
+    log(f"disagg A/B: {lcfg.n_sessions} sessions (every 2nd opens "
+        f"{long_len}-tok unique prompt), {n} workers each arm")
+    colo = arm(None, "colocated")
+    dis = arm(("prefill",) + ("decode",) * (n - 1), "disagg")
+    identical = colo["streams"] == dis["streams"]
+
+    def side(s):
+        return {
+            "short_ttft_p50_ms": _ttft_ms(s["results"], lcfg, False,
+                                          session_is_long, 0.50),
+            "short_ttft_p99_ms": _ttft_ms(s["results"], lcfg, False,
+                                          session_is_long),
+            "long_ttft_p99_ms": _ttft_ms(s["results"], lcfg, True,
+                                         session_is_long),
+            "n_completed": s["n_completed"],
+            "wall_s": s["wall_s"],
+            "recompiles_after_warmup": s["recompiles_after_warmup"],
+        }
+
+    rc = dis["router"]
+    colo_p99 = _ttft_ms(colo["results"], lcfg, False, session_is_long)
+    dis_p99 = _ttft_ms(dis["results"], lcfg, False, session_is_long)
+    log(f"disagg A/B: short TTFT p99 {colo_p99} ms colocated -> "
+        f"{dis_p99} ms disagg, tokens_identical={identical}, "
+        f"{rc.get('fleet_transfers', 0)} transfers "
+        f"({rc.get('fleet_transfer_bytes', 0)} B)")
+    emit({
+        "metric": "fleet_disagg_short_ttft_p99_ms",
+        "value": dis_p99,
+        "unit": "virtual_ms",
+        "vs_baseline": colo_p99,
+        "device_kind": dev.device_kind,
+        "disagg_ab": {
+            "clock": f"virtual-step (dt={dt * 1e3:g} ms/router-step)",
+            "workers_per_arm": n,
+            "kv_quant": ecfg.kv_quant,
+            "tiers": {"prefill": 1, "decode": n - 1},
+            "trace": {"n_sessions": lcfg.n_sessions,
+                      "turns": lcfg.turns,
+                      "long_every": lcfg.long_every,
+                      "long_prefix_len": long_len},
+            "colocated": side(colo),
+            "disagg": {
+                **side(dis),
+                "disagg_prefills": rc.get("fleet_disagg_prefills", 0),
+                "shortcircuits":
+                    rc.get("fleet_disagg_shortcircuits", 0),
+                "fallbacks": rc.get("fleet_disagg_fallbacks", 0),
+                "transfers": rc.get("fleet_transfers", 0),
+                "transfer_pages": rc.get("fleet_transfer_pages", 0),
+                "transfer_bytes": rc.get("fleet_transfer_bytes", 0),
+                "transfer_failures":
+                    rc.get("fleet_transfer_failures", 0),
+                "transfer_p99_ms": round(
+                    dis["transfer_s"].get("p99", 0) * 1e3, 3),
+            },
+            "tokens_identical": identical,
+            "short_ttft_p99_improves": dis_p99 < colo_p99,
+        },
+    })
+
+
 def bench_fleet(args) -> None:
     """Fleet serving replay (serve/router.py + serve/loadgen.py):
     multi-turn session traffic through N engine replicas behind the
@@ -766,6 +945,9 @@ def bench_fleet(args) -> None:
                         max_queue=4 * args.fleet_sessions,
                         page_size=page_size,
                         n_pages=args.serve_n_pages)
+    if getattr(args, "disagg", False):
+        bench_fleet_disagg_ab(args, cfg, lcfg, ecfg, dev)
+        return
     n_initial = 1 if args.fleet_load_step else rcfg.n_replicas
     log(f"fleet replay: {lcfg.n_sessions} sessions x {lcfg.turns} turns "
         f"@ {lcfg.rate}/s{' (load-step x2 then /2)' if lcfg.load_step else ''} "
@@ -1342,6 +1524,15 @@ def main() -> None:
                         "artifact emits scale-up/scale-down counts, "
                         "peak/final worker counts and the zero-drop "
                         "verification")
+    p.add_argument("--disagg", action="store_true",
+                   help="--mode fleet: run the disaggregation A/B "
+                        "instead of the plain replay — the same mixed "
+                        "long+short trace through a colocated fleet "
+                        "and a 1-prefill/(N-1)-decode fleet at equal "
+                        "worker count; the artifact's disagg_ab block "
+                        "carries both arms' short-prompt TTFT, the "
+                        "page-transfer counters, and the greedy "
+                        "token-identity bit")
     p.add_argument("--fleet-journal-dir", default="",
                    help="--mode fleet: per-replica crash journals "
                         "(default: a temp dir)")

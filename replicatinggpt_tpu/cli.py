@@ -567,8 +567,13 @@ def cmd_serve(args) -> int:
                 log=lambda m: print(m, file=sys.stderr)))
         router = Router(state.params, cfg.model, rcfg, in_ecfg,
                         telemetry=telemetry)
+    rate_limit = None
+    if args.rate_limit_rps > 0:
+        from .serve.http import RateLimitConfig
+        rate_limit = RateLimitConfig(rps=args.rate_limit_rps,
+                                     burst=args.rate_limit_burst)
     app = ServeApp(router, idle_timeout_s=args.idle_timeout_s,
-                   supervisor=supervisor)
+                   supervisor=supervisor, rate_limit=rate_limit)
     rc = 0
     try:
         asyncio.run(app.serve_forever(args.host, args.port))
@@ -860,6 +865,14 @@ def main(argv=None) -> int:
                     help="drop a connection that stalls mid-headers/"
                          "body or stops consuming its SSE stream for "
                          "this long (slow-loris guard; 0 = off)")
+    pv.add_argument("--rate-limit-rps", type=float, default=0.0,
+                    help="per-client submit rate (token bucket keyed "
+                         "on the x-client-id header; over-rate submits "
+                         "get 429 + Retry-After; 0 = off)")
+    pv.add_argument("--rate-limit-burst", type=float, default=10.0,
+                    help="token-bucket capacity: submits a quiet "
+                         "client may burst before the sustained rate "
+                         "applies")
     pv.add_argument("--trace-out", default=None,
                     help="write a Perfetto trace (router + per-replica "
                          "tracks) at shutdown")
@@ -909,6 +922,15 @@ def main(argv=None) -> int:
                          "stale incarnation)")
     pw.add_argument("--no-fsync", action="store_true",
                     help="disable fsync-per-finish journal durability")
+    pw.add_argument("--tier", default="mixed",
+                    choices=["mixed", "prefill", "decode"],
+                    help="disaggregation role (serve/disagg.py), "
+                         "advertised at registration: 'prefill' "
+                         "workers take only prefill_only prompt work "
+                         "and export finished KV pages, 'decode' "
+                         "workers receive pages and own the streams, "
+                         "'mixed' (default) does both — the colocated "
+                         "fleet")
     pw.add_argument("--reregister-idle-s", type=float, default=5.0,
                     help="router-silence threshold before this worker "
                          "re-sends its register frame (bounded "

@@ -60,10 +60,18 @@ FLEET_STEP = "fleet/step"
 #: loadgen session-creation seam — fired once per session with the
 #: session index
 FLEET_SESSION = "fleet/session"
+#: disaggregated page-transfer seam (serve/disagg.py) — fired once per
+#: chunk round-trip inside a running transfer, with the chunk index
+FLEET_TRANSFER = "fleet/transfer"
 
 KIND_REPLICA_KILL = "replica_kill"
 KIND_REPLICA_WEDGE = "replica_wedge"
 KIND_HOT_KEY_SKEW = "hot_key_skew"
+#: mid-transfer host loss: at transfer chunk ``at``, replica
+#: ``int(arg)`` — either tier — dies and the transfer aborts the way
+#: a vanished host would (the router falls back to a full decode-tier
+#: prefill; streams stay exactly-once through the delivery ledger)
+KIND_TRANSFER_KILL = "transfer_kill"
 #: process-level chaos (multi-process fleet only; needs a supervisor)
 KIND_PROC_KILL = "proc_kill"
 KIND_PROC_HANG = "proc_hang"
@@ -76,6 +84,12 @@ def fleet_step_fault(step: int) -> Optional[Fault]:
     """The router's per-step seam: at most one fleet fault per step
     (None almost always — the no-plan fast path is one global read)."""
     return fire(FLEET_STEP, index=step)
+
+
+def transfer_fault(chunk_index: int) -> Optional[Fault]:
+    """The page-transfer per-chunk seam: at most one fault per chunk
+    round-trip (None almost always — one global read)."""
+    return fire(FLEET_TRANSFER, index=chunk_index)
 
 
 def session_skew(session_index: int) -> float:

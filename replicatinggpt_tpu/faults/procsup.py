@@ -324,6 +324,11 @@ class ProcSupervisor:
         gen = int(doc.get("gen", 0))
         port = int(doc["port"])
         pid = int(doc.get("pid", 0))
+        # disaggregation role + page geometry (serve/disagg.py): the
+        # worker advertises both; older workers default to the
+        # colocated "mixed" role
+        tier = str(doc.get("tier", "mixed"))
+        page_size = int(doc.get("page_size", 0))
         h = self._handle(idx) if idx >= 0 else None
         if h is not None:
             if gen != h.gen:
@@ -332,7 +337,8 @@ class ProcSupervisor:
                 raise ValueError(
                     f"stale generation {gen} (current {h.gen})")
             info = router.attach_replica(idx, port, pid=pid, gen=gen,
-                                         host=peer_host)
+                                         host=peer_host, tier=tier,
+                                         page_size=page_size)
             router.replicas[idx].restarts = h.restarts
             h.state = RUNNING
             h.pid = pid
@@ -354,7 +360,8 @@ class ProcSupervisor:
             step_timeout_s=router.rcfg.step_timeout_s)
         router.add_replica(rep)
         info = router.attach_replica(new_idx, port, pid=pid, gen=gen,
-                                     host=peer_host)
+                                     host=peer_host, tier=tier,
+                                     page_size=page_size)
         self.external.append(new_idx)
         self._event(f"external worker joined as replica {new_idx} "
                     f"(host {peer_host}, pid {pid})")
@@ -759,17 +766,21 @@ def _worker_env(env: Optional[dict]) -> dict:
 
 def make_worker_spec(idx: int, workdir: str, config_args: List[str],
                      engine_args: Optional[List[str]] = None,
-                     env: Optional[dict] = None) -> WorkerSpec:
+                     env: Optional[dict] = None,
+                     tier: str = "mixed") -> WorkerSpec:
     """One ``serve-worker`` spec with a PRIVATE working directory
     (journal.jsonl + worker.log inside it). Nothing outside the worker
     process reads the directory — the router reconciles over RPC —
-    and ``host_loss`` chaos deletes it wholesale."""
+    and ``host_loss`` chaos deletes it wholesale. ``tier`` is the
+    worker's disaggregation role (serve/disagg.py)."""
     os.makedirs(workdir, exist_ok=True)
     jpath = os.path.join(workdir, "journal.jsonl")
     log = os.path.join(workdir, "worker.log")
     cmd = [sys.executable, "-m", "replicatinggpt_tpu",
            "serve-worker", *config_args,
-           "--port", "0", "--journal", jpath, *(engine_args or [])]
+           "--port", "0", "--journal", jpath,
+           *(["--tier", tier] if tier != "mixed" else []),
+           *(engine_args or [])]
     return WorkerSpec(idx=idx, cmd=cmd, journal_path=jpath,
                       workdir=workdir, log_path=log,
                       env=_worker_env(env))
@@ -778,16 +789,23 @@ def make_worker_spec(idx: int, workdir: str, config_args: List[str],
 def make_worker_specs(n_workers: int, base_dir: str,
                       config_args: List[str],
                       engine_args: Optional[List[str]] = None,
-                      env: Optional[dict] = None) -> List[WorkerSpec]:
+                      env: Optional[dict] = None,
+                      tiers: Optional[List[str]] = None
+                      ) -> List[WorkerSpec]:
     """Specs for N ``serve-worker`` subprocesses, each in its own
     ISOLATED directory ``base_dir/worker{i}/`` — there is no shared
     journal directory anywhere in the fleet; ``base_dir`` is merely
     where this (single-machine) launcher happens to put the private
     dirs. ``config_args`` select the model (e.g. ``["--preset",
-    "test-tiny"]``); ``engine_args`` are pool/page knobs."""
+    "test-tiny"]``); ``engine_args`` are pool/page knobs; ``tiers``
+    assigns a disaggregation role per worker (None = all mixed)."""
+    if tiers is not None:
+        assert len(tiers) == n_workers, (tiers, n_workers)
     return [make_worker_spec(
         i, os.path.join(base_dir, f"worker{i}"), config_args,
-        engine_args, env) for i in range(n_workers)]
+        engine_args, env,
+        tier=(tiers[i] if tiers else "mixed"))
+        for i in range(n_workers)]
 
 
 def worker_spec_factory(base_dir: str, config_args: List[str],

@@ -1,0 +1,51 @@
+"""jax version-compat shims for the parallel layer.
+
+``shard_map`` graduated out of ``jax.experimental.shard_map`` into the
+top-level ``jax`` namespace (and its ``check_rep`` keyword was renamed
+``check_vma``) across jax releases. The call sites in this package are
+written against the modern spelling; on an older jax this module falls
+back to the experimental import and translates the keyword, so the
+sequence/tensor-parallel suites run on either side of the rename
+instead of dying with ``AttributeError: module 'jax' has no attribute
+'shard_map'`` at collection.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    @functools.wraps(_exp_shard_map)
+    def shard_map(f, *args, **kwargs):
+        # modern keyword on the old API: check_vma -> check_rep
+        if "check_vma" in kwargs and "check_rep" not in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _exp_shard_map(f, *args, **kwargs)
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        # pre-rename jax has no static accessor; psum of 1 over the
+        # axis is the classic spelling and constant-folds at trace time
+        return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax, "set_mesh"):
+    set_mesh = jax.set_mesh
+elif hasattr(jax.sharding, "use_mesh"):
+    set_mesh = jax.sharding.use_mesh
+else:
+    def set_mesh(mesh):
+        # a Mesh is itself a context manager activating its axis names
+        return mesh
+
+
+__all__ = ["shard_map", "set_mesh", "axis_size"]

@@ -41,6 +41,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import MeshConfig, ModelConfig
+from .compat import set_mesh
 
 # param-name → (tp_dim or None); dims are indices into the *unstacked* shape
 # (block params carry a leading layer dim handled by offset)
@@ -162,7 +163,7 @@ def shard_train_state(create_fn: Callable[[], Any], mesh: Mesh,
     parameter/optimizer shards (no host-side full copy)."""
     abstract = jax.eval_shape(create_fn)
     shardings = state_shardings(abstract, mesh, mesh_cfg)
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         return jax.jit(create_fn, out_shardings=shardings)()
 
 

@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import axis_size, shard_map
+
 from ..config import MeshConfig, ModelConfig
 
 
@@ -61,7 +63,7 @@ def _block_tp(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: ModelConfig,
                               _merge_heads, _split_heads)
 
     cd = x.dtype
-    tp = jax.lax.axis_size(tp_axis)
+    tp = axis_size(tp_axis)
     r_attn, r_drop1, r_drop2 = (jax.random.split(rng, 3)
                                 if rng is not None else (None, None, None))
     if r_attn is not None:
@@ -121,7 +123,7 @@ def _pp_local(x: jnp.ndarray, blocks: Dict[str, jnp.ndarray],
         # so an unfolded key would repeat the same mask on every shard).
         # NOT folded over 'model': activations are replicated across model
         # shards, so their dropout masks must agree.
-        shard_id = (jax.lax.axis_index("data") * jax.lax.axis_size("seq")
+        shard_id = (jax.lax.axis_index("data") * axis_size("seq")
                     + jax.lax.axis_index("seq"))
         rng = jax.random.fold_in(rng, shard_id)
 
@@ -232,7 +234,7 @@ def pipeline_blocks(x: jnp.ndarray, blocks, cfg: ModelConfig, *,
             lambda leaf: P(*(("pipe",) + (None,) * (leaf.ndim - 1))), blocks)
     rng_spec = None if rng is None else P()
 
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pp_local, cfg=cfg, train=train,
                           n_stages=n_stages, tp_sharded=tp_sharded),
         mesh=mesh,

@@ -49,6 +49,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import axis_size, shard_map
+
 from ..ops.attention import NEG_INF, uint8_inverted_dropout
 
 # per-hop q-chunk row bound: peak score-tile memory is
@@ -96,7 +98,7 @@ def _ring_local_flash(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     """
     from ..ops.flash_pallas import pallas_flash_chunk
 
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, Tl, D = q.shape
     q_off = idx * Tl
@@ -163,7 +165,7 @@ def _ring_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     if hop_impl not in ("auto", "flash", "einsum"):
         raise ValueError(f"hop_impl must be 'auto', 'flash' or 'einsum', "
                          f"got {hop_impl!r}")
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, Tl, D = q.shape
     if scale is None:
@@ -298,7 +300,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     """
     spec = P("data", "model", seq_axis, None)
     if not (train and dropout_rate > 0.0 and rng is not None):
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(_ring_local, axis_name=seq_axis, scale=scale,
                               hop_impl=hop_impl),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -306,14 +308,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         return fn(q, k, v)
 
     def body(q, k, v, key):
-        shard = (jax.lax.axis_index("data") * jax.lax.axis_size("model")
+        shard = (jax.lax.axis_index("data") * axis_size("model")
                  + jax.lax.axis_index("model"))
         return _ring_local(q, k, v, axis_name=seq_axis, scale=scale,
                            dropout_rate=dropout_rate,
                            rng=jax.random.fold_in(key, shard), train=True,
                            hop_impl=hop_impl)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, P()),
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, P()),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v, rng)
 

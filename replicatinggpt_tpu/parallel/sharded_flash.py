@@ -27,6 +27,8 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import axis_size, shard_map
+
 from ..ops.attention import full_causal_attention
 from ..ops.flash_attention import FLASH_MIN_T
 
@@ -43,7 +45,7 @@ def _local_attention(q, k, v, key=None, *, scale: Optional[float],
     if key is not None:
         shard = jax.lax.axis_index(batch_axis) if batch_axis else 0
         if head_axis:
-            shard = (shard * jax.lax.axis_size(head_axis)
+            shard = (shard * axis_size(head_axis)
                      + jax.lax.axis_index(head_axis))
         key = jax.random.fold_in(key, shard)
     return full_causal_attention(q, k, v, scale=scale, impl=impl,
@@ -114,10 +116,10 @@ def sharded_flash_attention(q, k, v, *, mesh: Mesh,
                               dropout_rate=dropout_rate, impl=impl,
                               batch_axis=batch_axis, head_axis=head_axis)
     if not (train and dropout_rate > 0.0 and rng is not None):
-        fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+        fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec, check_vma=False)
         return fn(q, k, v)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, P()),
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, P()),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v, rng)
 
@@ -179,10 +181,10 @@ def make_sharded_flash_attention_fn(mesh: Mesh,
                                       scale=scale,
                                       dropout_rate=dropout_rate)
             if not (train and dropout_rate > 0.0 and rng is not None):
-                fn = jax.shard_map(local, mesh=mesh, in_specs=(spec,),
+                fn = shard_map(local, mesh=mesh, in_specs=(spec,),
                                    out_specs=spec, check_vma=False)
                 return fn(qkv)
-            fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, P()),
+            fn = shard_map(local, mesh=mesh, in_specs=(spec, P()),
                                out_specs=spec, check_vma=False)
             return fn(qkv, rng)
 

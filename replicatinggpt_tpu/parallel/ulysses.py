@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import axis_size, shard_map
+
 from ..ops.attention import full_causal_attention
 
 
@@ -35,7 +37,7 @@ def _ulysses_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    key: Optional[jax.Array] = None, *,
                    axis_name: str, scale: Optional[float], impl: str,
                    dropout_rate: float = 0.0) -> jnp.ndarray:
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     H = q.shape[1]
     assert H % n == 0, (
         f"Ulysses needs local head count {H} divisible by seq axis {n} "
@@ -48,7 +50,7 @@ def _ulysses_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         # every device holds a distinct (batch, head-group) after the
         # all-to-all and emits only its own output shard, so masks
         # decorrelate over all three sharded axes
-        shard = ((jax.lax.axis_index("data") * jax.lax.axis_size("model")
+        shard = ((jax.lax.axis_index("data") * axis_size("model")
                   + jax.lax.axis_index("model")) * n
                  + jax.lax.axis_index(axis_name))
         key = jax.random.fold_in(key, shard)
@@ -79,10 +81,10 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                               scale=scale, impl=impl,
                               dropout_rate=dropout_rate)
     if not (train and dropout_rate > 0.0 and rng is not None):
-        fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+        fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec, check_vma=False)
         return fn(q, k, v)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, P()),
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, P()),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v, rng)
 

@@ -32,6 +32,13 @@
   redelivery, protocol-version + engine-shape-hash handshake with
   typed ``RpcProtocolError`` rejection, and the poll-driven
   ``RpcListener`` registration endpoint);
+- ``disagg``: disaggregated prefill/decode tiers — page
+  sources/sinks (in-process and RPC), the chunked ``TransferJob``
+  that ships a prefilled request's KV pages (storage-dtype bytes +
+  quant scales, no dequant) from a prefill worker to a decode
+  worker's pool via a warmed jitted install, and the router policy
+  that diverts long-tail prompts to the prefill tier
+  (docs/serving.md#disaggregation);
 - ``worker``: the worker process (`serve-worker` CLI) — one engine +
   an exclusively-locked PRIVATE crash journal, replayed at startup
   and streamed to the router over RPC, so a ``kill -9`` mid-decode
@@ -51,6 +58,9 @@ fleet-level faults (replica kill/wedge, hot-key skew) live behind
 """
 
 from .cache_pool import CachePool
+from .disagg import (LocalPageSink, LocalPageSource, RpcPageSink,
+                     RpcPageSource, TransferJob, TransferResult,
+                     transfer_prefix)
 from .engine import Engine, EngineConfig, compile_counts
 from .journal import JournalBusyError, RequestJournal
 from .loadgen import (SessionLoadConfig, StepClock, make_sessions,
@@ -76,4 +86,7 @@ __all__ = ["CachePool", "Engine", "EngineConfig", "compile_counts",
            "RemoteReplica", "Replica", "ReplicaBase", "Router",
            "RouterConfig", "RpcClient", "RpcDown", "RpcTimeout",
            "SessionLoadConfig", "StepClock", "make_sessions",
-           "run_fleet_replay", "session_request"]
+           "run_fleet_replay", "session_request",
+           "LocalPageSink", "LocalPageSource", "RpcPageSink",
+           "RpcPageSource", "TransferJob", "TransferResult",
+           "transfer_prefix"]

@@ -1,0 +1,359 @@
+"""Disaggregated prefill/decode: KV pages over the fleet RPC.
+
+Chunked prefill (serve/engine.py) made the prompt phase preemptible
+*within* one engine; this module makes it placeable *across* engines.
+A **prefill worker** chews the prompt through the ordinary chunked
+prefill program (the request carries ``prefill_only=True``, so the
+engine finishes it after the first decode token — the token that
+rewrites prompt position P-1 and finalizes the last full page — with
+``finish_reason="prefilled"``); the finished KV pages then ship to a
+**decode worker**, which scatters them into its own page pool through
+a construction-warmed install program and registers the chain into its
+radix. The next admission on the decode tier claims those pages
+through an ordinary prefix claim — the page-table rebase to local
+physical indices IS the radix claim, no new admission path — and the
+request decodes as if it had prefilled locally.
+
+Why split tiers at all: prefill is compute-bound and bursty (one long
+prompt monopolizes the batch budget for several windows), decode is
+latency-bound and steady. Colocating them makes every long prompt a
+TTFT spike for every short request behind it. Dedicated prefill
+workers absorb the bursts; the decode tier's windows stay dense with
+decode rows (bench.py ``--disagg`` measures exactly this: short-prompt
+TTFT p99 under a mixed long+short trace, disaggregated vs colocated at
+equal worker count).
+
+The moving parts, smallest to largest:
+
+- **source / sink adapters** — a common six-step protocol
+  (begin/chunk/end on the source, begin/chunk/commit-or-abort on the
+  sink) with two implementations each: ``Local*`` call an in-process
+  :class:`~.engine.Engine` directly (host numpy blocks, no
+  serialization — the in-process fleet's path), ``Rpc*`` speak the
+  ``page_transfer`` verb (serve/rpc.py) against a worker process,
+  base64 page blocks chunked under the frame bound. The two compose
+  freely: a remote prefill worker can feed an in-process decode
+  engine and vice versa — the driver never looks inside a block.
+- **:func:`transfer_prefix`** — the driver: pin on the source,
+  allocate+pin on the sink, stream chunks, commit into the sink's
+  radix, unpin both. Every failure path degrades to "prefix not
+  cached on the decode tier": the sink aborts (staged pages free, the
+  half-landed chain never enters the radix), the source unpins, and
+  the caller submits the original request for a full local prefill —
+  slower, never wrong.
+
+Wire safety: blocks are raw page bytes per pool entry — int8/fp8/bf16
+K/V rows AND the f32 per-row scale arrays of a quantized pool, which
+share the page axis and therefore ride the same uniform dict. Shapes
+and dtypes never cross the wire; both ends decode against their own
+pool's :func:`~.rpc.page_block_template`, and the engine-shape hash
+agreed at registration guarantees the templates match.
+
+The router (serve/router.py) owns placement and orchestration policy:
+which prompts go to the prefill tier, which decode worker receives the
+pages (prefix-affinity), the short-circuit when the decode tier
+already holds most of the prompt, and the telemetry/metrics around
+each transfer. This module is deliberately policy-free.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .rpc import (PAGE_CHUNK_BYTES, RpcError, page_block_from_wire,
+                  page_block_to_wire, page_block_template,
+                  page_wire_bytes)
+
+#: transfer seam for fault injection (faults/fleet.py): fired between
+#: chunk round-trips with the running chunk index, so chaos tests can
+#: kill a tier mid-transfer at a deterministic point. None = no chaos.
+TransferFault = Optional[Callable[[int], None]]
+
+#: what a dying endpoint looks like mid-transfer: RPC failures and
+#: raw transport errors, the router's ReplicaDownError (a
+#: RuntimeError — not imported, no serve.router cycle), a codec
+#: shape/length assert, and a missing-key state desync. All degrade
+#: to "transfer failed, prefill locally".
+XFER_ERRORS = (RpcError, OSError, RuntimeError, KeyError,
+               AssertionError)
+
+
+def _is_wire_block(block: dict) -> bool:
+    """Wire blocks carry base64 strings; local blocks carry ndarrays."""
+    return isinstance(next(iter(block.values())), str)
+
+
+# --------------------------------------------------------------- source
+
+
+class LocalPageSource:
+    """Export side against an in-process engine: pin the prompt's
+    radix-cached pages, page them out as host numpy blocks."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.template = page_block_template(engine.pool.cache)
+        self.page_bytes = page_wire_bytes(self.template)
+        self.pages_per_chunk = max(1, PAGE_CHUNK_BYTES // self.page_bytes)
+        self._sending: Dict[str, List[int]] = {}
+
+    def begin(self, key: str, prompt: np.ndarray, from_page: int) -> int:
+        pinned = self.engine.pool.pin_prefix(key, prompt)
+        send = pinned[from_page:]
+        if not send:
+            self.engine.pool.unpin(key)
+            return 0
+        self._sending[key] = send
+        return len(send)
+
+    def chunk(self, key: str, cursor: int, limit: int = 0):
+        send = self._sending[key]
+        take = min(self.pages_per_chunk, limit or self.pages_per_chunk)
+        batch = send[cursor:cursor + take]
+        blocks = self.engine.export_pages(batch)
+        nxt = cursor + len(batch)
+        return blocks, nxt, nxt >= len(send)
+
+    def end(self, key: str) -> None:
+        self._sending.pop(key, None)
+        self.engine.pool.unpin(key)      # tolerant of an absent pin
+
+
+class RpcPageSource:
+    """Export side against a worker process: the same three steps as
+    :class:`LocalPageSource`, spoken as ``page_transfer`` kinds. The
+    worker owns pinning and chunk sizing (it knows its own template);
+    blocks arrive as wire docs and stay wire — the sink decodes."""
+
+    def __init__(self, call: Callable[..., dict]):
+        #: ``call(op, **kwargs) -> response`` — the router passes its
+        #: replica's RpcClient.call (timeouts/reconnects included)
+        self.call = call
+        self.page_bytes = 0              # learned from export_begin
+
+    def begin(self, key: str, prompt: np.ndarray, from_page: int) -> int:
+        r = self.call("page_transfer", kind="export_begin", key=key,
+                      prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
+                      from_page=int(from_page))
+        self.page_bytes = int(r.get("page_bytes", 0))
+        return int(r["pages"])
+
+    def chunk(self, key: str, cursor: int, limit: int = 0):
+        r = self.call("page_transfer", kind="export_chunk", key=key,
+                      cursor=int(cursor), limit=int(limit))
+        return r["blocks"], int(r["cursor"]), bool(r["done"])
+
+    def end(self, key: str) -> None:
+        self.call("page_transfer", kind="export_end", key=key)
+
+
+# ----------------------------------------------------------------- sink
+
+
+class LocalPageSink:
+    """Install side against an in-process engine: allocate + pin fresh
+    physical pages, scatter arriving blocks through the warmed install
+    program, commit the chain into the radix."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.template = page_block_template(engine.pool.cache)
+        self._staged: Dict[str, dict] = {}
+
+    def begin(self, key: str, prompt: np.ndarray, from_page: int,
+              n_pages: int) -> bool:
+        taken = self.engine.pool.install_prefix(
+            key, np.asarray(prompt, np.int32).reshape(-1),
+            int(from_page), int(n_pages))
+        if taken is None:
+            return False
+        self._staged[key] = {"pages": taken, "cursor": 0}
+        return True
+
+    def chunk(self, key: str, blocks: list) -> None:
+        st = self._staged[key]
+        decoded = [page_block_from_wire(b, self.template)
+                   if _is_wire_block(b) else b for b in blocks]
+        pages = st["pages"][st["cursor"]:st["cursor"] + len(decoded)]
+        assert len(pages) == len(decoded), \
+            f"transfer {key!r}: more blocks than staged pages"
+        self.engine.install_pages(pages, decoded)
+        st["cursor"] += len(decoded)
+
+    def commit(self, key: str) -> int:
+        st = self._staged.pop(key)
+        if st["cursor"] != len(st["pages"]):
+            # short chain: blocks lost between begin and commit — free
+            # the staged pages rather than registering garbage
+            self.engine.pool.unpin(key)
+            return 0
+        return self.engine.pool.commit_install(key)
+
+    def abort(self, key: str) -> None:
+        self._staged.pop(key, None)
+        self.engine.pool.unpin(key)
+
+
+class RpcPageSink:
+    """Install side against a worker process. Blocks already in wire
+    form pass through untouched (remote->remote relays once through
+    the router, no decode in the middle); local numpy blocks are
+    encoded here."""
+
+    def __init__(self, call: Callable[..., dict]):
+        self.call = call
+
+    def begin(self, key: str, prompt: np.ndarray, from_page: int,
+              n_pages: int) -> bool:
+        r = self.call("page_transfer", kind="install_begin", key=key,
+                      prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
+                      from_page=int(from_page), n_pages=int(n_pages))
+        # "accepted", not "ok": the transport wraps every response in
+        # its own ok=true envelope and a nested "ok" would collide
+        return bool(r["accepted"])
+
+    def chunk(self, key: str, blocks: list) -> None:
+        wire = [b if _is_wire_block(b) else page_block_to_wire(b)
+                for b in blocks]
+        self.call("page_transfer", kind="install_chunk", key=key,
+                  blocks=wire)
+
+    def commit(self, key: str) -> int:
+        r = self.call("page_transfer", kind="install_commit", key=key)
+        return int(r["registered"])
+
+    def abort(self, key: str) -> None:
+        self.call("page_transfer", kind="install_commit", key=key,
+                  abort=True)
+
+
+# --------------------------------------------------------------- driver
+
+
+@dataclass
+class TransferResult:
+    """What one :func:`transfer_prefix` did, for the router's
+    telemetry span and Prometheus counters."""
+
+    ok: bool
+    pages: int = 0                 # pages landed AND radix-registered
+    wire_bytes: int = 0            # raw page bytes moved (pre-base64)
+    elapsed_s: float = 0.0
+    error: str = ""                # failure class, "" on success
+
+
+class TransferJob:
+    """A resumable transfer: the same begin/chunk/commit protocol as
+    :func:`transfer_prefix`, advanced ONE bounded chunk round-trip per
+    :meth:`step` call. The router keeps a list of active jobs and
+    steps each once per fleet scheduling iteration, so a multi-
+    megabyte transfer never stalls the loop that every other request's
+    TTFT is riding on — the stall ceiling per fleet step is one chunk
+    (``max_chunk_pages`` pages), not one transfer.
+
+    :meth:`step` returns ``None`` while in flight and the final
+    :class:`TransferResult` once — cleanup (sink abort on failure,
+    source unpin always) happens inside, exactly as the blocking
+    driver did it. ``fault`` fires before each chunk with the running
+    chunk index (the ``fleet/transfer`` chaos seam); anything it
+    raises takes the ordinary failure path."""
+
+    def __init__(self, source, sink, key: str, prompt: np.ndarray,
+                 from_page: int, fault: TransferFault = None,
+                 clock=time.monotonic, max_chunk_pages: int = 0):
+        self.source, self.sink = source, sink
+        self.key = key
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.from_page = int(from_page)
+        self.fault = fault
+        self.clock = clock
+        self.max_chunk_pages = int(max_chunk_pages)
+        self.t0 = clock()
+        self.result: Optional[TransferResult] = None
+        self._state = "begin"
+        self._cursor = 0
+        self._chunk_idx = 0
+        self._sink_begun = False
+        self._src_begun = False
+
+    def _finish(self, ok: bool, pages: int = 0,
+                error: str = "") -> TransferResult:
+        if not ok and self._sink_begun:
+            try:
+                self.sink.abort(self.key)
+            except XFER_ERRORS:
+                pass                  # sink gone: pins die with it
+        if self._src_begun:
+            try:
+                self.source.end(self.key)
+            except XFER_ERRORS:
+                pass                  # source gone: pin died with it
+        self._state = "done"
+        self.result = TransferResult(
+            ok=ok, pages=pages,
+            wire_bytes=pages * int(getattr(self.source, "page_bytes",
+                                           0)),
+            elapsed_s=self.clock() - self.t0, error=error)
+        return self.result
+
+    def step(self) -> Optional[TransferResult]:
+        if self.result is not None:
+            return self.result
+        try:
+            if self._state == "begin":
+                n = self.source.begin(self.key, self.prompt,
+                                      self.from_page)
+                self._src_begun = n > 0
+                if n <= 0:
+                    return self._finish(False, error="no_pages")
+                if not self.sink.begin(self.key, self.prompt,
+                                       self.from_page, n):
+                    return self._finish(False, error="sink_refused")
+                self._sink_begun = True
+                self._state = "stream"
+                return None
+            # stream: one chunk round-trip, committing right after the
+            # last chunk lands (both are sink-side ops — no extra step)
+            if self.fault is not None:
+                self.fault(self._chunk_idx)
+            blocks, self._cursor, done = self.source.chunk(
+                self.key, self._cursor, self.max_chunk_pages)
+            self.sink.chunk(self.key, blocks)
+            self._chunk_idx += 1
+            if not done:
+                return None
+            registered = self.sink.commit(self.key)
+            self._sink_begun = False     # commit consumed the staging
+            if registered <= 0:
+                return self._finish(False, error="commit_raced")
+            return self._finish(True, pages=registered)
+        except XFER_ERRORS as e:
+            return self._finish(False, error=type(e).__name__)
+
+
+def transfer_prefix(source, sink, key: str, prompt: np.ndarray,
+                    from_page: int, fault: TransferFault = None,
+                    clock=time.monotonic,
+                    max_chunk_pages: int = 0) -> TransferResult:
+    """Move prompt pages ``from_page..`` from ``source`` to ``sink``,
+    blocking until done — a :class:`TransferJob` driven to completion.
+
+    ``from_page`` is the page count the sink already holds (the
+    placement probe's ``cached_prefix_tokens // page_size``) — only the
+    uncached tail crosses the wire. Returns a :class:`TransferResult`;
+    ``ok=False`` means the decode tier holds nothing new and the caller
+    must fall back to a full local prefill (correctness never depends
+    on a transfer landing). The source pin is always released, even
+    when the sink half fails; a failed sink is aborted best-effort
+    (an unreachable sink's pins die with its process)."""
+    job = TransferJob(source, sink, key, prompt, from_page,
+                      fault=fault, clock=clock,
+                      max_chunk_pages=max_chunk_pages)
+    while True:
+        r = job.step()
+        if r is not None:
+            return r

@@ -109,8 +109,9 @@ from ..utils.sanitize import CompileGuard, check_in_bounds, sanitize_enabled
 from ..utils.telemetry import ENGINE_TRACK, NULL, SLOT_TRACK_BASE
 from .pages import PagedCachePool
 from .requests import (FINISH_CANCELLED, FINISH_DEADLINE, FINISH_EOS,
-                       FINISH_LENGTH_CAP, FINISH_MAX_TOKENS, FINISH_SHED,
-                       REJECT_BAD_REQUEST, Request, RequestResult)
+                       FINISH_LENGTH_CAP, FINISH_MAX_TOKENS,
+                       FINISH_PREFILLED, FINISH_SHED, REJECT_BAD_REQUEST,
+                       Request, RequestResult)
 from .scheduler import Scheduler
 from .speculative import (DraftContext, Drafter, spec_accept_and_sample,
                           timed_draft)
@@ -484,6 +485,50 @@ def _engine_page_copy(cache, src, dst, shardings=None):
     return out
 
 
+@jax.jit
+def _engine_page_export(pool_entries, src):
+    """Disaggregated transfer, source side (serve/disagg.py): slice
+    physical page ``src`` out of every pool entry — K/V rows at the
+    storage dtype AND the quantized pool's per-row scale arrays, which
+    share the page axis (axis 1), so an int8/fp8 page's scales leave
+    with its rows for free. One program for any page (``src`` traced),
+    warmed at engine construction next to the COW copy; the caller
+    batches every requested page's dispatch before its single
+    ``device_get`` sync. A READ of the pool, never an update — the
+    pool must survive, so nothing donates (hence ``pool_entries``,
+    not the update programs' donated ``cache``)."""
+    return {name: jax.lax.dynamic_index_in_dim(arr, src, 1, keepdims=True)
+            for name, arr in pool_entries.items()}
+
+
+@partial(jax.jit, static_argnames=("shardings",),
+         donate_argnames=("cache",))
+def _engine_page_install(cache, dst, blocks, shardings=None):
+    """Disaggregated transfer, destination side: scatter one
+    transferred page's blocks (the exact per-entry slices
+    ``_engine_page_export`` produced, round-tripped through the RPC
+    byte codec) into physical page ``dst`` of the local pool. Same
+    shape/dtype discipline as the COW copy — ``dst`` is a traced
+    scalar and the blocks are fixed-shape, so installing any page into
+    any slot of the pool is ONE compiled program, warmed at engine
+    construction (a transfer mid-traffic can never cost a compile).
+    The table rebase the tentpole names happens host-side: installed
+    pages enter the local radix (``PagedCachePool.commit_install``)
+    and the next admission's claim maps logical prompt pages to these
+    LOCAL physical indices through the ordinary chain walk."""
+    from ..models.gpt import pool_entry_sharding
+    out = {}
+    for name, arr in cache.items():
+        check_in_bounds(dst, 1, arr.shape[1], what="page install")
+        new = jax.lax.dynamic_update_slice_in_dim(arr, blocks[name], dst,
+                                                  axis=1)
+        if shardings is not None:
+            new = jax.lax.with_sharding_constraint(
+                new, pool_entry_sharding(shardings, name))
+        out[name] = new
+    return out
+
+
 def engine_summary_block(engine: "Engine") -> dict:
     """The per-replica block of the fleet summary — ONE definition
     consumed by both sides of the process boundary (the in-process
@@ -517,6 +562,8 @@ def compile_counts() -> Dict[str, int]:
             "prefill": _engine_prefill._cache_size(),
             "verify": _engine_verify._cache_size(),
             "page_copy": _engine_page_copy._cache_size(),
+            "page_export": _engine_page_export._cache_size(),
+            "page_install": _engine_page_install._cache_size(),
             "draft_decode": _draft_decode_k._cache_size(),
             "draft_prefill": _draft_prefill._cache_size()}
 
@@ -759,12 +806,29 @@ class Engine:
         self._prefill_guard = CompileGuard(_engine_prefill, "serve/prefill")
         self._verify_guard = CompileGuard(_engine_verify, "serve/verify")
         self._copy_guard = CompileGuard(_engine_page_copy, "serve/page-copy")
+        self._export_guard = CompileGuard(_engine_page_export,
+                                          "serve/page-export")
+        self._install_guard = CompileGuard(_engine_page_install,
+                                           "serve/page-install")
         # warm the COW program NOW (page 0 onto itself — a value no-op):
         # the first real copy-on-write happens mid-replay, where a
         # compile would break the pinned-flat compile_counts invariant
         self.pool.cache = self._copy_guard(self.pool.cache, jnp.int32(0),
                                            jnp.int32(0),
                                            shardings=self._plan)
+        # warm the disaggregated-transfer pair the same way: export page
+        # 0, round-trip its blocks through host memory (matching the
+        # live path's placement — uncommitted uploads — so the warm
+        # program IS the steady-state program), install them back onto
+        # page 0. A value no-op; the first real transfer lands
+        # mid-traffic on either tier.
+        blocks = {name: np.asarray(arr) for name, arr in
+                  self._export_guard(self.pool.cache,
+                                     jnp.int32(0)).items()}
+        self.pool.cache = self._install_guard(
+            self.pool.cache, jnp.int32(0),
+            {name: jnp.asarray(arr) for name, arr in blocks.items()},
+            shardings=self._plan)
         if self._window > 1:
             # compile every bucketed window program up front (masked
             # no-op dispatches) — admissions, lifecycle masks and
@@ -891,6 +955,49 @@ class Engine:
         router closes a killed replica's open request envelopes on the
         right tracks."""
         return self._tb + SLOT_TRACK_BASE + slot
+
+    # ------------------------------------------- disaggregated transfer
+
+    def export_pages(self, pages: List[int]) -> List[Dict[str, np.ndarray]]:
+        """Fetch physical pages to host memory for a cross-tier
+        transfer (serve/disagg.py): one warmed jitted slice per page,
+        each a dict of per-entry blocks — K/V rows plus any quantized
+        scale rows, exactly what ``install_pages`` scatters on the far
+        side. Every page's slice is dispatched before the single
+        ``device_get`` sync fetches the whole batch. The caller pins
+        the pages first (``pool.pin_prefix``) so LRU eviction cannot
+        recycle one mid-copy."""
+        out = []
+        for p in pages:
+            check_in_bounds(int(p), 1, self.pool.n_pages,
+                            what="page export")
+            out.append(self._export_guard(self.pool.cache, jnp.int32(p)))
+        self.pool.pages_exported += len(pages)
+        return jax.device_get(out)
+
+    def install_pages(self, pages: List[int],
+                      blocks: List[Dict[str, np.ndarray]]) -> None:
+        """Scatter transferred page blocks into local physical pages
+        (allocated + pinned by ``pool.install_prefix``) through the
+        construction-warmed install program — zero recompiles, any
+        traffic. Shapes/dtypes must match this pool's entries exactly;
+        the engine-shape hash both tiers agreed on at registration
+        guarantees that, and the assert keeps a codec bug loud."""
+        cache = self.pool.cache
+        for p, blk in zip(pages, blocks):
+            check_in_bounds(int(p), 1, self.pool.n_pages,
+                            what="page install")
+            dev = {}
+            for name, arr in cache.items():
+                want = (arr.shape[0], 1) + tuple(arr.shape[2:])
+                b = blk[name]
+                assert b.shape == want and b.dtype == arr.dtype, (
+                    f"page block {name!r}: got {b.shape}/{b.dtype}, "
+                    f"pool wants {want}/{arr.dtype}")
+                dev[name] = jnp.asarray(b)
+            cache = self._install_guard(cache, jnp.int32(p), dev,
+                                        shardings=self._plan)
+        self.pool.cache = cache
 
     @property
     def idle(self) -> bool:
@@ -1171,7 +1278,9 @@ class Engine:
                                "mixed": self._mixed_guard.stats(),
                                "prefill": self._prefill_guard.stats(),
                                "verify": self._verify_guard.stats(),
-                               "page_copy": self._copy_guard.stats()}
+                               "page_copy": self._copy_guard.stats(),
+                               "page_export": self._export_guard.stats(),
+                               "page_install": self._install_guard.stats()}
         # paged-pool health: bench dashboards key on this block (schema
         # pinned in tests/test_pages.py)
         s["pages"] = self.pool.stats()
@@ -1234,7 +1343,13 @@ class Engine:
         """Decode budget for a request: decode step i runs at position
         P-1+i (the first rewrites the last prompt position), so a slot
         supports S - P + 1 new tokens before the write position would
-        leave the logical buffer."""
+        leave the logical buffer. A ``prefill_only`` request budgets
+        exactly ONE decode token — enough to rewrite position P-1 and
+        finalize the last full prompt page for registration — so the
+        prefill tier reserves prompt pages only, never a decode
+        budget's worth."""
+        if req.prefill_only:
+            return 1
         return min(req.max_new_tokens,
                    self.pool.seq_len - int(req.prompt.size) + 1)
 
@@ -2002,6 +2117,16 @@ class Engine:
                      device_stopped: bool = False,
                      masked: bool = False) -> RequestResult:
         st = self._slots.pop(slot)
+        if st.req.prefill_only and reason in (
+                FINISH_MAX_TOKENS, FINISH_LENGTH_CAP, FINISH_EOS):
+            # disaggregated prefill completed: the prompt's full pages
+            # are final (the 1-token budget rewrote position P-1) and
+            # registered for export; the envelope closes migrated — the
+            # decode tier's segment is the terminal one. Deadline /
+            # cancel / shed outcomes keep their reason: those ARE
+            # terminal for the request.
+            reason = FINISH_PREFILLED
+            migrated = True
         self._active[slot] = False
         self._deadline[slot] = np.inf
         self._adm_mask[slot] = False

@@ -53,6 +53,15 @@ completes its headers (slow-loris), stalls mid-body, or stops
 consuming its SSE stream is dropped after ``idle_timeout_s`` — a
 handler task and its buffers are capacity, and a peer that is not
 making progress does not get to pin them forever.
+
+Per-client rate limiting (:class:`RateLimitConfig`): the submit paths
+(``/v1/submit``, ``/v1/generate``) meter a token bucket per client id
+(the ``x-client-id`` header; missing header = one shared anonymous
+bucket) BEFORE parsing the body — an over-rate client gets 429 with a
+``Retry-After`` header and never costs a JSON parse or a router
+submit. This is *fairness* backpressure (one greedy tenant must not
+consume every queue slot), distinct from the Scheduler's *capacity*
+backpressure (a full queue 429s everyone).
 """
 
 from __future__ import annotations
@@ -60,7 +69,9 @@ from __future__ import annotations
 import asyncio
 import itertools
 import json
-from typing import Optional, Tuple
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -91,6 +102,63 @@ _STATUS_TEXT = {200: "OK", 202: "Accepted", 400: "Bad Request",
                 429: "Too Many Requests",
                 500: "Internal Server Error", 503: "Service Unavailable",
                 504: "Gateway Timeout"}
+
+
+@dataclass(frozen=True)
+class RateLimitConfig:
+    """Per-client token-bucket sizing for the submit paths. ``rps`` is
+    the sustained refill rate (0 disables the limiter entirely);
+    ``burst`` the bucket capacity — how many submits a quiet client may
+    fire back-to-back. ``header`` names the client-id header; a request
+    without it shares one anonymous bucket (anonymous traffic competes
+    with itself, never with identified tenants). ``max_clients`` bounds
+    the bucket table — the id is an UNTRUSTED string, and without a cap
+    a peer minting fresh ids per request would grow the table without
+    limit."""
+
+    rps: float = 0.0
+    burst: float = 10.0
+    header: str = "x-client-id"
+    max_clients: int = 4096
+
+
+class _TokenBuckets:
+    """The bucket table: lazily-refilled continuous token buckets keyed
+    by client id. ``take`` returns 0.0 on admit (one token consumed) or
+    the seconds until a token accrues (the Retry-After value). Stale
+    entries (fully refilled = client gone quiet) are reclaimed when the
+    table hits ``max_clients``; if every entry is active the OLDEST
+    refill is dropped — an attacker minting ids can only evict its own
+    churn, an active tenant's bucket refills on its next request at
+    worst."""
+
+    def __init__(self, cfg: RateLimitConfig, clock):
+        self.cfg = cfg
+        self.clock = clock
+        self._b: Dict[str, Tuple[float, float]] = {}  # id -> (tokens, t)
+
+    def take(self, client: str) -> float:
+        now = self.clock()
+        tokens, t = self._b.get(client, (self.cfg.burst, now))
+        tokens = min(self.cfg.burst,
+                     tokens + (now - t) * self.cfg.rps)
+        if tokens >= 1.0:
+            if client not in self._b and \
+                    len(self._b) >= self.cfg.max_clients:
+                self._evict(now)
+            self._b[client] = (tokens - 1.0, now)
+            return 0.0
+        self._b[client] = (tokens, now)
+        return (1.0 - tokens) / max(self.cfg.rps, 1e-9)
+
+    def _evict(self, now: float) -> None:
+        full = [k for k, (tok, t) in self._b.items()
+                if tok + (now - t) * self.cfg.rps >= self.cfg.burst]
+        if full:
+            for k in full:
+                del self._b[k]
+            return
+        del self._b[min(self._b, key=lambda k: self._b[k][1])]
 
 
 def request_from_json(body: dict, default_id: str, clock,
@@ -150,12 +218,16 @@ class ServeApp:
 
     def __init__(self, router: Router, idle_sleep_s: float = 0.002,
                  step_wait_s: float = 0.5,
-                 idle_timeout_s: float = 30.0, supervisor=None):
+                 idle_timeout_s: float = 30.0, supervisor=None,
+                 rate_limit: Optional[RateLimitConfig] = None):
         self.router = router
         self.idle_sleep_s = idle_sleep_s
         self.step_wait_s = step_wait_s
         self.idle_timeout_s = idle_timeout_s
         self.supervisor = supervisor
+        self.rate_limit = rate_limit
+        self._buckets = (_TokenBuckets(rate_limit, router.clock)
+                         if rate_limit and rate_limit.rps > 0 else None)
         self._vocab: Optional[int] = None
         self._ids = itertools.count()
         self._running = False
@@ -290,7 +362,7 @@ class ServeApp:
         body = b""
         if n:
             body = await reader.readexactly(n)
-        return method, path, body
+        return method, path, body, headers
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -316,9 +388,9 @@ class ServeApp:
                 return
             if req is None:
                 return
-            method, path, body = req
+            method, path, body, headers = req
             await self._dispatch(method, path.split("?", 1)[0], body,
-                                 writer)
+                                 writer, headers)
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -329,7 +401,23 @@ class ServeApp:
                 pass
 
     async def _dispatch(self, method: str, path: str, body: bytes,
-                        writer: asyncio.StreamWriter) -> None:
+                        writer: asyncio.StreamWriter,
+                        headers: Optional[dict] = None) -> None:
+        if (self._buckets is not None and method == "POST"
+                and path in ("/v1/submit", "/v1/generate")):
+            client = (headers or {}).get(self.rate_limit.header,
+                                         "") or "anonymous"
+            wait_s = self._buckets.take(client)
+            if wait_s > 0:
+                self.router.metrics.inc("http_rate_limited")
+                await self._json(
+                    writer, 429,
+                    {"error": "rate limited",
+                     "client": client,
+                     "retry_after_s": round(wait_s, 3)},
+                    extra_headers={"Retry-After":
+                                   str(max(1, math.ceil(wait_s)))})
+                return
         if path == "/healthz" and method == "GET":
             # liveness: answering at all IS the signal — always 200
             await self._json(writer, 200, self.router.healthz())
@@ -492,17 +580,22 @@ class ServeApp:
                 self.router.cancel(rid)
                 self._abandoned.add(rid)
 
-    async def _json(self, writer, status: int, obj: dict) -> None:
+    async def _json(self, writer, status: int, obj: dict,
+                    extra_headers: Optional[dict] = None) -> None:
         await self._raw(writer, status,
                         (json.dumps(obj) + "\n").encode(),
-                        "application/json")
+                        "application/json", extra_headers)
 
     async def _raw(self, writer, status: int, payload: bytes,
-                   ctype: str) -> None:
+                   ctype: str,
+                   extra_headers: Optional[dict] = None) -> None:
+        extra = "".join(f"{k}: {v}\r\n"
+                        for k, v in (extra_headers or {}).items())
         writer.write(
             f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, '')}\r\n"
             f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(payload)}\r\n"
+            f"{extra}"
             f"Connection: close\r\n\r\n".encode())
         writer.write(payload)
         await writer.drain()

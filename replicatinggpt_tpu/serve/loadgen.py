@@ -69,6 +69,21 @@ class SessionLoadConfig:
     #: draws, phase-scaled — seeded and deterministic like everything
     #: else here.
     load_step: bool = False
+    #: mixed long+short traffic (the disaggregation A/B trace,
+    #: ``bench.py --mode fleet --disagg``): every ``long_every``-th
+    #: session (sid % long_every == 0) opens with a UNIQUE
+    #: ``long_prefix_len``-token prompt instead of its group prefix —
+    #: no radix sharing, a guaranteed full prefill that monopolizes
+    #: prompt budget. 0 disables. Both A/B arms replay the same lcfg,
+    #: so the long/short mix is identical by construction.
+    long_every: int = 0
+    long_prefix_len: int = 0
+
+
+def session_is_long(sid: int, lcfg: SessionLoadConfig) -> bool:
+    """Whether session ``sid`` is a long-prompt session under the
+    mixed trace rule (bench partitions TTFT by this)."""
+    return lcfg.long_every > 0 and sid % lcfg.long_every == 0
 
 
 @dataclass
@@ -169,7 +184,9 @@ def make_sessions(mcfg: ModelConfig, lcfg: SessionLoadConfig
     times (Poisson), per-turn user token draws. The ``hot_key_skew``
     chaos seam is consulted per session — with a plan installed, a
     session collapses onto group 0 with the planned probability."""
-    worst = (lcfg.prefix_len
+    worst_prefix = max(lcfg.prefix_len,
+                       lcfg.long_prefix_len if lcfg.long_every else 0)
+    worst = (worst_prefix
              + lcfg.turns * (lcfg.user_len_max + lcfg.max_new_tokens))
     assert worst <= mcfg.block_size, (
         f"session worst-case context {worst} exceeds block_size "
@@ -202,8 +219,13 @@ def make_sessions(mcfg: ModelConfig, lcfg: SessionLoadConfig
                                  lcfg.user_len_max + 1))
             turns.append(rng.integers(0, mcfg.vocab_size, (n,),
                                       dtype=np.int64).astype(np.int32))
-        out.append(_Session(sid=sid, group=group,
-                            context=prefixes[group].copy(),
+        if session_is_long(sid, lcfg):
+            ctx = rng.integers(0, mcfg.vocab_size,
+                               (lcfg.long_prefix_len,),
+                               dtype=np.int64).astype(np.int32)
+        else:
+            ctx = prefixes[group].copy()
+        out.append(_Session(sid=sid, group=group, context=ctx,
                             user_turns=turns, due_t=starts[sid]))
     return out
 

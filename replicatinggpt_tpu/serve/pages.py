@@ -474,6 +474,14 @@ class PagedCachePool:
         # flush_pending can never pre-register a page a still-flying
         # window is writing
         self._deferred: set = set()
+        # disaggregation (serve/disagg.py): pages refcount-pinned under
+        # a transfer key — an export pin keeps radix prefix pages alive
+        # while their bytes stream out; an install pin holds freshly
+        # allocated pages until commit_install registers them
+        self._pins: Dict[str, List[int]] = {}
+        self._installs: Dict[str, Tuple[np.ndarray, int, List[int]]] = {}
+        self.pages_exported = 0
+        self.pages_installed = 0
 
     # ---------------------------------------------------------- geometry
 
@@ -578,6 +586,105 @@ class PagedCachePool:
     def slot_of(self, request_id: str) -> Optional[int]:
         return self._slot_by_request.get(request_id)
 
+    # ------------------------------------------- disaggregated transfer
+    #
+    # The page-level API serve/disagg.py moves KV between tiers with.
+    # Export side: a prefill worker's finished prompt pages live in its
+    # radix as refcount-0 prefix cache — pin_prefix refcounts them for
+    # the duration of the copy-out so LRU eviction cannot reclaim a
+    # page mid-transfer. Install side: install_prefix allocates fresh
+    # physical pages (pinned, so nothing evicts them before their
+    # bytes land), the engine's jitted scatter writes the transferred
+    # blocks, and commit_install registers the chain into the local
+    # radix keyed by the prompt's token bytes — after which a NORMAL
+    # admission claims the prefix exactly like a locally warmed one
+    # (table rebase to local physical indices is the radix chain
+    # itself). Every failure path degrades to "prefix not cached":
+    # the request re-prefills locally, token-identically.
+
+    def pin_prefix(self, key: str, prompt: np.ndarray) -> List[int]:
+        """Refcount-pin the radix-cached full prompt pages of
+        ``prompt`` under ``key``; returns the physical pages in prefix
+        order (possibly empty). Pin keys are single-owner: re-pinning
+        an active key is a bug."""
+        assert key not in self._pins, f"transfer pin {key!r} already held"
+        chain = self.alloc.radix.lookup(
+            np.asarray(prompt, np.int32).reshape(-1), self.page_size,
+            touch=True)
+        pages = [n.page for n in chain]
+        for p in pages:
+            self.alloc.ref[p] += 1
+        self._pins[key] = pages
+        return pages
+
+    def unpin(self, key: str) -> None:
+        """Drop a transfer pin (export finished, or install aborted).
+        Pages whose refcount hits 0 return to the free list unless the
+        radix holds them — same discipline as claim release."""
+        self._installs.pop(key, None)
+        for p in self._pins.pop(key, []):
+            self.alloc.ref[p] -= 1
+            assert self.alloc.ref[p] >= 0, f"page {p} pin underflow"
+            if self.alloc.ref[p] == 0 and p not in self.alloc.page_node:
+                self.alloc._free.append(p)
+
+    def install_prefix(self, key: str, prompt: np.ndarray,
+                       from_page: int,
+                       n_pages: int) -> Optional[List[int]]:
+        """Allocate ``n_pages`` fresh physical pages (pinned under
+        ``key``) to receive transferred KV blocks for prompt pages
+        ``from_page .. from_page+n_pages``. Requires the local radix to
+        already hold the first ``from_page`` pages (the chain the
+        placement probe saw) — if that prefix shrank since (eviction),
+        returns None and the caller falls back to local prefill."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        chain = self.alloc.radix.lookup(prompt, self.page_size,
+                                        touch=True)
+        if len(chain) < from_page:
+            return None
+        protect = {n.page for n in chain}
+        taken: List[int] = []
+        for _ in range(n_pages):
+            if not self.alloc._free and \
+                    self.alloc._evict_one(protect) is None:
+                for p in taken:                      # unwind: no pin
+                    self.alloc.ref[p] = 0
+                    self.alloc._free.append(p)
+                return None
+            p = self.alloc._free.pop()
+            self.alloc.ref[p] = 1
+            taken.append(p)
+        self._pins[key] = list(taken)
+        self._installs[key] = (prompt.copy(), int(from_page), taken)
+        return taken
+
+    def commit_install(self, key: str) -> int:
+        """Register an installed chain into the radix (the transferred
+        blocks are known landed — the caller sequences this after the
+        scatter's result is committed) and drop the pin. Returns the
+        number of pages that entered the radix; pages whose edge
+        already existed (a concurrent local prefill won the race) stay
+        private and free with the pin."""
+        prompt, g0, pages = self._installs.pop(key)
+        psz = self.page_size
+        chain = self.alloc.radix.lookup(prompt, psz, touch=True)
+        if len(chain) < g0 or not self.alloc.prefix_cache:
+            self.unpin(key)
+            return 0
+        parent = chain[g0 - 1].id if g0 else RadixIndex.ROOT
+        registered = 0
+        for i, page in enumerate(pages):
+            g = g0 + i
+            node, inserted = self.alloc.radix.insert(
+                parent, prompt[g * psz:(g + 1) * psz].tobytes(), page)
+            if inserted:
+                self.alloc.page_node[page] = node
+                registered += 1
+            parent = node.id
+        self.pages_installed += registered
+        self.unpin(key)
+        return registered
+
     # ----------------------------------------------------------- metrics
 
     def stats(self) -> dict:
@@ -627,4 +734,8 @@ class PagedCachePool:
                                 if a.prompt_tokens else 0.0),
             "evictions": a.evictions,
             "cow_copies": a.cow_copies,
+            # disaggregated transfer counters (serve/disagg.py)
+            "pages_exported": self.pages_exported,
+            "pages_installed": self.pages_installed,
+            "transfer_pins": len(self._pins),
         }

@@ -50,6 +50,16 @@ FINISH_DEADLINE = "deadline"            # deadline expired (at submit,
 FINISH_CANCELLED = "cancelled"          # caller cancelled (queued or active)
 FINISH_SHED = "shed"                    # dropped by overload shedding
                                         # (faults.watchdog.LoadShedder)
+FINISH_PREFILLED = "prefilled"          # prefill-tier completion of a
+                                        # ``prefill_only`` request: the
+                                        # prompt's KV pages are warm in
+                                        # this engine's radix, ready for
+                                        # export (serve/disagg.py); NOT
+                                        # a client-visible terminal —
+                                        # the fleet router diverts it
+                                        # into the page transfer and the
+                                        # decode tier produces the real
+                                        # stream
 REJECT_QUEUE_FULL = "rejected_queue_full"      # backpressure at submit
 REJECT_PROMPT_TOO_LONG = "rejected_prompt_too_long"  # prompt > block_size
 REJECT_BAD_REQUEST = "rejected_bad_request"    # empty prompt / bad lengths
@@ -78,6 +88,13 @@ class Request:
     #: max_new_tokens. Must be a valid vocab id — the engine rejects
     #: out-of-range values at submit.
     eos_token_id: Optional[int] = None
+    #: disaggregated prefill (serve/disagg.py): run the prompt through
+    #: admission + chunked prefill normally, finish after the FIRST
+    #: decode token (which rewrites prompt position P-1, finalizing the
+    #: last full page for radix registration), report finish_reason
+    #: ``prefilled`` with the telemetry envelope closed ``migrated`` —
+    #: a non-terminal segment; the decode tier owns the stream.
+    prefill_only: bool = False
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

@@ -91,20 +91,24 @@ from __future__ import annotations
 
 import os
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..faults.fleet import (KIND_HOST_LOSS, KIND_PROC_HANG,
                             KIND_PROC_KILL, KIND_REPLICA_KILL,
-                            KIND_REPLICA_WEDGE, fleet_step_fault)
+                            KIND_REPLICA_WEDGE, KIND_TRANSFER_KILL,
+                            fleet_step_fault, transfer_fault)
 from ..utils.jsonl import load_jsonl_if_exists
 from ..utils.logging import Metrics
 from ..utils.telemetry import (ENGINE_TRACK, NULL, REPLICA_TRACK_STRIDE,
                                ROUTER_TRACK, ROUTER_TRACK_NAME)
+from .disagg import (LocalPageSink, LocalPageSource, RpcPageSink,
+                     RpcPageSource, TransferJob)
 from .journal import RequestJournal
 from .requests import (FINISH_CANCELLED, FINISH_DEADLINE,
-                       REJECT_BAD_REQUEST, REJECT_PROMPT_TOO_LONG,
-                       REJECT_QUEUE_FULL, Request, RequestResult)
+                       FINISH_PREFILLED, REJECT_BAD_REQUEST,
+                       REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL,
+                       Request, RequestResult)
 from .rpc import (REJECT_REPLICA_DOWN, RpcClient, RpcDown, RpcError,
                   RpcTimeout, request_from_wire, request_to_wire,
                   result_from_wire)
@@ -181,6 +185,24 @@ class RouterConfig:
     #: call abandons and the elapsed time feeds the wedge probe. A hung
     #: (SIGSTOPped) worker costs the router this much per step, bounded.
     step_timeout_s: float = 10.0
+    #: IN-PROCESS disaggregation (serve/disagg.py): per-replica tier
+    #: labels ("prefill" / "decode" / "mixed"), one per replica index.
+    #: None = every replica "mixed" (the colocated fleet — placement is
+    #: unchanged). Worker processes advertise their tier at
+    #: registration instead (serve/worker.py ``--tier``).
+    tiers: Optional[Tuple[str, ...]] = None
+    #: two-tier placement threshold: a prompt whose UNCACHED tail on
+    #: the best decode-tier replica is fewer than this many full pages
+    #: short-circuits the prefill tier entirely (the transfer would
+    #: cost more than prefilling the tail locally). Prefix-hot traffic
+    #: therefore never leaves the decode tier.
+    disagg_min_tail: int = 2
+    #: page-transfer pacing: each active transfer advances by at most
+    #: this many pages per router step (one chunk round-trip). The
+    #: scheduling loop's stall ceiling per step is one chunk — a large
+    #: transfer spreads across steps instead of freezing the fleet.
+    #: 0 = whole frame-bound chunks (rpc.PAGE_CHUNK_BYTES).
+    transfer_chunk_pages: int = 8
 
 
 @dataclass
@@ -205,6 +227,21 @@ class _Requeue:
     #                            latency = resubmit accept - this)
 
 
+@dataclass
+class _Transfer:
+    """An in-flight disaggregated page transfer: the router advances
+    ``job`` one chunk per fleet step (:meth:`Router._advance_transfers`)
+    and resubmits ``req`` to the decode tier when it lands."""
+
+    job: object                # disagg.TransferJob
+    req: Request
+    t_submit: float            # the ORIGINAL submit time (TTFT base)
+    attempts: int
+    src_idx: int
+    dst_idx: int
+    t0_us: float = 0.0         # telemetry span base
+
+
 class ReplicaBase:
     """The router-side replica contract: health state every backend
     shares, plus the host-API verbs the router drives. ``Replica``
@@ -213,6 +250,11 @@ class ReplicaBase:
     hedged re-route and the delivery ledger are backend-agnostic."""
 
     is_local = True
+    #: page geometry for disaggregated placement (serve/disagg.py) —
+    #: 0 = unknown (two-tier placement disabled toward this replica).
+    #: Local replicas read their engine's pool; remote ones learn it
+    #: from the registration handshake.
+    page_size = 0
 
     def __init__(self, idx: int, journal_path: Optional[str]):
         self.idx = idx
@@ -225,6 +267,10 @@ class ReplicaBase:
         self.quarantine_until = 0
         self.last_step_s = 0.0
         self.steps = 0
+        #: disaggregation role: "prefill" takes only prefill_only
+        #: work, "decode" and "mixed" take sessions ("mixed" is the
+        #: colocated default — both roles)
+        self.tier = "mixed"
 
     # ------------------------------------------------------ router state
 
@@ -310,11 +356,16 @@ class Replica(ReplicaBase):
 
     def __init__(self, idx: int, engine, journal_path: Optional[str],
                  journal: Optional[RequestJournal],
-                 skip_steps: int = 0):
+                 skip_steps: int = 0, tier: str = "mixed"):
         super().__init__(idx, journal_path)
         self.engine = engine
         self.journal = journal
         self.skip_steps = skip_steps
+        self.tier = tier
+
+    @property
+    def page_size(self) -> int:
+        return self.engine.pool.page_size
 
     def submit(self, req: Request) -> Optional[RequestResult]:
         return self.engine.submit(req)
@@ -700,6 +751,10 @@ class Router:
                                         f"worker{rep.idx}")
         else:
             assert rcfg.n_replicas >= 1, rcfg.n_replicas
+            if rcfg.tiers is not None:
+                assert len(rcfg.tiers) == rcfg.n_replicas, (
+                    f"tiers {rcfg.tiers} vs n_replicas "
+                    f"{rcfg.n_replicas}")
             from .engine import Engine, EngineConfig
             ecfg = ecfg or EngineConfig()
             for i in range(rcfg.n_replicas):
@@ -717,10 +772,15 @@ class Router:
                              track_label=f"replica{i} ")
                 self.replicas.append(Replica(
                     idx=i, engine=eng, journal_path=jpath, journal=jr,
-                    skip_steps=rcfg.wedge_skip_steps))
+                    skip_steps=rcfg.wedge_skip_steps,
+                    tier=(rcfg.tiers[i] if rcfg.tiers else "mixed")))
         self.n_steps = 0
         self._inflight: Dict[str, _InFlight] = {}
         self._requeue: List[_Requeue] = []
+        #: in-flight disaggregated page transfers, each advanced one
+        #: chunk per step — the request lives HERE between its prefill-
+        #: tier finish and its decode-tier resubmission
+        self._transfers: List[_Transfer] = []
         #: id -> replica whose engine-surfaced terminal result must be
         #: swallowed (hedged re-route cancelled that copy on that
         #: replica; keyed by replica so the LIVE copy's finish on a
@@ -811,7 +871,7 @@ class Router:
         # its restart, and the fleet must keep stepping (retry ladder,
         # supervisor ticks ride the driver) until they resolve.
         return (not self._requeue and not self._router_finished
-                and not self._inflight
+                and not self._inflight and not self._transfers
                 and all(r.engine_idle for r in self.replicas if r.alive))
 
     @property
@@ -888,6 +948,7 @@ class Router:
                     out.append(done)
             self._probe(rep, step_idx)
 
+        self._advance_transfers(now)
         self._observe_ttft(now)
         self._drain_requeue(step_idx)
         if self._router_finished:   # terminals recorded DURING this
@@ -1014,7 +1075,9 @@ class Router:
     def attach_replica(self, idx: int, port: int,
                        pid: Optional[int] = None,
                        gen: Optional[int] = None,
-                       host: Optional[str] = None) -> dict:
+                       host: Optional[str] = None,
+                       tier: Optional[str] = None,
+                       page_size: Optional[int] = None) -> dict:
         """(Re)connect a remote replica and reconcile the router's
         in-flight ledger against what the restarted worker actually
         recovered from its journal (shipped over the ``journal_drain``
@@ -1037,6 +1100,13 @@ class Router:
         rep = self.replicas[idx]
         assert isinstance(rep, RemoteReplica), "attach is remote-only"
         rep.connect(port, pid=pid, gen=gen, host=host)
+        if tier is not None:
+            # the worker's advertised disaggregation role + page
+            # geometry (registration doc) — a restarted worker may
+            # come back with a different role
+            rep.tier = tier
+        if page_size:
+            rep.page_size = int(page_size)
         h = rep.refresh_health()
         rep.stream_drain()
         worker_ids = set(h.get("in_flight", []))
@@ -1157,8 +1227,13 @@ class Router:
             "n_replicas": len(self.replicas),
             "n_alive": self.n_alive,
             "n_steps": self.n_steps,
+            "tiers": {t: sum(1 for r in self.replicas if r.tier == t)
+                      for t in sorted({r.tier
+                                       for r in self.replicas})},
             "router": {k: int(v) for k, v in sorted(c.items())},
             "fleet_ttft_s": self.metrics.hist_summary("fleet_ttft_s"),
+            "transfer_s": self.metrics.hist_summary(
+                "fleet_transfer_s"),
             "requeue_latency_s": self.metrics.hist_summary(
                 "fleet_requeue_latency_s"),
             "aggregate_prefix_hit_rate": (
@@ -1230,8 +1305,14 @@ class Router:
     def _candidates(self, req: Request
                     ) -> List[Tuple[ReplicaBase, int]]:
         """(replica, cached-prefix-tokens) pairs to try, best first:
-        longest cached prefix, then least load, then index (stable)."""
-        avail = [r for r in self.replicas if r.routable]
+        longest cached prefix, then least load, then index (stable).
+        Dedicated prefill-tier replicas never take sessions — unless
+        they are the only thing left alive (a decode tier lost whole
+        still beats dropping requests; slower, never wrong)."""
+        avail = [r for r in self.replicas
+                 if r.routable and r.tier != "prefill"]
+        if not avail:
+            avail = [r for r in self.replicas if r.routable]
         if not avail:
             # a fully wedged fleet still beats dropping the request on
             # the floor: route to a wedged-but-alive replica (never a
@@ -1249,7 +1330,16 @@ class Router:
     def _submit_routed(self, req: Request, t_submit: float,
                        attempts: int) -> Optional[RequestResult]:
         """Try every candidate replica once, in affinity/load order;
-        returns None on acceptance or the LAST rejection."""
+        returns None on acceptance or the LAST rejection. With a
+        prefill tier present, first-attempt requests whose prompt is
+        cold on the decode tier divert through disaggregated prefill
+        (:meth:`_submit_prefill`); attempts > 0 — including the
+        fallback resubmission after a failed transfer — place directly
+        so a sick transfer path can never orbit a request between the
+        tiers."""
+        if (attempts == 0 and not req.prefill_only
+                and self._submit_prefill(req, t_submit)):
+            return None
         last: Optional[RequestResult] = None
         for rep, aff in self._candidates(req):
             rej = rep.submit(req)
@@ -1282,6 +1372,181 @@ class Router:
                                  finish_reason=REJECT_FLEET_CAPACITY)
         return last
 
+    # ------------------------------------------- disaggregated prefill
+
+    def _page_size(self) -> int:
+        for rep in self.replicas:
+            if rep.alive and rep.page_size:
+                return int(rep.page_size)
+        return 0
+
+    def _prefill_tier(self) -> List[ReplicaBase]:
+        return [r for r in self.replicas
+                if r.routable and r.tier == "prefill"]
+
+    def _decode_target(self, req: Request
+                       ) -> Tuple[Optional[ReplicaBase], int]:
+        """Best decode-tier home for a session: (replica,
+        cached-prefix-tokens), longest prefix then least load — the
+        replica whose radix already holds the session's pages."""
+        avail = [r for r in self.replicas
+                 if r.routable and r.tier != "prefill"]
+        if not avail:
+            return None, 0
+        scored = [(rep, (rep.cached_prefix_tokens(req.prompt)
+                         if self.rcfg.affinity else 0))
+                  for rep in avail]
+        scored.sort(key=lambda t: (-t[1], t[0].load, t[0].idx))
+        return scored[0]
+
+    def _submit_prefill(self, req: Request, t_submit: float) -> bool:
+        """Two-tier placement: if a prefill tier exists and the best
+        decode-tier replica is missing at least ``disagg_min_tail``
+        full pages of this prompt, submit a ``prefill_only`` clone to
+        the least-loaded prefill worker. The ``prefilled`` finish
+        diverts into :meth:`_on_prefilled` (transfer + resubmission).
+        Returns False to fall through to ordinary placement — a
+        prefix-hot prompt (the short-circuit), no prefill capacity, or
+        no page geometry yet."""
+        pre = self._prefill_tier()
+        psz = self._page_size()
+        if not pre or psz <= 0:
+            return False
+        n_full = len(req.prompt) // psz
+        _, cached = self._decode_target(req)
+        if n_full - cached // psz < self.rcfg.disagg_min_tail:
+            if n_full:
+                self.metrics.inc("fleet_disagg_shortcircuits")
+            return False
+        pre.sort(key=lambda r: (r.load, r.idx))
+        rep = pre[0]
+        if rep.submit(replace(req, prefill_only=True)) is not None:
+            self.metrics.inc("fleet_disagg_fallbacks")
+            return False
+        self._inflight[req.id] = _InFlight(
+            req=req, replica=rep.idx, t_submit=t_submit, attempts=0)
+        self.metrics.inc("fleet_requests_routed")
+        self.metrics.inc("fleet_disagg_prefills")
+        self._env_open(req.id, rep.idx)
+        if self.tel.enabled:
+            self.tel.instant("route", ROUTER_TRACK, request=req.id,
+                             replica=rep.idx, attempt=0,
+                             tier="prefill")
+        return True
+
+    def _page_source(self, rep: ReplicaBase):
+        if rep.is_local:
+            return LocalPageSource(rep.engine)
+        return RpcPageSource(rep._call)
+
+    def _page_sink(self, rep: ReplicaBase):
+        if rep.is_local:
+            return LocalPageSink(rep.engine)
+        return RpcPageSink(rep._call)
+
+    def _transfer_chaos(self, chunk_idx: int) -> None:
+        """Per-chunk fault seam inside a running transfer
+        (faults/fleet.py ``transfer_kill``): kill the named replica —
+        either tier — and abort the transfer the way a vanished host
+        would (the driver falls back to a full decode-tier prefill)."""
+        f = transfer_fault(chunk_idx)
+        if f is not None and f.kind == KIND_TRANSFER_KILL:
+            idx = int(f.arg)
+            self._kill(idx, self.n_steps)
+            raise OSError(f"replica {idx} lost mid-transfer (chaos)")
+
+    def _on_prefilled(self, res: RequestResult, fi: _InFlight,
+                      src_idx: int, now: float) -> None:
+        """A prefill-tier worker finished chewing a prompt: start
+        shipping its KV pages to the request's decode-tier home. The
+        transfer is a :class:`~.disagg.TransferJob` advanced one chunk
+        per router step (:meth:`_advance_transfers`) — the scheduling
+        loop never blocks on page bytes; the request is resubmitted
+        when the transfer resolves. No usable source/target means the
+        no-pages fallback immediately: submit without the transfer, a
+        full local prefill, token-identical, just slower."""
+        req = fi.req
+        src = self.replicas[src_idx]
+        self._env_close(res.id, migrated=True, reason="prefilled",
+                        n_tokens=len(res.tokens))
+        dst, cached = self._decode_target(req)
+        psz = self._page_size()
+        if dst is None or not src.alive or psz <= 0:
+            self._resubmit_prefilled(req, fi.t_submit, fi.attempts,
+                                     dst, now)
+            return
+        job = TransferJob(
+            self._page_source(src), self._page_sink(dst),
+            f"xfer:{req.id}", req.prompt, cached // psz,
+            fault=self._transfer_chaos, clock=self.clock,
+            max_chunk_pages=self.rcfg.transfer_chunk_pages)
+        self._transfers.append(_Transfer(
+            job=job, req=req, t_submit=fi.t_submit,
+            attempts=fi.attempts, src_idx=src_idx, dst_idx=dst.idx,
+            t0_us=(self.tel.ts_us(self.clock())
+                   if self.tel.enabled else 0.0)))
+
+    def _advance_transfers(self, now: float) -> None:
+        """Advance every in-flight page transfer by ONE chunk
+        round-trip; finished jobs record their metrics/span and the
+        request resubmits to the decode tier (failed transfers submit
+        pageless — full local prefill)."""
+        if not self._transfers:
+            return
+        still: List[_Transfer] = []
+        for tr in self._transfers:
+            r = tr.job.step()
+            if r is None:
+                still.append(tr)
+                continue
+            self.metrics.inc("fleet_transfers")
+            self.metrics.observe("fleet_transfer_s", r.elapsed_s)
+            if r.ok:
+                self.metrics.inc("fleet_transfer_pages", r.pages)
+                self.metrics.inc("fleet_transfer_bytes", r.wire_bytes)
+            else:
+                self.metrics.inc("fleet_transfer_failures")
+            if self.tel.enabled:
+                self.tel.complete(
+                    "page_transfer", ROUTER_TRACK, tr.t0_us,
+                    max(self.tel.ts_us(self.clock()) - tr.t0_us, 1.0),
+                    request=tr.req.id, src=tr.src_idx, dst=tr.dst_idx,
+                    pages=r.pages, bytes=r.wire_bytes, ok=r.ok,
+                    **({"error": r.error} if r.error else {}))
+            # the chaos seam may have killed dst mid-transfer —
+            # re-resolve before resubmitting
+            dst = self.replicas[tr.dst_idx]
+            if not dst.routable:
+                dst, _ = self._decode_target(tr.req)
+            self._resubmit_prefilled(tr.req, tr.t_submit, tr.attempts,
+                                     dst, now)
+        self._transfers = still
+
+    def _resubmit_prefilled(self, req: Request, t_submit: float,
+                            attempts: int, dst: Optional[ReplicaBase],
+                            now: float) -> None:
+        """The decode-tier half of a disaggregated request: submit the
+        ORIGINAL request — admission claims whatever prefix the radix
+        now holds (the transferred pages, or nothing after a failed
+        transfer) and decodes as if it had prefilled locally."""
+        if dst is not None and dst.submit(req) is None:
+            self._inflight[req.id] = _InFlight(
+                req=req, replica=dst.idx, t_submit=t_submit,
+                attempts=attempts)
+            self.metrics.inc("fleet_requests_routed")
+            self._env_open(req.id, dst.idx)
+            if self.tel.enabled:
+                self.tel.instant("route", ROUTER_TRACK, request=req.id,
+                                 replica=dst.idx, attempt=attempts,
+                                 tier="decode")
+            return
+        # no decode capacity right now: the retry ladder owns it, with
+        # attempts past 0 so the resubmission places directly
+        self._requeue.append(_Requeue(
+            req=req, t_submit=t_submit, attempts=attempts + 1,
+            due_step=self.n_steps, t_requeued=now))
+        self.metrics.inc("fleet_requeued_requests")
+
     def _on_finish(self, res: RequestResult, replica: int,
                    now: float) -> Optional[RequestResult]:
         if self._superseded.get(res.id) == replica:
@@ -1307,6 +1572,13 @@ class Router:
             # the router submitted.
             if res.id not in self.results:
                 self.metrics.inc("fleet_ghost_finishes")
+            return None
+        if res.finish_reason == FINISH_PREFILLED:
+            # NOT a terminal: the prefill tier's half of a
+            # disaggregated request — divert into the page transfer
+            # and decode-tier resubmission; no ledger finish, no
+            # client-visible result (the decode tier produces it)
+            self._on_prefilled(res, fi, replica, now)
             return None
         res.total_s = now - fi.t_submit
         if res.id in self._ttft:
@@ -1606,3 +1878,20 @@ class Router:
         self.metrics.gauge("fleet_replicas", len(self.replicas))
         self.metrics.gauge("fleet_replicas_routable",
                            sum(r.routable for r in self.replicas))
+        tiers = {rep.tier for rep in self.replicas}
+        if tiers != {"mixed"}:
+            # tier occupancy, disaggregated fleets only (a colocated
+            # fleet's per-replica gauges above already cover it)
+            for tier in sorted(tiers):
+                reps = [r for r in self.replicas
+                        if r.tier == tier and r.alive]
+                self.metrics.gauge(f"tier_{tier}_replicas", len(reps))
+                self.metrics.gauge(
+                    f"tier_{tier}_slots_active",
+                    sum(r.slots_active for r in reps))
+                self.metrics.gauge(
+                    f"tier_{tier}_queue_depth",
+                    sum(r.queue_depth for r in reps))
+                self.metrics.gauge(
+                    f"tier_{tier}_pages_in_use",
+                    sum(r.pages_in_use for r in reps))

@@ -50,6 +50,27 @@ Verbs (dispatched in serve/worker.py):
   ``Router.attach_replica`` reconciles across machines — the journal
   never leaves the worker's filesystem; its *content* rides the RPC
   channel;
+- ``page_transfer`` — the disaggregation verb (serve/disagg.py): move
+  a prompt's finished KV pages between tiers in bounded frames. One
+  verb, six kinds: ``export_begin`` pins the prompt's radix-cached
+  full pages on the prefill worker and answers with the page count;
+  ``export_chunk`` pages the pinned pages out as base64 raw bytes —
+  every pool entry per page (int8/fp8/bf16 K/V rows AND the quantized
+  per-row scale arrays, which share the page axis), chunked so each
+  frame stays under :data:`MAX_FRAME`; ``export_end`` drops the pin.
+  On the decode worker ``install_begin`` allocates + pins local
+  physical pages, ``install_chunk`` scatters arriving blocks through
+  the engine's construction-warmed install program, and
+  ``install_commit`` registers the chain into the local radix (the
+  page-table rebase: the next admission maps the prompt to these
+  LOCAL physical indices through an ordinary prefix claim) — or,
+  with ``abort: true``, unpins and frees the staged pages (the
+  driver lost the source mid-transfer; a half-landed chain must
+  never enter the radix). Shapes
+  and dtypes are never carried per frame — the engine-shape hash both
+  tiers presented at registration already guarantees page-geometry
+  agreement, so the receiver decodes against its own pool's template
+  (:func:`page_block_template`);
 - ``summary``  — the engine ``metrics_summary()`` block the fleet
   summary aggregates;
 - ``shutdown`` — close the journal and exit 0 (the graceful half of a
@@ -173,6 +194,7 @@ def request_to_wire(req: Request, now: float) -> dict:
                          else max(req.deadline - now, 0.0)),
         "eos_token_id": (None if req.eos_token_id is None
                          else int(req.eos_token_id)),
+        "prefill_only": bool(req.prefill_only),
     }
 
 
@@ -192,7 +214,8 @@ def request_from_wire(doc: dict, now: float) -> Request:
             greedy=bool(doc["greedy"])),
         deadline=deadline, rng_seed=int(doc["rng_seed"]),
         eos_token_id=(None if doc.get("eos_token_id") is None
-                      else int(doc["eos_token_id"])))
+                      else int(doc["eos_token_id"])),
+        prefill_only=bool(doc.get("prefill_only", False)))
 
 
 def result_to_wire(res: RequestResult) -> dict:
@@ -213,6 +236,70 @@ def result_from_wire(doc: dict) -> RequestResult:
         ttft_s=float(doc.get("ttft_s", 0.0)),
         decode_tokens_per_s=float(doc.get("decode_tokens_per_s", 0.0)),
         total_s=float(doc.get("total_s", 0.0)))
+
+
+# ------------------------------------------------------ page transfer codec
+
+#: raw bytes of page blocks per ``export_chunk`` frame: base64 expands
+#: 4/3 and the JSON envelope adds entry names, so 8 MiB of raw page
+#: bytes stays comfortably under the 16 MiB MAX_FRAME bound. A single
+#: page larger than this still ships (one page per frame is the floor);
+#: that needs a model far past anything this repo sizes.
+PAGE_CHUNK_BYTES = 8 << 20
+
+
+def page_block_template(cache) -> dict:
+    """Per-entry (shape, dtype) of ONE page's export blocks, derived
+    from a pool's cache dict — the receiver-side decode key. Never
+    serialized: both tiers derive it from their own pool, and the
+    engine-shape hash agreed at registration guarantees the two match
+    byte-for-byte."""
+    return {name: ((arr.shape[0], 1) + tuple(arr.shape[2:]),
+                   np.dtype(arr.dtype))
+            for name, arr in cache.items()}
+
+
+def page_wire_bytes(template: dict) -> int:
+    """Raw bytes one page occupies on the wire (all entries)."""
+    total = 0
+    for shape, dtype in template.values():
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * dtype.itemsize
+    return total
+
+
+def page_block_to_wire(block: dict) -> dict:
+    """One page's export blocks -> {entry: base64 raw bytes}. Raw
+    bytes, not token lists: int8/fp8 pages round-trip exactly, and the
+    f32 scale rows ride as their IEEE bytes (bit-exact — a lossy float
+    repr here would silently perturb dequantization on the far tier)."""
+    import base64
+    return {name: base64.b64encode(
+                np.ascontiguousarray(arr).tobytes()).decode("ascii")
+            for name, arr in block.items()}
+
+
+def page_block_from_wire(doc: dict, template: dict) -> dict:
+    """{entry: base64} -> one page's blocks, decoded against the LOCAL
+    pool's template. A byte-length mismatch is a loud error: it means
+    the shape-hash handshake let a geometry drift through, which must
+    never be papered over with a reshape."""
+    import base64
+    out = {}
+    for name, (shape, dtype) in template.items():
+        raw = base64.b64decode(doc[name])
+        n = 1
+        for d in shape:
+            n *= int(d)
+        if len(raw) != n * dtype.itemsize:
+            raise ValueError(
+                f"page block {name!r}: {len(raw)} bytes on the wire, "
+                f"local pool wants {n * dtype.itemsize} "
+                f"(shape {shape}, dtype {dtype})")
+        out[name] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+    return out
 
 
 # ---------------------------------------------------------- sync client

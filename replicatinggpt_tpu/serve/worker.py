@@ -222,6 +222,50 @@ class WorkerServer:
         return {"tokens": int(
             self.engine.pool.cached_prefix_tokens(prompt))}
 
+    def op_page_transfer(self, doc: dict) -> dict:
+        """The disaggregation verb (serve/disagg.py): this worker is
+        the source (export_* kinds, prefill tier) or the sink
+        (install_* kinds, decode tier) of one prefix transfer. State
+        between kinds lives in the Local* adapters, lazily built —
+        a worker that never disaggregates never touches them."""
+        import numpy as np
+
+        from .disagg import LocalPageSink, LocalPageSource
+        from .rpc import page_block_to_wire
+        if not hasattr(self, "_xfer_src"):
+            self._xfer_src = LocalPageSource(self.engine)
+            self._xfer_sink = LocalPageSink(self.engine)
+        kind, key = doc["kind"], doc["key"]
+        if kind == "export_begin":
+            n = self._xfer_src.begin(
+                key, np.asarray(doc["prompt"], np.int32),
+                int(doc["from_page"]))
+            return {"pages": n,
+                    "page_bytes": self._xfer_src.page_bytes}
+        if kind == "export_chunk":
+            blocks, cursor, done = self._xfer_src.chunk(
+                key, int(doc["cursor"]), int(doc.get("limit", 0)))
+            return {"blocks": [page_block_to_wire(b) for b in blocks],
+                    "cursor": cursor, "done": done}
+        if kind == "export_end":
+            self._xfer_src.end(key)
+            return {}
+        if kind == "install_begin":
+            if self.draining:
+                return {"accepted": False}
+            return {"accepted": self._xfer_sink.begin(
+                key, np.asarray(doc["prompt"], np.int32),
+                int(doc["from_page"]), int(doc["n_pages"]))}
+        if kind == "install_chunk":
+            self._xfer_sink.chunk(key, doc["blocks"])
+            return {}
+        if kind == "install_commit":
+            if doc.get("abort"):
+                self._xfer_sink.abort(key)
+                return {"registered": 0}
+            return {"registered": self._xfer_sink.commit(key)}
+        raise ValueError(f"unknown page_transfer kind {kind!r}")
+
     def op_health(self, doc: dict) -> dict:
         return {
             "pid": os.getpid(),
@@ -424,7 +468,8 @@ def warm_engine(engine: Engine) -> None:
 
 async def _run_async(worker: WorkerServer, host: str, port: int,
                      router_addr: Optional[str], gen: int,
-                     worker_idx: int, shape_hash: str) -> int:
+                     worker_idx: int, shape_hash: str,
+                     tier: str = "mixed") -> int:
     server = await asyncio.start_server(
         lambda r, w: serve_connection(r, w, worker.dispatch),
         host, port)
@@ -444,10 +489,16 @@ async def _run_async(worker: WorkerServer, host: str, port: int,
         # the server is ALREADY live: the supervisor's attach
         # (health/stream_drain/journal_drain RPCs) is served by this
         # same loop while the register coroutine awaits its response
+        # "tier" advertises this worker's role in a disaggregated
+        # fleet (serve/disagg.py): "prefill" takes prefill_only
+        # requests, "decode" takes sessions, "mixed" takes both —
+        # the router's placement policy reads it off registration
         reg_doc = {"port": bound[1], "pid": os.getpid(), "gen": gen,
                    "worker_idx": worker_idx,
                    "replayed": worker.n_replayed,
-                   "proto": PROTO_VERSION, "shape_hash": shape_hash}
+                   "proto": PROTO_VERSION, "shape_hash": shape_hash,
+                   "tier": tier,
+                   "page_size": int(worker.engine.pool.page_size)}
         try:
             await _register_with_router(router_addr, reg_doc)
         except RpcProtocolError as e:
@@ -538,7 +589,8 @@ def run_worker(args) -> int:
     try:
         return asyncio.run(_run_async(
             worker, args.host, args.port, args.router_addr, args.gen,
-            args.worker_idx, shape))
+            args.worker_idx, shape,
+            tier=getattr(args, "tier", "mixed")))
     finally:
         if journal is not None:
             journal.close()

@@ -1,0 +1,347 @@
+"""Disaggregated prefill/decode tier tests (serve/disagg.py + the
+router's two-tier placement): greedy token identity vs a colocated
+fleet for unquantized and int8-KV pools with zero steady-state
+recompiles on either tier, the prefix-hot short-circuit, host loss on
+the prefill tier mid-transfer and on the decode tier post-transfer
+(exactly-once streams, token parity via re-prefill), page_transfer
+span validation in the Perfetto trace, and the HTTP front door's
+per-client token-bucket rate limiter."""
+
+import asyncio
+import importlib.util
+import json
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from replicatinggpt_tpu.config import ModelConfig
+from replicatinggpt_tpu.faults import Fault, FaultPlan, installed
+from replicatinggpt_tpu.faults.fleet import (FLEET_STEP, FLEET_TRANSFER,
+                                             KIND_REPLICA_KILL,
+                                             KIND_TRANSFER_KILL)
+from replicatinggpt_tpu.models.gpt import init_params
+from replicatinggpt_tpu.serve import (EngineConfig, Request, Router,
+                                      RouterConfig, SamplingParams)
+from replicatinggpt_tpu.serve.engine import compile_counts
+from replicatinggpt_tpu.serve.http import RateLimitConfig, ServeApp
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+pytestmark = pytest.mark.fleet
+
+CFG = ModelConfig(vocab_size=65, block_size=64, n_layer=2, n_head=2,
+                  n_embd=32, dropout=0.0, attn_dropout=0.0,
+                  dtype="float32")
+
+#: 20 tokens @ page_size 4 — five flushed pages, so the radix holds 4
+#: full pages for prompt[:-1] and the transfer ships a real multi-page
+#: payload while the tail re-prefills on the decode tier
+PROMPT_LEN = 20
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _long_req(rid, seed=3, max_new=8):
+    rng = np.random.default_rng(seed)
+    return Request(id=rid,
+                   prompt=rng.integers(1, CFG.vocab_size - 1,
+                                       (PROMPT_LEN,)).astype(np.int32),
+                   max_new_tokens=max_new,
+                   sampling=SamplingParams(greedy=True), rng_seed=0)
+
+
+def _ecfg(**kw):
+    return EngineConfig(**{"pool_size": 2, "max_queue": 8,
+                           "page_size": 4, **kw})
+
+
+def _colocated_tokens(params, ecfg, rid="base", seed=3, max_new=8):
+    """The baseline arm: the same request through a colocated fleet of
+    the same engine config (int8 KV perturbs logits, so parity must be
+    measured against the same pool storage, not offline float)."""
+    r = Router(params, CFG, RouterConfig(n_replicas=2), ecfg)
+    assert r.submit(_long_req(rid, seed, max_new)) is None
+    tokens = {res.id: res.tokens for res in r.drain()}[rid]
+    r.close()
+    return tokens
+
+
+def _drain_streaming(router, ids):
+    results, streams = {}, {i: [] for i in ids}
+    while not router.idle:
+        for res in router.step():
+            results[res.id] = res
+        for rid in streams:
+            streams[rid].extend(router.take_new_tokens(rid))
+    return results, streams
+
+
+def _trace_check():
+    spec = importlib.util.spec_from_file_location(
+        "trace_check", REPO / "tools" / "trace_check.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# token identity + transfer counters + zero recompiles
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_disagg_token_identity_and_short_circuit(params, kv_quant):
+    """A request through the prefill tier + page transfer + decode tier
+    produces the exact greedy stream a colocated fleet produces, for
+    the raw and the int8-quantized page pool (the wire format carries
+    the quantized page bytes AND the per-row scales); the transfer
+    installs through warmed programs (zero compiles during traffic);
+    and a second identical prompt short-circuits the prefill tier —
+    its pages are already radix-hot on the decode worker."""
+    ecfg = _ecfg(kv_quant=kv_quant)
+    base = _colocated_tokens(params, ecfg)
+
+    r = Router(params, CFG,
+               RouterConfig(n_replicas=2, tiers=("prefill", "decode"),
+                            disagg_min_tail=1), ecfg)
+    warm = sum(compile_counts().values())
+    assert r.submit(_long_req("d1")) is None
+    out = {res.id: res for res in r.drain()}
+    assert out["d1"].tokens == base
+    c = r.metrics.counters
+    assert c.get("fleet_disagg_prefills", 0) == 1
+    assert c.get("fleet_transfers", 0) == 1
+    assert c.get("fleet_transfer_pages", 0) >= 4
+    assert c.get("fleet_transfer_bytes", 0) > 0
+    assert c.get("fleet_transfer_failures", 0) == 0
+
+    # same prompt again: the decode tier already holds its prefix —
+    # no second diversion, no second transfer
+    assert r.submit(_long_req("d2")) is None
+    out2 = {res.id: res for res in r.drain()}
+    assert out2["d2"].tokens == base
+    c = r.metrics.counters
+    assert c.get("fleet_disagg_shortcircuits", 0) == 1
+    assert c.get("fleet_transfers", 0) == 1
+
+    assert sum(compile_counts().values()) == warm
+    s = r.fleet_summary()
+    assert s["tiers"] == {"prefill": 1, "decode": 1}
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: tier loss
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_prefill_tier_loss_mid_transfer(params, tmp_path):
+    """The prefill worker dies mid-transfer (chunk 0 of the page
+    stream): the transfer aborts, the request falls back to a full
+    decode-tier prefill through the retry ladder, and the client
+    stream is exactly-once and token-identical."""
+    ecfg = _ecfg()
+    base = _colocated_tokens(params, ecfg)
+    with installed(FaultPlan(Fault(site=FLEET_TRANSFER,
+                                   kind=KIND_TRANSFER_KILL, at=0,
+                                   arg=0))):
+        r = Router(params, CFG,
+                   RouterConfig(n_replicas=2,
+                                tiers=("prefill", "decode"),
+                                disagg_min_tail=1,
+                                journal_dir=str(tmp_path)), ecfg)
+        assert r.submit(_long_req("x")) is None
+        results, streams = _drain_streaming(r, ["x"])
+        c = dict(r.metrics.counters)
+        prefill_alive = r.replicas[0].alive
+        r.close()
+    assert results["x"].tokens == base
+    assert streams["x"] == base
+    assert c.get("fleet_transfer_failures", 0) == 1
+    assert c.get("fleet_transfer_pages", 0) == 0
+    assert not prefill_alive
+
+
+@pytest.mark.chaos
+def test_decode_tier_loss_post_transfer(params, tmp_path):
+    """The decode worker holding the transferred pages dies mid-decode
+    (after the transfer landed): the journal requeue re-places the
+    request from scratch — the pages died with the host, so the prompt
+    re-prefills (via the still-alive prefill tier, a second diversion
+    + transfer to the surviving decode worker) — token-identical,
+    exactly-once stream."""
+    ecfg = _ecfg()
+    base = _colocated_tokens(params, ecfg, max_new=12)
+    with installed(FaultPlan(Fault(site=FLEET_STEP,
+                                   kind=KIND_REPLICA_KILL, at=6,
+                                   arg=1))):
+        r = Router(params, CFG,
+                   RouterConfig(n_replicas=3,
+                                tiers=("prefill", "decode", "decode"),
+                                disagg_min_tail=1,
+                                journal_dir=str(tmp_path)), ecfg)
+        assert r.submit(_long_req("x", max_new=12)) is None
+        results, streams = _drain_streaming(r, ["x"])
+        c = dict(r.metrics.counters)
+        r.close()
+    assert results["x"].tokens == base
+    assert streams["x"] == base
+    # the first transfer landed on the doomed worker; the requeue
+    # re-prefilled via a fresh diversion (so >= 1 transfer, none failed)
+    assert c.get("fleet_transfers", 0) >= 1
+    assert c.get("fleet_transfer_failures", 0) == 0
+    assert c.get("fleet_requeued_requests", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry: page_transfer spans
+# ---------------------------------------------------------------------------
+
+def test_page_transfer_span_validates(params, tmp_path):
+    """A disaggregated run's trace carries a router-track
+    page_transfer X span inside the request's fleet-wide envelope
+    hull, and the request's envelope closes exactly once fleet-wide
+    (prefill segment migrated, decode segment terminal) — all enforced
+    by tools/trace_check.py."""
+    from replicatinggpt_tpu.utils.telemetry import Telemetry
+    tel = Telemetry()
+    r = Router(params, CFG,
+               RouterConfig(n_replicas=2, tiers=("prefill", "decode"),
+                            disagg_min_tail=1), _ecfg(),
+               telemetry=tel)
+    assert r.submit(_long_req("t1")) is None
+    r.drain()
+    r.close()
+    out = tmp_path / "disagg_trace.json"
+    tel.export_chrome_trace(str(out))
+    tel.close()
+    doc = json.loads(out.read_text())
+    xfer = [e for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e.get("name") == "page_transfer"]
+    assert len(xfer) == 1
+    assert xfer[0]["args"]["request"] == "t1"
+    assert xfer[0]["args"]["pages"] >= 4
+    assert xfer[0]["args"]["bytes"] > 0
+    tc = _trace_check()
+    assert tc.check_trace(str(out), min_requests=1) == []
+
+
+def test_trace_check_flags_bad_transfers(tmp_path):
+    """The validator actually rejects: a transfer dangling past the
+    terminal envelope close, a transfer for a request with no
+    envelope, and a transfer with no preceding migrated (prefill)
+    segment."""
+    tc = _trace_check()
+
+    def trace(events):
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps({"traceEvents": events}))
+        return str(p)
+
+    meta = {"ph": "M", "name": "thread_name", "pid": 0, "tid": 9,
+            "args": {"name": "router"}}
+
+    def envelope(rid, tid, b, e, migrated=False):
+        args = {"request": rid}
+        return [{"ph": "B", "name": "request", "pid": 0, "tid": tid,
+                 "ts": b, "args": dict(args)},
+                {"ph": "E", "name": "request", "pid": 0, "tid": tid,
+                 "ts": e,
+                 "args": {**args, **({"migrated": True}
+                                     if migrated else {})}}]
+
+    def xfer(rid, ts, dur):
+        return {"ph": "X", "name": "page_transfer", "pid": 0, "tid": 9,
+                "ts": ts, "dur": dur, "args": {"request": rid}}
+
+    good = [meta] + envelope("r1", 1, 100.0, 200.0, migrated=True) \
+        + envelope("r1", 2, 260.0, 300.0) + [xfer("r1", 210.0, 20.0)]
+    assert tc.check_trace(trace(good)) == []
+
+    dangling = [meta] + envelope("r1", 1, 100.0, 200.0, migrated=True) \
+        + envelope("r1", 2, 260.0, 300.0) + [xfer("r1", 290.0, 40.0)]
+    errs = tc.check_trace(trace(dangling))
+    assert any("outside its fleet-wide envelope hull" in e for e in errs)
+
+    orphan = [meta] + envelope("r1", 1, 100.0, 200.0) \
+        + [xfer("r2", 110.0, 10.0)]
+    errs = tc.check_trace(trace(orphan))
+    assert any("no complete envelope" in e for e in errs)
+
+    unmigrated = [meta] + envelope("r1", 1, 100.0, 200.0) \
+        + [xfer("r1", 110.0, 10.0)]
+    errs = tc.check_trace(trace(unmigrated))
+    assert any("no migrated" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front door: per-client rate limiting
+# ---------------------------------------------------------------------------
+
+async def _post(host, port, path, body, headers=None):
+    """One POST; returns (status, response-headers-lowercased, body)."""
+    r, w = await asyncio.open_connection(host, port)
+    payload = json.dumps(body).encode()
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    w.write(f"POST {path} HTTP/1.1\r\nHost: t\r\n{extra}"
+            f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload)
+    await w.drain()
+    data = await r.read()
+    w.close()
+    await w.wait_closed()
+    head, _, rest = data.partition(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    hdrs = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        hdrs[k.strip().lower()] = v.strip()
+    return status, hdrs, json.loads(rest)
+
+
+def test_http_rate_limit_per_client(params):
+    """The submit paths meter a token bucket per x-client-id: a client
+    past its burst gets 429 with a Retry-After header and a metrics
+    increment; other clients (and the anonymous bucket) are
+    unaffected; the router never sees the over-rate submit."""
+    ecfg = _ecfg()
+
+    async def main():
+        router = Router(params, CFG, RouterConfig(n_replicas=1), ecfg)
+        app = ServeApp(router,
+                       rate_limit=RateLimitConfig(rps=0.001, burst=2.0))
+        host, port = await app.start()
+        try:
+            body = {"prompt": [1, 2], "max_new_tokens": 1,
+                    "greedy": True}
+            for i in range(2):
+                st, _, doc = await _post(
+                    host, port, "/v1/submit", {**body, "id": f"a{i}"},
+                    {"x-client-id": "tenant-a"})
+                assert st == 200, doc
+            st, hdrs, doc = await _post(
+                host, port, "/v1/submit", {**body, "id": "a2"},
+                {"x-client-id": "tenant-a"})
+            assert st == 429
+            assert doc["error"] == "rate limited"
+            assert int(hdrs["retry-after"]) >= 1
+            # a different tenant still has its full burst
+            st, _, doc = await _post(
+                host, port, "/v1/submit", {**body, "id": "b0"},
+                {"x-client-id": "tenant-b"})
+            assert st == 200, doc
+            # no header = the shared anonymous bucket, also fresh
+            st, _, doc = await _post(host, port, "/v1/submit",
+                                     {**body, "id": "anon0"})
+            assert st == 200, doc
+            assert router.metrics.counters["http_rate_limited"] == 1
+            # the rejected id never reached the router
+            assert not router.knows("a2")
+        finally:
+            await app.stop()
+
+    asyncio.run(main())

@@ -90,7 +90,8 @@ class _RegStubRouter:
         from replicatinggpt_tpu.utils.telemetry import NULL
         self.tel = NULL
 
-    def attach_replica(self, idx, port, pid=None, gen=None, host=None):
+    def attach_replica(self, idx, port, pid=None, gen=None, host=None,
+                       tier=None, page_size=None):
         self.attached.append((idx, port, pid, gen, host))
         return {"kept": 0, "requeued": 0, "ghosts": 0}
 

@@ -577,9 +577,10 @@ def test_metrics_summary_key_schema(params):
         assert key in s, key
     assert set(s["compile_counts"]) == {
         "decode", "mixed", "prefill", "verify", "page_copy",
-        "draft_decode", "draft_prefill"}
+        "page_export", "page_install", "draft_decode", "draft_prefill"}
     assert set(s["compile_guards"]) == {"decode", "mixed", "prefill",
-                                        "verify", "page_copy"}
+                                        "verify", "page_copy",
+                                        "page_export", "page_install"}
     # continuous-window observability (ISSUE 13): the break counters
     # keyed by reason, and the k-autotune fields in the dispatch block
     assert set(s["window_breaks"]) == {"admit", "deadline", "cancel",
@@ -605,7 +606,11 @@ def test_metrics_summary_key_schema(params):
         # tests/test_quant.py); bytes_per_page is the fixed-HBM
         # capacity denominator, kv_quant_bits the numeric mode gauge
         "kv_quant", "quant_granularity", "bytes_per_page",
-        "kv_quant_bits"}
+        "kv_quant_bits",
+        # disaggregation gauges (ISSUE 16): page export/install traffic
+        # and transfer-pinned pages; zero on a colocated engine but the
+        # schema never branches on tier
+        "pages_exported", "pages_installed", "transfer_pins"}
     assert s["pages"]["kv_quant"] == "none"
     assert s["pages"]["kv_quant_bits"] == 32      # f32 test pool
     assert s["pages"]["mesh_shape"] == [1, 1]

@@ -10,6 +10,7 @@ import pytest
 
 from replicatinggpt_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from replicatinggpt_tpu.ops.attention import full_causal_attention
+from replicatinggpt_tpu.parallel.compat import shard_map
 from replicatinggpt_tpu.parallel import (make_ring_attention_fn,
                                          make_ulysses_attention_fn,
                                          select_attention_fn)
@@ -204,7 +205,7 @@ def test_ring_q_chunking_matches_unchunked():
     # back to the largest divisor (4), keeping the memory bound rather
     # than silently processing the whole shard in one tile
     for q_chunk in (4, 5):
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(_ring_local, axis_name="seq", scale=None,
                               q_chunk=q_chunk),
             mesh=mesh, in_specs=(P("data", "model", "seq", None),) * 3,
@@ -213,13 +214,13 @@ def test_ring_q_chunking_matches_unchunked():
         np.testing.assert_allclose(got, want, atol=1e-6, rtol=1e-6)
     # and with dropout: chunked mask streams are keyed per chunk, so only
     # statistics (not bits) are comparable — check determinism instead
-    a = jax.shard_map(
+    a = shard_map(
         functools.partial(_ring_local, axis_name="seq", scale=None,
                           q_chunk=4, dropout_rate=0.3,
                           rng=jax.random.PRNGKey(5), train=True),
         mesh=mesh, in_specs=(P("data", "model", "seq", None),) * 3,
         out_specs=P("data", "model", "seq", None), check_vma=False)(q, k, v)
-    b = jax.shard_map(
+    b = shard_map(
         functools.partial(_ring_local, axis_name="seq", scale=None,
                           q_chunk=4, dropout_rate=0.3,
                           rng=jax.random.PRNGKey(5), train=True),
@@ -241,7 +242,7 @@ def _ring_fn(mesh, **kw):
     from replicatinggpt_tpu.parallel.ring_attention import _ring_local
 
     spec = P("data", "model", "seq", None)
-    return jax.shard_map(
+    return shard_map(
         functools.partial(_ring_local, axis_name="seq", scale=None, **kw),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
 

@@ -51,6 +51,18 @@ timeout -s INT --kill-after=60 1800 python bench.py --mode fleet \
   --fleet-load-step --fleet-replicas 3 \
   > benchmarks/BENCH_fleet_load_step.json 2>> "$LOG"
 echo "=== fleet-load-step rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+# disaggregation rows (ISSUE 16): colocated-vs-tiered TTFT A/B at
+# equal worker count (disagg_ab artifact block: short/long TTFT
+# p50/p99 both arms, transfer counters + p99, token-identity bit) —
+# bf16 pool and int8 paged KV (quantized pages + scales on the wire)
+timeout -s INT --kill-after=60 1800 python bench.py --mode fleet \
+  --disagg --fleet-replicas 4 \
+  > benchmarks/BENCH_fleet_disagg_ab.json 2>> "$LOG"
+echo "=== fleet-disagg-ab rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+timeout -s INT --kill-after=60 1800 python bench.py --mode fleet \
+  --disagg --fleet-replicas 4 --kv-quant int8 \
+  > benchmarks/BENCH_fleet_disagg_ab_int8.json 2>> "$LOG"
+echo "=== fleet-disagg-ab-int8 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
 mkdir -p benchmarks/converged_gpt2
 timeout -s INT --kill-after=60 5400 python -m replicatinggpt_tpu train \
   --preset gpt2-large --dataset datasets/shakespeare.txt \

@@ -25,6 +25,18 @@ an error, so CI validates structure explicitly:
   fall outside any envelope). Router tracks are recognized by their
   thread-name metadata (``utils.telemetry.ROUTER_TRACK_NAME``) so
   this validator stays stdlib-only with no imports from the package;
+- disaggregated prefill/decode requests (serve/disagg.py) are checked
+  against the fleet-wide envelope: a ``page_transfer`` X span on the
+  router track names the request it moves pages for, and must fall
+  inside that request's envelope HULL — at-or-after its earliest
+  segment opens (the prefill tier's half, which closes ``migrated``
+  before the transfer starts) and at-or-before its terminal segment
+  closes (the decode tier's half). A transfer for a request with no
+  envelope, or one dangling past the terminal close, means the router
+  shipped pages for a request it no longer owns. The
+  exactly-one-terminal-close rule above is what "a disaggregated
+  request's envelope closes exactly once fleet-wide" means: prefill
+  segment migrated, decode segment terminal;
 - multi-token decode windows are allowed and checked: a window's
   ``decode``/``verify`` X span may contain MANY per-request ``token``
   instants; each must carry a positive integer ``index`` (the
@@ -89,6 +101,9 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
     segments: Dict[str, List[dict]] = {}
     open_envs: Dict[Tuple[str, Tuple[int, int]], List[float]] = {}
     tagged: List[dict] = []
+    # router-track page_transfer X spans (disaggregation): checked
+    # against the request's fleet-wide envelope hull, not one segment
+    transfers: List[dict] = []
     # request id -> highest token-instant index seen (window deliveries)
     token_indices: Dict[str, int] = {}
 
@@ -139,6 +154,8 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
                 errors.append(f"X {name!r} has bad dur {dur!r}")
             elif rid is not None and not on_router:
                 tagged.append(ev)
+            elif rid is not None and name == "page_transfer":
+                transfers.append(ev)
         elif ph == "i":
             if rid is not None and name not in UNSTARTED and not on_router:
                 tagged.append(ev)
@@ -208,6 +225,32 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
                 f"{ev['ph']} {name!r} for request {rid!r} "
                 f"[{lo:.1f}, {hi:.1f}] on track {key} outside every "
                 f"envelope segment of that request")
+
+    for ev in transfers:
+        rid = ev["args"]["request"]
+        segs = segments.get(rid)
+        lo = ev["ts"]
+        hi = lo + ev.get("dur", 0.0)
+        if not segs:
+            errors.append(f"page_transfer for request {rid!r} which has "
+                          f"no complete envelope (pages shipped for a "
+                          f"request the fleet never ran)")
+            continue
+        hull_lo = min(s["b"] for s in segs)
+        hull_hi = max(s["e"] for s in segs)
+        if lo < hull_lo - EPS_US or hi > hull_hi + EPS_US:
+            errors.append(
+                f"page_transfer for request {rid!r} [{lo:.1f}, {hi:.1f}] "
+                f"outside its fleet-wide envelope hull "
+                f"[{hull_lo:.1f}, {hull_hi:.1f}] — the router moved "
+                f"pages for a request it no longer owned")
+            continue
+        if not any(s["migrated"] and s["b"] <= lo + EPS_US
+                   for s in segs):
+            errors.append(
+                f"page_transfer for request {rid!r} with no migrated "
+                f"(prefill-tier) envelope segment opened before it — "
+                f"a transfer must follow a diverted prefill")
 
     if n_complete < min_requests:
         errors.append(f"only {n_complete} complete request envelope(s); "
