@@ -129,6 +129,46 @@ def device_call_kind(node: ast.Call) -> Optional[str]:
     return None
 
 
+#: event-loop blockers (GL019's vocabulary). Exact dotted calls that
+#: park the host thread, plus socket-receive methods (the RPC client's
+#: frame reads) and subprocess waits. ``asyncio.sleep`` never appears
+#: here — it is awaited, and awaited calls are excluded at scan time.
+_BLOCKING_EXACT = {
+    "time.sleep": "time.sleep",
+    "os.fsync": "os.fsync",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+}
+_BLOCKING_RECV_ATTRS = {"recv", "recvfrom", "recv_into"}
+#: the project's synchronous RPC spelling: ``client.call("verb", ...)``
+#: / ``replica._call("verb", ...)``. Only a call WITHOUT an explicit
+#: budget is classified — ``timeout_s=...`` (or a positional timeout)
+#: is the reviewed bound that makes a blocking RPC acceptable.
+_RPC_CALL_ATTRS = {"call", "_call"}
+
+
+def blocking_call_kind(node: ast.Call) -> Optional[str]:
+    """A human-readable kind string when this call blocks the host
+    thread without a budget (GL019's vocabulary), else None."""
+    f = dotted(node.func)
+    if f in _BLOCKING_EXACT:
+        return _BLOCKING_EXACT[f]
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    if node.func.attr in _BLOCKING_RECV_ATTRS:
+        return f"socket .{node.func.attr}()"
+    if node.func.attr in _RPC_CALL_ATTRS and node.args \
+            and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        timed = (len(node.args) >= 2
+                 or any(kw.arg == "timeout_s" for kw in node.keywords))
+        if not timed:
+            return f"untimed rpc .{node.func.attr}({node.args[0].value!r})"
+    return None
+
+
 def jit_wrap_call(node: ast.AST) -> Optional[ast.Call]:
     if isinstance(node, ast.Call):
         f = dotted(node.func)
@@ -177,6 +217,37 @@ def param_names(fn: ast.FunctionDef) -> List[str]:
     return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
 
 
+def annotation_type_names(ann: Optional[ast.expr]) -> Set[str]:
+    """Every identifier a type annotation mentions: ``Dict[str,
+    RemoteReplica]`` -> {'Dict', 'str', 'RemoteReplica'}. Callers
+    validate against the project class registry, which drops the typing
+    vocabulary. String annotations are parsed ("Router" works under
+    ``from __future__ import annotations``)."""
+    if ann is None:
+        return set()
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return set()
+    names: Set[str] = set()
+    for n in ast.walk(ann):
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.add(n.attr)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            names.add(n.value)               # nested string annotation
+    return names
+
+
+def _annotated_params(fn: ast.FunctionDef) -> Dict[str, Set[str]]:
+    a = fn.args
+    return {p.arg: annotation_type_names(p.annotation)
+            for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)
+            if p.annotation is not None}
+
+
 # --------------------------------------------------------------------------
 # summaries
 # --------------------------------------------------------------------------
@@ -199,6 +270,7 @@ class FunctionSummary:
     name: str                     # local qualname: "f" or "Class.f"
     node: ast.FunctionDef = None
     params: List[str] = field(default_factory=list)
+    is_async: bool = False        # declared ``async def``
     jitted: bool = False
     static_params: Set[str] = field(default_factory=set)
     donated_params: Set[str] = field(default_factory=set)
@@ -211,6 +283,10 @@ class FunctionSummary:
     sync_sites: List[Tuple[ast.AST, str]] = field(default_factory=list)
     #: direct device-call sites (GL002 vocabulary), pragma-filtered
     device_sites: List[Tuple[ast.AST, str]] = field(default_factory=list)
+    #: direct event-loop blockers (GL019 vocabulary): (node, kind).
+    #: Awaited calls are excluded at scan time, and a GL019 pragma at
+    #: the site stops interprocedural propagation.
+    blocking_sites: List[Tuple[ast.AST, str]] = field(default_factory=list)
     calls: List[CallSite] = field(default_factory=list)
     #: names read but never bound locally (captured from enclosing scope)
     free_reads: Set[str] = field(default_factory=set)
@@ -232,11 +308,31 @@ class ImportBinding:
 
 
 @dataclass
+class ClassInfo:
+    """One class's structure, as far as a heuristic needs it: bases (for
+    override resolution through abstract seams like ReplicaBase), the
+    method-name set, and candidate attribute types harvested from
+    annotations (``self.x: Optional[RpcClient]``), annotated-parameter
+    assignments (``self.router = router`` with ``router: Router``), and
+    constructor calls (``self.x = RpcClient(...)``). Type *names* only —
+    validated against the project's class registry at query time, which
+    naturally drops typing containers (List, Optional, ...)."""
+
+    name: str
+    label: str
+    node: ast.ClassDef = None
+    bases: Set[str] = field(default_factory=set)
+    methods: Set[str] = field(default_factory=set)
+    attr_types: Dict[str, Set[str]] = field(default_factory=dict)
+
+
+@dataclass
 class ModuleInfo:
     label: str
     tree: ast.Module
     lines: Sequence[str]
     functions: Dict[str, FunctionSummary] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
     imports: Dict[str, ImportBinding] = field(default_factory=dict)
     #: module-scope simple assignments: name -> value expression
     globals: Dict[str, ast.expr] = field(default_factory=dict)
@@ -288,6 +384,9 @@ class _FnScanner(ast.NodeVisitor):
         self.if_depth_in_loop = 0
         self.cond_depth = 0            # `if` nesting anywhere in the body
         self.loop_vars: List[Set[str]] = []
+        #: id()s of Call nodes under an ``await`` — an awaited call
+        #: yields to the event loop instead of blocking it
+        self._awaited: Set[int] = set()
 
     def _collect_store_names(self, target: ast.AST) -> Set[str]:
         return {n.id for n in ast.walk(target)
@@ -381,6 +480,11 @@ class _FnScanner(ast.NodeVisitor):
 
     # -- reads & calls -----------------------------------------------------
 
+    def visit_Await(self, node):
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
     def visit_Name(self, node):
         if isinstance(node.ctx, ast.Load):
             self.s.free_reads.add(node.id)    # filtered against locals later
@@ -397,6 +501,10 @@ class _FnScanner(ast.NodeVisitor):
         dev = device_call_kind(node)
         if dev is not None and not self.suppressed(line, "GL002"):
             self.s.device_sites.append((node, dev))
+        if id(node) not in self._awaited:
+            blk = blocking_call_kind(node)
+            if blk is not None and not self.suppressed(line, "GL019"):
+                self.s.blocking_sites.append((node, blk))
         enclosing = set().union(*self.loop_vars) if self.loop_vars else set()
         self.s.calls.append(CallSite(
             node=node, func_expr=node.func, loop_depth=self.loop_depth,
@@ -428,7 +536,8 @@ class _FnScanner(ast.NodeVisitor):
 
 def _summarize_function(label: str, qual: str, fn: ast.FunctionDef,
                         suppressed) -> FunctionSummary:
-    s = FunctionSummary(label=label, name=qual, node=fn)
+    s = FunctionSummary(label=label, name=qual, node=fn,
+                        is_async=isinstance(fn, ast.AsyncFunctionDef))
     s.params = param_names(fn)
     s.local_names |= set(s.params)
     dec = None
@@ -462,6 +571,42 @@ def _apply_jit_kwargs(s: FunctionSummary, kw: Dict[str, ast.expr]) -> None:
             s.donated_params.add(s.params[i])
     if "in_shardings" in kw or "out_shardings" in kw:
         s.shard_annotated = True
+
+
+def _harvest_attr_types(info: ClassInfo, fn: ast.FunctionDef) -> None:
+    """Collect candidate type names for ``self.<attr>`` from one method:
+    ``self.x: T = ...`` annotations, ``self.x = <annotated param>``, and
+    ``self.x = ClassName(...)`` constructor calls. Every method is
+    harvested (``connect``-style late binding is as real as __init__)."""
+    pmap = _annotated_params(fn)
+
+    def is_self_attr(t: ast.AST) -> Optional[str]:
+        if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            return t.attr
+        return None
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.AnnAssign):
+            attr = is_self_attr(node.target)
+            if attr is not None:
+                info.attr_types.setdefault(attr, set()).update(
+                    annotation_type_names(node.annotation))
+        elif isinstance(node, ast.Assign):
+            names: Set[str] = set()
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in pmap:
+                names = pmap[node.value.id]
+            elif isinstance(node.value, ast.Call):
+                d = dotted(node.value.func)
+                if d:
+                    names = {d.split(".")[-1]}
+            if not names:
+                continue
+            for t in node.targets:
+                attr = is_self_attr(t)
+                if attr is not None:
+                    info.attr_types.setdefault(attr, set()).update(names)
 
 
 def _is_main_guard(stmt: ast.stmt) -> bool:
@@ -511,6 +656,10 @@ class ProjectIndex:
         self._by_pyname: Dict[str, str] = {}      # python module -> label
         self._sync_memo: Dict[str, Optional[List[str]]] = {}
         self._dev_memo: Dict[str, Optional[List[str]]] = {}
+        self._blk_memo: Dict[str, Optional[List[str]]] = {}
+        #: class name -> [(label, ClassInfo)] across every module
+        self._class_registry: Dict[str, List[Tuple[str, ClassInfo]]] = {}
+        self._subclass_memo: Dict[str, Set[str]] = {}
 
     # -- construction ------------------------------------------------------
 
@@ -540,11 +689,25 @@ class ProjectIndex:
             mod.functions[stmt.name] = _summarize_function(
                 mod.label, stmt.name, stmt, suppressed)
         elif isinstance(stmt, ast.ClassDef):
+            info = ClassInfo(name=stmt.name, label=mod.label, node=stmt)
+            for b in stmt.bases:
+                d = dotted(b)
+                if d:
+                    info.bases.add(d.split(".")[-1])
             for sub in stmt.body:
                 if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     qual = f"{stmt.name}.{sub.name}"
                     mod.functions[qual] = _summarize_function(
                         mod.label, qual, sub, suppressed)
+                    info.methods.add(sub.name)
+                    _harvest_attr_types(info, sub)
+                elif isinstance(sub, ast.AnnAssign) \
+                        and isinstance(sub.target, ast.Name):
+                    info.attr_types.setdefault(sub.target.id, set()) \
+                        .update(annotation_type_names(sub.annotation))
+            mod.classes[stmt.name] = info
+            self._class_registry.setdefault(stmt.name, []).append(
+                (mod.label, info))
         elif isinstance(stmt, ast.Import):
             for a in stmt.names:
                 mod.imports[a.asname or a.name.split(".")[0]] = \
@@ -715,5 +878,203 @@ class ProjectIndex:
               else (mod.toplevel if mod else None))
         if fn and fn.sync_sites:
             node, kind = fn.sync_sites[0]
+            return (label, getattr(node, "lineno", 0), kind)
+        return None
+
+    # -- class registry / receiver typing ----------------------------------
+
+    def class_infos(self, name: str) -> List[Tuple[str, "ClassInfo"]]:
+        return self._class_registry.get(name, [])
+
+    def subclasses_of(self, name: str) -> Set[str]:
+        """All registered class names reachable downward from ``name``
+        (including ``name`` itself) — override resolution through
+        abstract seams like ReplicaBase."""
+        if name in self._subclass_memo:
+            return self._subclass_memo[name]
+        out = {name}
+        changed = True
+        while changed:
+            changed = False
+            for cls_name, infos in self._class_registry.items():
+                if cls_name in out:
+                    continue
+                for _, info in infos:
+                    if info.bases & out:
+                        out.add(cls_name)
+                        changed = True
+                        break
+        self._subclass_memo[name] = out
+        return out
+
+    def _attr_types(self, type_name: str, attr: str,
+                    depth: int = 0) -> Set[str]:
+        """Candidate type names of ``<type_name> instance>.<attr>``,
+        searching the class and its transitive bases."""
+        out: Set[str] = set()
+        if depth > 4:
+            return out
+        for _, info in self._class_registry.get(type_name, []):
+            out |= info.attr_types.get(attr, set())
+            for b in info.bases:
+                out |= self._attr_types(b, attr, depth + 1)
+        return {t for t in out if t in self._class_registry}
+
+    def expr_type_names(self, mod: ModuleInfo,
+                        caller: Optional[FunctionSummary],
+                        expr: ast.expr, depth: int = 0) -> Set[str]:
+        """Best-effort set of *registered class* names an expression may
+        evaluate to. Flow-insensitive and deliberately shallow: params
+        and locals via annotations, ``x = ClassName(...)``, attribute
+        chains through harvested attr types, element passthrough for
+        subscripts / for-targets / ``.values()``."""
+        if depth > 5:
+            return set()
+        reg = self._class_registry
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if name in ("self", "cls") and caller is not None \
+                    and "." in caller.name:
+                cls_name = caller.name.split(".", 1)[0]
+                return {cls_name} if cls_name in reg else set()
+            if caller is None or caller.node is None:
+                return set()
+            out: Set[str] = set()
+            ann = _annotated_params(caller.node).get(name)
+            if ann:
+                out |= {t for t in ann if t in reg}
+            for sub in ast.walk(caller.node):
+                if isinstance(sub, ast.AnnAssign) \
+                        and isinstance(sub.target, ast.Name) \
+                        and sub.target.id == name:
+                    out |= {t for t in annotation_type_names(sub.annotation)
+                            if t in reg}
+                elif isinstance(sub, ast.Assign) and sub.value is not None:
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name) and t.id == name:
+                            out |= self.expr_type_names(
+                                mod, caller, sub.value, depth + 1)
+                elif isinstance(sub, ast.For) \
+                        and isinstance(sub.target, ast.Name) \
+                        and sub.target.id == name:
+                    out |= self.expr_type_names(
+                        mod, caller, sub.iter, depth + 1)
+            return out
+        if isinstance(expr, ast.Attribute):
+            base_types = self.expr_type_names(mod, caller, expr.value,
+                                              depth + 1)
+            out = set()
+            for t in base_types:
+                out |= self._attr_types(t, expr.attr)
+            return out
+        if isinstance(expr, ast.Subscript):
+            # element-of-container passthrough: List[T]/Dict[_, T]
+            # annotations already contribute T to the container's types
+            return self.expr_type_names(mod, caller, expr.value, depth + 1)
+        if isinstance(expr, (ast.ListComp, ast.GeneratorExp, ast.SetComp)) \
+                and len(expr.generators) == 1 \
+                and isinstance(expr.elt, ast.Name) \
+                and isinstance(expr.generators[0].target, ast.Name) \
+                and expr.elt.id == expr.generators[0].target.id:
+            return self.expr_type_names(mod, caller,
+                                        expr.generators[0].iter, depth + 1)
+        if isinstance(expr, ast.Call):
+            if isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr == "values":
+                return self.expr_type_names(mod, caller, expr.func.value,
+                                            depth + 1)
+            d = dotted(expr.func)
+            if d:
+                tail = d.split(".")[-1]
+                if tail in reg:
+                    return {tail}
+            return set()
+        return set()
+
+    def resolve_method_candidates(self, mod: ModuleInfo,
+                                  caller: Optional[FunctionSummary],
+                                  func_expr: ast.expr,
+                                  ) -> List[FunctionSummary]:
+        """Like resolve_call, but when the direct resolution fails on an
+        attribute call, type the receiver and return every matching
+        method across the receiver's class and its subclasses (capped).
+        Used by the blocking-chain search so ``rep.submit(...)`` through
+        an abstract base reaches the concrete overrides."""
+        direct = self.resolve_call(mod, caller, func_expr)
+        if direct is not None:
+            return [direct]
+        if not isinstance(func_expr, ast.Attribute):
+            return []
+        recv_types = self.expr_type_names(mod, caller, func_expr.value)
+        out: List[FunctionSummary] = []
+        seen: Set[str] = set()
+        for t in sorted(recv_types):
+            for cand in sorted(self.subclasses_of(t)):
+                for label, info in self._class_registry.get(cand, []):
+                    if func_expr.attr not in info.methods:
+                        continue
+                    owner = self.modules.get(label)
+                    summ = (owner.functions.get(f"{cand}.{func_expr.attr}")
+                            if owner else None)
+                    if summ is not None and summ.qname not in seen:
+                        seen.add(summ.qname)
+                        out.append(summ)
+                        if len(out) >= 8:
+                            return out
+        return out
+
+    # -- blocking reachability (GL019) ------------------------------------
+
+    def _blocking_search(self, s: FunctionSummary, depth: int,
+                         stack: Set[str],
+                         ) -> Tuple[Optional[List[str]], bool]:
+        """Like _transitive over ``blocking_sites``, but resolves calls
+        through receiver types (so abstract replica seams are crossed)
+        and skips async callees: calling an ``async def`` without
+        awaiting it just builds a coroutine — it cannot block here, and
+        awaited paths are the *callee's* GL019 problem."""
+        if s.qname in self._blk_memo:
+            return self._blk_memo[s.qname], True
+        if s.blocking_sites:
+            self._blk_memo[s.qname] = [s.qname]
+            return self._blk_memo[s.qname], True
+        if depth >= 8 or s.qname in stack:
+            return None, False
+        stack = stack | {s.qname}
+        mod = self.modules.get(s.label)
+        if mod is None:
+            self._blk_memo[s.qname] = None
+            return None, True
+        complete = True
+        for site in s.calls:
+            for callee in self.resolve_method_candidates(
+                    mod, s, site.func_expr):
+                if callee.jitted or callee.is_async:
+                    continue
+                sub, sub_complete = self._blocking_search(callee, depth + 1,
+                                                          stack)
+                if sub is not None:
+                    self._blk_memo[s.qname] = [s.qname] + sub
+                    return self._blk_memo[s.qname], True
+                complete = complete and sub_complete
+        if complete:
+            self._blk_memo[s.qname] = None
+        return None, complete
+
+    def blocking_chain(self, s: FunctionSummary) -> Optional[List[str]]:
+        """qname chain from ``s`` to a function with a direct
+        event-loop-blocking site, or None. A GL019 pragma at the
+        blocking site stops the chain at the source."""
+        return self._blocking_search(s, 0, set())[0]
+
+    def blocking_site_of(self, qname: str) -> Optional[Tuple[str, int, str]]:
+        """(label, line, kind) of the first direct blocking site of a
+        summarized function, for chain-naming messages."""
+        label, name = qname.split("::", 1)
+        mod = self.modules.get(label)
+        fn = (mod.functions.get(name) if mod and name != "<module>"
+              else (mod.toplevel if mod else None))
+        if fn and fn.blocking_sites:
+            node, kind = fn.blocking_sites[0]
             return (label, getattr(node, "lineno", 0), kind)
         return None

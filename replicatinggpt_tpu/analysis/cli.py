@@ -66,8 +66,11 @@ def add_lint_flags(p: argparse.ArgumentParser) -> None:
                         "or enter the baseline")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule ids to run (default: all)")
-    p.add_argument("--format", choices=("text", "json", "sarif"),
-                   default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif", "github"),
+                   default="text",
+                   help="output format; 'github' prints workflow-command "
+                        "annotations (::error file=...,line=...) that "
+                        "GitHub Actions renders inline on the PR diff")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     p.add_argument("--docs", action="store_true",
@@ -79,6 +82,22 @@ def _print_findings(findings: List[Finding], stream=None) -> None:
     stream = stream or sys.stdout
     for f in findings:
         print(f.format(), file=stream)
+
+
+def _print_github(findings: Sequence[Finding],
+                  warnings: Sequence[Finding], stream=None) -> None:
+    """GitHub Actions workflow-command annotations: one ``::error`` /
+    ``::warning`` line per finding, which the Actions runner turns into
+    inline PR-diff annotations. Message text is %-escaped per the
+    workflow-command spec (%, CR, LF)."""
+    stream = stream or sys.stdout
+    for f in (*findings, *warnings):
+        kind = "error" if f.severity == "error" else "warning"
+        msg = (f"{f.rule} {f.message}".replace("%", "%25")
+               .replace("\r", "%0D").replace("\n", "%0A"))
+        print(f"::{kind} file={f.path},line={max(f.line, 1)},"
+              f"col={f.col + 1},title=graftlint {f.rule}::{msg}",
+              file=stream)
 
 
 def _parse_severity(args) -> Optional[Dict[str, str]]:
@@ -95,19 +114,47 @@ def _parse_severity(args) -> Optional[Dict[str, str]]:
     return out
 
 
+def _paths_from_name_status(text: str) -> Set[str]:
+    """Current-tree paths from ``git diff --name-status`` output.
+
+    Plain statuses (M/A/...) are ``<status>\\t<path>``; renames and
+    copies (R<score>/C<score>) are ``<status>\\t<old>\\t<new>`` — only
+    the NEW path exists in the working tree, so that is the lintable
+    one (the old path would silently drop the file from the scope,
+    hiding every finding a rename carried along)."""
+    out: Set[str] = set()
+    for line in text.splitlines():
+        parts = line.rstrip("\n").split("\t")
+        if len(parts) < 2:
+            continue
+        status = parts[0]
+        path = parts[2] if status[:1] in ("R", "C") and len(parts) >= 3 \
+            else parts[1]
+        if path.endswith(".py"):
+            out.add(path)
+    return out
+
+
 def changed_files(ref: str) -> Set[str]:
     """Repo-relative labels of .py files differing from ``ref`` in the
-    working tree, plus untracked ones."""
-    out: Set[str] = set()
-    for cmd in (["git", "diff", "--name-only", "--diff-filter=d", ref],
-                ["git", "ls-files", "--others", "--exclude-standard"]):
-        proc = subprocess.run(cmd, cwd=REPO_ROOT, capture_output=True,
-                              text=True, timeout=60)
-        if proc.returncode != 0:
-            raise SystemExit(f"--changed: `{' '.join(cmd)}` failed: "
-                             f"{proc.stderr.strip()}")
-        out |= {line.strip() for line in proc.stdout.splitlines()
-                if line.strip().endswith(".py")}
+    working tree (rename/copy-aware: R/C entries contribute their NEW
+    path), plus untracked ones."""
+    cmd = ["git", "diff", "--name-status", "-M", "-C",
+           "--diff-filter=d", ref]
+    proc = subprocess.run(cmd, cwd=REPO_ROOT, capture_output=True,
+                          text=True, timeout=60)
+    if proc.returncode != 0:
+        raise SystemExit(f"--changed: `{' '.join(cmd)}` failed: "
+                         f"{proc.stderr.strip()}")
+    out = _paths_from_name_status(proc.stdout)
+    cmd = ["git", "ls-files", "--others", "--exclude-standard"]
+    proc = subprocess.run(cmd, cwd=REPO_ROOT, capture_output=True,
+                          text=True, timeout=60)
+    if proc.returncode != 0:
+        raise SystemExit(f"--changed: `{' '.join(cmd)}` failed: "
+                         f"{proc.stderr.strip()}")
+    out |= {line.strip() for line in proc.stdout.splitlines()
+            if line.strip().endswith(".py")}
     return out
 
 
@@ -224,6 +271,8 @@ def run_lint(args) -> int:
             }))
         elif args.format == "sarif":
             print(json.dumps(render_sarif(findings, warnings)))
+        elif args.format == "github":
+            _print_github(findings, warnings)
         else:
             _print_findings(findings)
             _print_findings(warnings)
@@ -249,6 +298,8 @@ def run_lint(args) -> int:
         }))
     elif args.format == "sarif":
         print(json.dumps(render_sarif(diff.new, warnings)))
+    elif args.format == "github":
+        _print_github(diff.new, warnings)
     else:
         _print_findings(diff.new)
         for key in stale:

@@ -807,3 +807,119 @@ def check_donated_closure_capture(idx: ProjectIndex) -> List[Finding]:
                             f"silently stale values",
                             mod))
     return findings
+
+
+# --------------------------------------------------------------------------
+# GL019 — event-loop blocker reachable from an async def
+# --------------------------------------------------------------------------
+
+
+def check_async_blocking_call(idx: ProjectIndex) -> List[Finding]:
+    """A blocking operation (socket recv, fsync, ``time.sleep``,
+    subprocess, an RPC call with no explicit ``timeout_s``) directly in,
+    or transitively reachable from, an ``async def`` body. The serving
+    front door is a single-threaded asyncio loop: one blocked coroutine
+    stalls every request, every /healthz probe, and the SSE heartbeats
+    at once (the PR 9 hang class). Call resolution crosses receiver
+    types and abstract bases (``rep.submit(...)`` through ReplicaBase
+    reaches the RemoteReplica override), awaited calls never count, and
+    a GL019 pragma at the blocking site stops the chain at the source —
+    use it for sites whose blocking is budgeted by construction."""
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        for fn in mod.functions.values():
+            if not fn.is_async:
+                continue
+            for node, kind in fn.blocking_sites:
+                findings.append(_finding(
+                    "GL019", node,
+                    f"`{kind}` directly inside async "
+                    f"`{_display(fn.qname)}` blocks the event loop — "
+                    f"every other coroutine (requests, health probes, "
+                    f"SSE streams) stalls behind it; offload to an "
+                    f"executor or give the call an explicit timeout_s "
+                    f"budget", mod))
+            for site in fn.calls:
+                for callee in idx.resolve_method_candidates(
+                        mod, fn, site.func_expr):
+                    if callee.jitted or callee.is_async:
+                        continue
+                    chain = idx.blocking_chain(callee)
+                    if chain is None:
+                        continue
+                    src = idx.blocking_site_of(chain[-1])
+                    where = (f"`{src[2]}` at {src[0]}:{src[1]}" if src
+                             else "a blocking call")
+                    via = " -> ".join(_display(q) for q in chain)
+                    findings.append(_finding(
+                        "GL019", site.node,
+                        f"async `{_display(fn.qname)}` reaches {where} "
+                        f"(via {via}) — the single-threaded event loop "
+                        f"blocks for the full duration; offload the "
+                        f"chain to an executor or bound it with an "
+                        f"explicit timeout_s budget", mod))
+                    break          # one finding per call site
+    return findings
+
+
+# --------------------------------------------------------------------------
+# GL020 — terminal result recorded without the delivery ledger
+# --------------------------------------------------------------------------
+
+
+def check_unledgered_finish(idx: ProjectIndex) -> List[Finding]:
+    """In a class that owns a crash ledger/journal, any method that
+    stores a terminal result (``self.results[...] = ...``) must also
+    route through ``record_finish`` in the same method — the
+    exactly-once dedupe seam. A finish path that skips the ledger
+    resurrects the request on the next crash recovery (the journal
+    replays what it never saw finish) and double-delivers its stream."""
+    findings: List[Finding] = []
+    for mod in idx.modules.values():
+        for info in mod.classes.values():
+            if info.node is None:
+                continue
+            has_ledger = False
+            for sub in ast.walk(info.node):
+                target = None
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    target = sub.targets[0]
+                elif isinstance(sub, ast.AnnAssign):
+                    target = sub.target
+                if isinstance(target, ast.Attribute) \
+                        and isinstance(target.value, ast.Name) \
+                        and target.value.id == "self" \
+                        and target.attr in ("ledger", "journal"):
+                    has_ledger = True
+                    break
+            if not has_ledger:
+                continue
+            for m in info.node.body:
+                if not isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                ledgered = any(
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "record_finish"
+                    for sub in ast.walk(m))
+                if ledgered:
+                    continue
+                for sub in ast.walk(m):
+                    if isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1 \
+                            and isinstance(sub.targets[0], ast.Subscript):
+                        t = sub.targets[0].value
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self" \
+                                and t.attr == "results":
+                            findings.append(_finding(
+                                "GL020", sub,
+                                f"`{info.name}.{m.name}` stores a "
+                                f"terminal result without calling "
+                                f"record_finish — this finish bypasses "
+                                f"the delivery ledger, so a crash "
+                                f"recovery will resurrect the request "
+                                f"and double-deliver its stream", mod))
+    return findings

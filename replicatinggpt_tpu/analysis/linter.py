@@ -35,6 +35,7 @@ from pathlib import Path
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 from .callgraph import ProjectIndex, parse_pragmas
+from . import rules as rules_mod
 from .rules import RULES, Finding
 
 #: repo root when running from a checkout (analysis/ -> package -> root)
@@ -136,6 +137,7 @@ def _lint_files(ctxs: Sequence[_FileCtx],
     pragma filtering and severity tiering."""
     severity = DEFAULT_SEVERITY if severity is None else severity
     active = [RULES[r] for r in (rule_ids or sorted(RULES))]
+    rules_mod._ALL_FUNCTIONS_CACHE.clear()
     res = LintResult(files=len(ctxs))
 
     parsed = [c for c in ctxs if c.tree is not None]

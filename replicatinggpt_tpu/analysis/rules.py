@@ -30,7 +30,7 @@ from __future__ import annotations
 import ast
 import re
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 # ---------------------------------------------------------------------------
 # data model
@@ -88,11 +88,16 @@ def _register(rule: Rule) -> Rule:
 
 
 def _project(check_name: str):
-    """Lazy dispatch into dataflow.py (rules.py is imported by it, so
-    the project checkers bind at call time, not import time)."""
+    """Lazy dispatch into dataflow.py / contracts.py (rules.py is
+    imported by both, so the project checkers bind at call time, not
+    import time). dataflow owns the callgraph-walking families;
+    contracts owns the wire/config/metrics contract registry (v3)."""
     def run(index):
-        from . import dataflow
-        return getattr(dataflow, check_name)(index)
+        from . import contracts, dataflow
+        target = getattr(dataflow, check_name, None)
+        if target is None:
+            target = getattr(contracts, check_name)
+        return target(index)
     run.__name__ = check_name
     return run
 
@@ -215,9 +220,22 @@ def _top_level_functions(tree: ast.Module) -> List[ast.FunctionDef]:
     return out
 
 
+#: keyed on id(tree): every per-file rule asks for the same function
+#: list, and re-walking a large module once per rule dominates the
+#: per-file pass. The strong tree reference makes id() aliasing
+#: impossible while an entry lives; the linter clears the cache at the
+#: start of each run so trees don't accumulate across runs.
+_ALL_FUNCTIONS_CACHE: Dict[int, Tuple[ast.Module, List[ast.FunctionDef]]] = {}
+
+
 def _all_functions(tree: ast.Module) -> List[ast.FunctionDef]:
-    return [n for n in ast.walk(tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    hit = _ALL_FUNCTIONS_CACHE.get(id(tree))
+    if hit is not None and hit[0] is tree:
+        return hit[1]
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    _ALL_FUNCTIONS_CACHE[id(tree)] = (tree, fns)
+    return fns
 
 
 # ---------------------------------------------------------------------------
@@ -1629,6 +1647,287 @@ def write(ck, k_m, layer, phys, woff):
         k_m.astype(ck.dtype), mode="drop")   # store dtype explicit
 """,
     checker=_check_dtype_drift))
+
+
+# ---------------------------------------------------------------------------
+# GL018–GL023 — distributed-protocol & async-concurrency family (v3).
+# All six are project_checker-only: the contracts they check (wire
+# codecs, forwarding whitelists, metric schemas, trace pins) span files
+# by construction. dataflow.py hosts the callgraph-walking pair
+# (GL019/GL020); contracts.py hosts the contract-registry four.
+# ---------------------------------------------------------------------------
+
+
+_register(Rule(
+    id="GL018", name="rpc-verb-contract",
+    rationale=(
+        "The fleet RPC wire protocol is JSON dicts over a framed "
+        "socket: nothing type-checks the verb names or the per-verb "
+        "request/response keys, so a key renamed on one side of the "
+        "router/worker boundary fails at RUNTIME on the other — as a "
+        "worker-side KeyError that downs the replica, or worse, a "
+        "``.get()`` default silently zeroing a field every wire "
+        "crossing (the drift class every fleet PR since PR 13 fixed "
+        "by hand at review). Both sides are literal AST structure: "
+        "``op_<verb>`` handlers on dispatch classes read "
+        "``doc[\"k\"]`` (required) / ``doc.get(\"k\")`` or "
+        "branch-guarded keys (optional) and return literal dicts; "
+        "call sites name the verb and keys literally. The rule "
+        "cross-checks verb existence in both directions, sent-vs-read "
+        "request keys, caller reads vs returned response keys, and "
+        "``<stem>_to_wire``/``<stem>_from_wire`` codec pairs. A "
+        "``**spread`` on either side opens that set (no guessing); "
+        "the checks engage only when a dispatch class or codec pair "
+        "exists in the linted project."),
+    bad="""\
+class Worker:
+    def dispatch(self, doc):
+        return getattr(self, "op_" + doc.get("op"))(doc)
+    def op_submit(self, doc):
+        req = doc["req"]                     # required key
+        return {"accepted": True}
+    def op_drain(self, doc):                 # no caller anywhere: dead verb
+        return {}
+
+class Client:
+    def __init__(self, call):
+        self.call = call
+    def submit(self, req):
+        resp = self.call("submit", payload=req)   # sends 'payload',
+        return resp["rejection"]                  # reads a key never returned
+""",
+    good="""\
+class Worker:
+    def dispatch(self, doc):
+        return getattr(self, "op_" + doc.get("op"))(doc)
+    def op_submit(self, doc):
+        req = doc["req"]
+        if not req:
+            return {"accepted": False, "rejection": "empty"}
+        return {"accepted": True}
+
+class Client:
+    def __init__(self, call):
+        self.call = call
+    def submit(self, req):
+        resp = self.call("submit", req=req, timeout_s=1.0)
+        if not resp["accepted"]:
+            return resp["rejection"]
+        return None
+""",
+    project_checker=_project("check_rpc_verb_contract")))
+
+
+_register(Rule(
+    id="GL019", name="async-blocking-call",
+    rationale=(
+        "The serving front door and the worker host are "
+        "single-threaded asyncio loops: ONE blocking call inside any "
+        "coroutine stalls every concurrent request, every /healthz "
+        "probe, and every SSE heartbeat simultaneously (the PR 9 "
+        "``/healthz`` hang was exactly this — a liveness probe stuck "
+        "behind a sick worker's socket). Blocking hides behind "
+        "helpers, so the check is interprocedural: socket "
+        "``.recv()``, ``os.fsync``, ``time.sleep``, subprocess "
+        "calls, and RPC ``.call(\"verb\", ...)`` sites with no "
+        "explicit ``timeout_s`` budget are blocking sites, and any "
+        "``async def`` that reaches one through sync calls — "
+        "including through receiver types and abstract bases like "
+        "``rep.submit(...)`` via ReplicaBase — is flagged at its "
+        "call site with the full chain. Awaited calls never count "
+        "(they yield), and a reviewed ``# graftlint: disable=GL019`` "
+        "at the blocking site blesses every caller: use it for sites "
+        "whose blocking is budgeted by construction (a socket under "
+        "``settimeout``, deliberate chaos injection)."),
+    bad="""\
+import time
+
+class Poller:
+    def _backoff(self):
+        time.sleep(0.5)                  # blocks the event loop
+
+    async def tick(self, client):
+        self._backoff()                  # reached from async def
+        return client.call("health")     # untimed RPC: unbounded stall
+""",
+    good="""\
+import asyncio
+
+class Poller:
+    async def tick(self, client, loop):
+        await asyncio.sleep(0.5)         # yields instead of blocking
+        return await loop.run_in_executor(
+            None, lambda: client.call("health", timeout_s=1.0))
+""",
+    project_checker=_project("check_async_blocking_call")))
+
+
+_register(Rule(
+    id="GL020", name="unledgered-finish",
+    rationale=(
+        "Exactly-once delivery across crashes hangs on ONE seam: "
+        "every terminal result must route through the crash ledger's "
+        "``record_finish`` before (or with) its delivery-map store. "
+        "A finish path that stores ``self.results[...]`` without the "
+        "ledger write works perfectly until the next crash recovery, "
+        "when the journal replays the request it never saw finish — "
+        "double-delivering its stream to the client (the PR 13 "
+        "ledger exists precisely to prevent this). The rule arms on "
+        "classes that own a ``self.ledger``/``self.journal`` and "
+        "flags any method storing into ``self.results`` without a "
+        "``record_finish`` call in the same method."),
+    bad="""\
+class MiniRouter:
+    def __init__(self, journal):
+        self.journal = journal
+        self.results = {}
+
+    def on_finish(self, res):
+        self.results[res.id] = res       # crash-recovery will resurrect it
+""",
+    good="""\
+class MiniRouter:
+    def __init__(self, journal):
+        self.journal = journal
+        self.results = {}
+
+    def on_finish(self, res):
+        if self.journal is not None:
+            self.journal.record_finish(res.id, res.finish_reason)
+        self.results[res.id] = res       # ledger first, then delivery
+""",
+    project_checker=_project("check_unledgered_finish")))
+
+
+_register(Rule(
+    id="GL021", name="counter-schema-drift",
+    rationale=(
+        "Dashboards and alerts index Prometheus counters BY NAME, and "
+        "``Metrics.inc`` creates counters on first increment — so a "
+        "counter absent from the pinned exposition schema "
+        "(``PROM_PINNED_COUNTERS`` in utils/telemetry.py) reads as "
+        "'no data' instead of 0 until its first event, which for "
+        "failure counters is exactly when you needed the alert to "
+        "have been armed. Drift goes both ways: an increment outside "
+        "the pinned schema (a new fleet_* counter nobody pinned), "
+        "and a pinned name no code path increments (a rename that "
+        "left the schema behind — the exposition advertises a metric "
+        "that can never move). Literal and resolvable-constant "
+        "increment names check exactly; ``\"prefix_\" + reason`` "
+        "increments match pins by prefix; a fully dynamic "
+        "``inc(k)`` anywhere disables the never-incremented "
+        "direction (it could increment anything). Skipped entirely "
+        "when the linted project has no pins tuple."),
+    bad="""\
+PROM_PINNED_COUNTERS = (
+    "fleet_requests_routed",
+    "fleet_requeue_retries",             # nothing increments this
+)
+
+def step(metrics):
+    metrics.inc("fleet_requests_routed")
+    metrics.inc("fleet_replica_downs")   # incremented but not pinned
+""",
+    good="""\
+PROM_PINNED_COUNTERS = (
+    "fleet_requests_routed",
+    "fleet_replica_downs",
+)
+
+def step(metrics):
+    metrics.inc("fleet_requests_routed")
+    metrics.inc("fleet_replica_downs")
+    metrics.inc("engine_steps")          # outside the pinned families: fine
+""",
+    project_checker=_project("check_counter_schema_drift")))
+
+
+_register(Rule(
+    id="GL022", name="forwarded-flag-drift",
+    rationale=(
+        "``serve --multiproc`` respawns workers by RECONSTRUCTING the "
+        "command line from the ``ENGINE_FORWARD_FLAGS`` / "
+        "``ENGINE_FORWARD_SWITCHES`` whitelists — an ``EngineConfig`` "
+        "knob the whitelist doesn't carry means a fleet of workers "
+        "silently serving a DIFFERENT engine shape (pool, pages, "
+        "decode window, mesh slice) than the operator asked for: the "
+        "exact bug class PR 9's review caught by hand. Three drift "
+        "directions, all literal AST: a builder keyword whose "
+        "``args.<dest>`` read no whitelist entry carries; an "
+        "``EngineConfig`` field the builder never passes (the flag "
+        "surface cannot express it at all); and a stale whitelist "
+        "row whose dest the builder no longer reads. The "
+        "``MODEL_OVERRIDE_FLAGS`` dests are checked against "
+        "``ModelConfig``'s fields the same way. Skipped when the "
+        "linted project has no whitelist assignment."),
+    bad="""\
+ENGINE_FORWARD_FLAGS = (
+    ("pool_size", "--pool-size"),
+    ("stale_knob", "--stale-knob"),      # builder never reads it
+)
+
+class EngineConfig:
+    pool_size: int = 8
+    max_queue: int = 64
+    page_size: int = 0                   # never passed: inexpressible
+
+def engine_config_from_args(args):
+    return EngineConfig(pool_size=args.pool_size,
+                        max_queue=args.max_queue)   # not whitelisted
+""",
+    good="""\
+ENGINE_FORWARD_FLAGS = (
+    ("pool_size", "--pool-size"),
+    ("max_queue", "--max-queue"),
+    ("page_size", "--page-size"),
+)
+
+class EngineConfig:
+    pool_size: int = 8
+    max_queue: int = 64
+    page_size: int = 0
+
+def engine_config_from_args(args):
+    return EngineConfig(pool_size=args.pool_size,
+                        max_queue=args.max_queue,
+                        page_size=args.page_size)
+""",
+    project_checker=_project("check_forwarded_flag_drift")))
+
+
+_register(Rule(
+    id="GL023", name="telemetry-span-contract",
+    rationale=(
+        "``tools/trace_check.py`` validates exported Chrome traces "
+        "against named event envelopes (``TRACE_VALIDATED_NAMES``): "
+        "request begin/end pairing, page_transfer spans, token "
+        "instants, thread_name metadata. The validator and the "
+        "emitters drift independently — a span renamed at the "
+        "emission site leaves the validator pinning a name nothing "
+        "emits, so ``check_trace`` either rejects every healthy "
+        "trace or (worse) the validation goes dead and the soak "
+        "gate stops checking anything. The rule collects every "
+        "literal or constant-resolvable name passed to "
+        "``begin/end/instant/complete/span/name_track`` and every "
+        "``{\"ph\": ..., \"name\": ...}`` event literal, and flags "
+        "pinned names with no emission site. Skipped when the "
+        "linted project has no pins tuple."),
+    bad="""\
+TRACE_VALIDATED_NAMES = ("request", "token", "page_transfer")
+
+def emit(t, track, rid):
+    t.begin("request", track, id=rid)
+    t.instant("token", track, index=0)   # 'page_transfer' never emitted
+""",
+    good="""\
+TRACE_VALIDATED_NAMES = ("request", "token")
+
+def emit(t, track, rid):
+    t.begin("request", track, id=rid)
+    t.instant("token", track, index=0)
+    t.end("request", track)
+""",
+    project_checker=_project("check_telemetry_span_contract")))
 
 
 def all_rule_ids() -> List[str]:

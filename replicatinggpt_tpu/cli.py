@@ -34,6 +34,7 @@ ENGINE_FORWARD_FLAGS = (
     ("max_queue", "--max-queue"),
     ("prefill_chunk", "--prefill-chunk"),
     ("page_size", "--page-size"),
+    ("max_pages", "--max-pages"),
     ("n_pages", "--n-pages"),
     ("decode_window", "--decode-window"),
     ("mesh_shape", "--mesh-shape"),
@@ -43,7 +44,8 @@ ENGINE_FORWARD_FLAGS = (
 )
 #: store_true engine switches, forwarded only when set
 ENGINE_FORWARD_SWITCHES = (("no_prefix_cache", "--no-prefix-cache"),
-                           ("decode_window_auto", "--decode-window-auto"))
+                           ("decode_window_auto", "--decode-window-auto"),
+                           ("paged_kernel", "--paged-kernel"))
 
 
 def add_engine_flags(p: argparse.ArgumentParser) -> None:
@@ -60,6 +62,10 @@ def add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--page-size", type=int, default=0,
                    help="tokens per KV-cache page (0 = min(16, "
                         "block_size)); see docs/serving.md")
+    p.add_argument("--max-pages", type=int, default=0,
+                   help="logical KV pages per slot (0 = "
+                        "ceil(block_size / page_size)); capping below "
+                        "that bounds per-request KV length")
     p.add_argument("--n-pages", type=int, default=0,
                    help="physical KV pages in the pool (0 = "
                         "pool_size * pages-per-slot — the contiguous "
@@ -111,6 +117,11 @@ def add_engine_flags(p: argparse.ArgumentParser) -> None:
                         "serialized calibration next to "
                         "--checkpoint-dir is applied when present, "
                         "else computed (and saved) at startup")
+    p.add_argument("--paged-kernel", action="store_true",
+                   help="opt into the Pallas paged decode fast path "
+                        "(falls back to the XLA gather route when the "
+                        "mesh or dtype rules it out — see "
+                        "ops/paged_pallas.paged_kernel_mesh_ok)")
     p.add_argument("--quant-granularity", default="page",
                    choices=["page", "head"],
                    help="KV scale granularity: 'page' = one f32 scale "
@@ -148,8 +159,10 @@ def engine_config_from_args(args: argparse.Namespace):
     return EngineConfig(pool_size=args.pool_size,
                         max_queue=args.max_queue,
                         prefill_chunk=args.prefill_chunk,
-                        page_size=args.page_size, n_pages=args.n_pages,
+                        page_size=args.page_size,
+                        max_pages=args.max_pages, n_pages=args.n_pages,
                         prefix_cache=not args.no_prefix_cache,
+                        paged_kernel=args.paged_kernel,
                         decode_window=args.decode_window,
                         decode_window_auto=args.decode_window_auto,
                         mesh_data=d, mesh_model=m,

@@ -524,6 +524,7 @@ class RemoteReplica(ReplicaBase):
     def submit(self, req: Request) -> Optional[RequestResult]:
         try:
             resp = self._call("submit",
+                              timeout_s=self.rpc_timeout_s,
                               req=request_to_wire(
                                   req, time.monotonic()))
         except RpcTimeout:
@@ -544,8 +545,8 @@ class RemoteReplica(ReplicaBase):
 
     def cancel(self, request_id: str, migrated: bool = False) -> bool:
         try:
-            resp = self._call("cancel", id=request_id,
-                              migrated=migrated)
+            resp = self._call("cancel", timeout_s=self.rpc_timeout_s,
+                              id=request_id, migrated=migrated)
         except (ReplicaDownError, RpcTimeout):
             return False
         return bool(resp.get("found"))
@@ -576,7 +577,7 @@ class RemoteReplica(ReplicaBase):
     def stream_drain(self) -> None:
         """Refresh the committed-token cache without forcing a step
         (reconnect reconciliation)."""
-        resp = self._call("stream_drain")
+        resp = self._call("stream_drain", timeout_s=self.rpc_timeout_s)
         self._partials.update({rid: list(toks) for rid, toks
                                in resp.get("partials", {}).items()})
 
@@ -598,8 +599,9 @@ class RemoteReplica(ReplicaBase):
         cursor = 0
         while True:
             try:
-                resp = self._call("journal_drain", cursor=cursor,
-                                  kinds=list(kinds))
+                resp = self._call("journal_drain",
+                                  timeout_s=self.rpc_timeout_s,
+                                  cursor=cursor, kinds=list(kinds))
             except (ReplicaDownError, RpcTimeout, RpcError):
                 break
             for rec in resp.get("records", []):
@@ -701,7 +703,7 @@ class RemoteReplica(ReplicaBase):
 
     def summary_block(self) -> dict:
         try:
-            resp = self._call("summary")
+            resp = self._call("summary", timeout_s=self.rpc_timeout_s)
             block = resp.get("block", {})
         except (ReplicaDownError, RpcTimeout):
             block = {"occupancy_mean": 0.0,
@@ -928,7 +930,7 @@ class Router:
                 # the injected wedge: the replica's step stalls, inside
                 # the router's measurement — indistinguishable from a
                 # wedged device or a partition to that replica
-                time.sleep(delay)
+                time.sleep(delay)  # graftlint: disable=GL019 — chaos injection: the wedge MUST stall the loop
             try:
                 finished = rep.step_engine()
             except ReplicaDownError as e:

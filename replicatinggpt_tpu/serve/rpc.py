@@ -341,7 +341,9 @@ class RpcClient:
     def _recv_exact(self, n: int) -> bytes:
         buf = b""
         while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
+            # budget-bounded: call() sets sock.settimeout from its
+            # timeout_s before every frame, so this recv cannot hang
+            chunk = self._sock.recv(n - len(buf))  # graftlint: disable=GL019
             if not chunk:
                 raise RpcDown("connection closed mid-frame")
             buf += chunk

@@ -53,8 +53,36 @@ __all__ = [
     "ENGINE_TRACK", "SLOT_TRACK_BASE", "REPLICA_TRACK_STRIDE",
     "ROUTER_TRACK", "ROUTER_TRACK_NAME", "NULL", "NullTelemetry",
     "Telemetry", "MetricsTimeline", "chrome_trace_from_jsonl",
-    "load_jsonl", "prometheus_text",
+    "load_jsonl", "prometheus_text", "PROM_PINNED_COUNTERS",
 ]
+
+#: The fleet-dashboard counter schema: every name a Grafana panel or
+#: alert rule keys on. ``prometheus_text`` emits each of these at 0
+#: even before its first increment, so a freshly started router scrapes
+#: a complete series set (a rate() over a counter that APPEARS mid-run
+#: is indistinguishable from a restart). graftlint GL021 holds this
+#: tuple against the actual ``metrics.inc(...)`` literals — a counter
+#: renamed in code without updating this pin (or vice versa) is a
+#: silently-flatlined dashboard panel.
+PROM_PINNED_COUNTERS = (
+    # serve/router.py — fleet lifecycle, routing, disagg, transfers
+    "fleet_ledger_recovered", "fleet_requests_submitted",
+    "fleet_dedup_rejects", "fleet_replica_downs", "fleet_replicas_added",
+    "fleet_requeued_requests", "fleet_ghost_cancels",
+    "fleet_replica_attaches", "fleet_drains", "fleet_requests_routed",
+    "fleet_route_fallbacks", "fleet_disagg_shortcircuits",
+    "fleet_disagg_fallbacks", "fleet_disagg_prefills", "fleet_transfers",
+    "fleet_transfer_pages", "fleet_transfer_bytes",
+    "fleet_transfer_failures", "fleet_stale_finishes",
+    "fleet_ghost_finishes", "fleet_requests_finished",
+    "fleet_replica_rejoins", "fleet_replica_wedges", "fleet_replica_kills",
+    "fleet_requeue_submits", "fleet_requeue_exhausted",
+    "fleet_requeue_retries",
+    # faults/procsup.py — autoscaler actions
+    "fleet_scale_ups", "fleet_scale_downs",
+    # serve/http.py — front-door admission
+    "http_rate_limited",
+)
 
 #: engine-level track (steps, drafts, recovery markers); per-slot
 #: request trees live on SLOT_TRACK_BASE + slot
@@ -398,10 +426,13 @@ def prometheus_text(metrics, prefix: str = "tpu_gpt",
     lets the caller fold in derived values (pages_in_use, spec accept
     rate, ...) without teaching Metrics about them."""
     lines: List[str] = []
-    for name in sorted(metrics.counters):
+    counters = dict(metrics.counters)
+    for name in PROM_PINNED_COUNTERS:
+        counters.setdefault(name, 0)
+    for name in sorted(counters):
         pn = _prom_name(name, prefix)
         lines.append(f"# TYPE {pn} counter")
-        lines.append(f"{pn} {_prom_value(metrics.counters[name])}")
+        lines.append(f"{pn} {_prom_value(counters[name])}")
     gauges = dict(metrics.gauges)
     if extra_gauges:
         gauges.update(extra_gauges)

@@ -1,0 +1,26 @@
+"""GL018 bad: verb/key drift between a dispatch class and its caller."""
+
+
+class WorkerStub:
+    def dispatch(self, doc):
+        op = doc.get("op")
+        return getattr(self, "op_" + op)(doc)
+
+    def op_submit(self, doc):
+        req = doc["req"]
+        return {"accepted": bool(req)}
+
+    def op_orphan(self, doc):
+        # no literal .call("orphan", ...) site anywhere: dead verb
+        return {}
+
+
+class ClientStub:
+    def __init__(self, call):
+        self.call = call
+
+    def submit(self, req):
+        # sends 'payload' (never read), omits required 'req', and reads
+        # 'rejection' off a response that never returns it
+        resp = self.call("submit", payload=req, timeout_s=1.0)
+        return resp["rejection"]

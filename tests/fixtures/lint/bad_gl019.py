@@ -1,0 +1,20 @@
+"""GL019 bad: event-loop blockers in and below async defs."""
+
+import time
+
+
+class Poller:
+    def _backoff(self):
+        time.sleep(0.5)
+
+    async def tick(self):
+        # reaches time.sleep through a sync helper
+        self._backoff()
+
+    async def drain(self, sock):
+        # direct blocking socket read inside a coroutine
+        return sock.recv(4096)
+
+    async def probe(self, client):
+        # RPC call with no explicit timeout_s budget
+        return client.call("health")

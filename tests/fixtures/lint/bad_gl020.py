@@ -1,0 +1,12 @@
+"""GL020 bad: a finish path that bypasses the crash ledger."""
+
+
+class MiniRouter:
+    def __init__(self, journal):
+        self.journal = journal
+        self.results = {}
+
+    def on_finish(self, res):
+        # terminal store without record_finish: the next crash recovery
+        # replays this request and double-delivers its stream
+        self.results[res.id] = res

@@ -1,0 +1,12 @@
+"""GL021 bad: counter drift in both directions against the pins."""
+
+PROM_PINNED_COUNTERS = (
+    "fleet_requests_routed",
+    "fleet_requeue_retries",      # nothing increments this
+)
+
+
+class Stepper:
+    def step(self, metrics):
+        metrics.inc("fleet_requests_routed")
+        metrics.inc("fleet_replica_downs")      # incremented, not pinned

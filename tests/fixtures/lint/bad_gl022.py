@@ -1,0 +1,18 @@
+"""GL022 bad: all three forwarding-drift directions at once."""
+
+ENGINE_FORWARD_FLAGS = (
+    ("pool_size", "--pool-size"),
+    ("stale_knob", "--stale-knob"),   # builder never reads it
+)
+ENGINE_FORWARD_SWITCHES = ()
+
+
+class EngineConfig:
+    pool_size: int = 8
+    max_queue: int = 64
+    page_size: int = 0                # never passed: inexpressible
+
+
+def engine_config_from_args(args):
+    return EngineConfig(pool_size=args.pool_size,
+                        max_queue=args.max_queue)   # dest not whitelisted

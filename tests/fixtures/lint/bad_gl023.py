@@ -1,0 +1,9 @@
+"""GL023 bad: the validator pins a span name nothing emits."""
+
+TRACE_VALIDATED_NAMES = ("request", "token", "page_transfer")
+
+
+def emit(t, track, rid):
+    t.begin("request", track, id=rid)
+    t.instant("token", track, index=0)
+    t.end("request", track)

@@ -1,0 +1,27 @@
+"""GL018 good: every verb has a caller, keys agree in both directions."""
+
+
+class WorkerStub:
+    def dispatch(self, doc):
+        op = doc.get("op")
+        fn = getattr(self, "op_" + op, None)
+        if fn is None:
+            raise ValueError(op)
+        return fn(doc)
+
+    def op_submit(self, doc):
+        req = doc["req"]
+        if not req:
+            return {"accepted": False, "rejection": "empty"}
+        return {"accepted": True}
+
+
+class ClientStub:
+    def __init__(self, call):
+        self.call = call
+
+    def submit(self, req):
+        resp = self.call("submit", req=req, timeout_s=1.0)
+        if not resp["accepted"]:
+            return resp["rejection"]
+        return None

@@ -1,0 +1,16 @@
+"""GL019 good: yield instead of blocking; budget or offload the rest."""
+
+import asyncio
+
+
+class Poller:
+    async def tick(self):
+        await asyncio.sleep(0.5)
+
+    async def drain(self, reader):
+        return await reader.read(4096)
+
+    async def probe(self, client, loop):
+        # blocking work offloaded to an executor, with a timeout budget
+        return await loop.run_in_executor(
+            None, lambda: client.call("health", timeout_s=1.0))

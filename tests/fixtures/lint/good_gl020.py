@@ -1,0 +1,12 @@
+"""GL020 good: ledger first, then the delivery-map store."""
+
+
+class MiniRouter:
+    def __init__(self, journal):
+        self.journal = journal
+        self.results = {}
+
+    def on_finish(self, res):
+        if self.journal is not None:
+            self.journal.record_finish(res.id, res.finish_reason)
+        self.results[res.id] = res

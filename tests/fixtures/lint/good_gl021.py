@@ -1,0 +1,13 @@
+"""GL021 good: every pin incremented, every family literal pinned."""
+
+PROM_PINNED_COUNTERS = (
+    "fleet_requests_routed",
+    "fleet_replica_downs",
+)
+
+
+class Stepper:
+    def step(self, metrics):
+        metrics.inc("fleet_requests_routed")
+        metrics.inc("fleet_replica_downs")
+        metrics.inc("engine_steps")   # outside the pinned families: fine

@@ -1,0 +1,22 @@
+"""GL022 good: whitelist, builder, and config fields all agree."""
+
+ENGINE_FORWARD_FLAGS = (
+    ("pool_size", "--pool-size"),
+    ("max_queue", "--max-queue"),
+    ("page_size", "--page-size"),
+)
+ENGINE_FORWARD_SWITCHES = (("no_prefix_cache", "--no-prefix-cache"),)
+
+
+class EngineConfig:
+    pool_size: int = 8
+    max_queue: int = 64
+    page_size: int = 0
+    prefix_cache: bool = True
+
+
+def engine_config_from_args(args):
+    return EngineConfig(pool_size=args.pool_size,
+                        max_queue=args.max_queue,
+                        page_size=args.page_size,
+                        prefix_cache=not args.no_prefix_cache)

@@ -1,0 +1,10 @@
+"""GL023 good: every pinned name has an emission site."""
+
+TRACE_VALIDATED_NAMES = ("request", "token", "page_transfer")
+
+
+def emit(t, track, rid, pages):
+    t.begin("request", track, id=rid)
+    t.instant("token", track, index=0)
+    t.end("request", track)
+    t.complete("page_transfer", track, 0.0, 1.0, pages=pages)

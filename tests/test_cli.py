@@ -148,3 +148,27 @@ def test_lint_changed_wrapper_smoke():
          "--changed", "HEAD"], cwd=repo, capture_output=True, text=True,
         timeout=120)
     assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+
+
+def test_lint_github_format_annotations(capsys):
+    """`--format github` prints one workflow-command annotation per
+    finding (`::error file=...,line=...`) — what a GitHub Actions step
+    pipes to stdout to get inline PR-diff annotations."""
+    from replicatinggpt_tpu.cli import main
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = os.path.join(repo, "tests", "fixtures", "lint", "bad_gl019.py")
+    rc = main(["lint", "--format", "github", "--no-baseline",
+               "--severity", "tests/=error", "--rules", "GL019", bad])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [l for l in out.splitlines() if l]
+    assert lines and all(l.startswith("::error file=") for l in lines)
+    assert any("GL019" in l and ",line=" in l and ",col=" in l
+               for l in lines)
+    # clean run under the baseline: zero annotation lines, exit 0
+    rc = main(["lint", "--format", "github", "--baseline",
+               "--rules", "GL019"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert [l for l in out.splitlines()
+            if l.startswith("::error")] == []

@@ -31,13 +31,15 @@ NO_TIERS = {}
 
 
 def test_registry_shape():
-    assert len(RULES) >= 14                   # v1 rules + the mesh family
+    assert len(RULES) >= 23     # v1 + mesh family + protocol family
     for rid, rule in RULES.items():
         assert rid == rule.id and rid.startswith("GL") and len(rid) == 5
         assert rule.name and rule.rationale and rule.bad and rule.good
         assert callable(rule.checker) or callable(rule.project_checker)
     for rid in ("GL010", "GL011", "GL012", "GL013", "GL014"):
         assert rid in RULES                   # the sharding/mesh family
+    for rid in ("GL018", "GL019", "GL020", "GL021", "GL022", "GL023"):
+        assert rid in RULES                   # the protocol/async family
 
 
 @pytest.mark.parametrize("rule_id", RULE_IDS)
@@ -667,3 +669,156 @@ def test_docs_generated_from_registry_in_sync():
         "docs/graftlint_rules.md`")
     for rid in RULE_IDS:                      # every rule documented
         assert f"## {rid}" in committed
+
+
+# ---------------------------------------------------------------------------
+# protocol & async-concurrency family (GL018-GL023): contract registry
+# round-trips and mutation coverage
+# ---------------------------------------------------------------------------
+
+
+def _lint_sources(files, rule_ids):
+    """Lint (label, source) pairs as one multi-module project — the
+    mutation tests below lint real-file text with one line changed."""
+    from replicatinggpt_tpu.analysis.linter import _lint_files, _parse_file
+    ctxs = [_parse_file(src, label) for label, src in files]
+    return _lint_files(ctxs, rule_ids, severity=NO_TIERS)
+
+
+def test_changed_files_rename_and_copy_entries():
+    """--changed parses `git diff --name-status -M -C`: renames (R<score>)
+    and copies (C<score>) contribute their NEW path — the one that exists
+    in the working tree — not the old one, and non-.py entries drop."""
+    from replicatinggpt_tpu.analysis.cli import _paths_from_name_status
+    out = _paths_from_name_status(
+        "M\treplicatinggpt_tpu/serve/router.py\n"
+        "R097\treplicatinggpt_tpu/serve/old_name.py\t"
+        "replicatinggpt_tpu/serve/new_name.py\n"
+        "C075\ttools/base.py\ttools/base_copy.py\n"
+        "A\ttools/brand_new.py\n"
+        "M\tREADME.md\n"
+        "D\tgone.py\n")
+    assert out == {"replicatinggpt_tpu/serve/router.py",
+                   "replicatinggpt_tpu/serve/new_name.py",
+                   "tools/base_copy.py", "tools/brand_new.py",
+                   "gone.py"}
+
+
+_GL022_ROUND_TRIP = '''ENGINE_FORWARD_FLAGS = (
+    ("pool_size", "--pool-size"),{extra}
+)
+ENGINE_FORWARD_SWITCHES = ()
+
+
+class EngineConfig:
+    pool_size: int = 8
+    new_knob: int = 0
+
+
+def engine_config_from_args(args):
+    return EngineConfig(pool_size=args.pool_size,
+                        new_knob=args.new_knob)
+'''
+
+
+def test_gl022_registry_round_trip_synthetic_field():
+    """A synthetic EngineConfig field built from args trips GL022 until
+    its (dest, flag) pair lands in ENGINE_FORWARD_FLAGS."""
+    bad = _GL022_ROUND_TRIP.format(extra="")
+    res = lint_source(bad, "t.py", ["GL022"], severity=NO_TIERS)
+    assert len(res.findings) == 1, [f.format() for f in res.findings]
+    assert "new_knob" in res.findings[0].message
+    good = _GL022_ROUND_TRIP.format(
+        extra='\n    ("new_knob", "--new-knob"),')
+    res = lint_source(good, "t.py", ["GL022"], severity=NO_TIERS)
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+_GL018_ROUND_TRIP = '''class Worker:
+    def dispatch(self, op, doc):
+        return getattr(self, "op_" + op)(doc)
+
+    def op_submit(self, doc):
+        req = doc["req"]
+        return {{"accepted": bool(req)}}
+
+
+class Client:
+    def call(self, op, **kw):
+        return {{}}
+
+    def submit(self, payload):
+        resp = self.call("submit", {sent}timeout_s=1.0)
+        return resp["accepted"]
+'''
+
+
+def test_gl018_registry_round_trip_synthetic_verb():
+    """A call site that omits a key the handler reads unconditionally
+    trips GL018; sending the key makes the verb contract whole."""
+    bad = _GL018_ROUND_TRIP.format(sent="")
+    res = lint_source(bad, "t.py", ["GL018"], severity=NO_TIERS)
+    assert len(res.findings) == 1, [f.format() for f in res.findings]
+    assert "req" in res.findings[0].message
+    good = _GL018_ROUND_TRIP.format(sent="req=payload, ")
+    res = lint_source(good, "t.py", ["GL018"], severity=NO_TIERS)
+    assert res.findings == [], [f.format() for f in res.findings]
+
+
+# --- mutation coverage: each contract break produces EXACTLY ONE new
+# finding against the real project files (the acceptance criterion) ----
+
+
+def test_mutation_codec_key_drop_fires_exactly_one_gl018():
+    """Deleting one key from serve/rpc.py's result_to_wire leaves
+    result_from_wire reading a key the writer never sends: exactly one
+    new GL018."""
+    rel = "replicatinggpt_tpu/serve/rpc.py"
+    src = (REPO / rel).read_text()
+    assert lint_source(src, rel, ["GL018"], severity=NO_TIERS).findings \
+        == []
+    needle = '"queue_wait_s": res.queue_wait_s, '
+    assert needle in src
+    res = lint_source(src.replace(needle, ""), rel, ["GL018"],
+                      severity=NO_TIERS)
+    assert len(res.findings) == 1, [f.format() for f in res.findings]
+    assert "queue_wait_s" in res.findings[0].message
+    assert "never writes" in res.findings[0].message
+
+
+def test_mutation_forward_flag_drop_fires_exactly_one_gl022():
+    """Deleting one whitelist row from cli.py's ENGINE_FORWARD_FLAGS
+    orphans the builder keyword that reads it: exactly one new GL022."""
+    rel = "replicatinggpt_tpu/cli.py"
+    src = (REPO / rel).read_text()
+    assert lint_source(src, rel, ["GL022"], severity=NO_TIERS).findings \
+        == []
+    needle = '    ("page_size", "--page-size"),\n'
+    assert needle in src
+    res = lint_source(src.replace(needle, ""), rel, ["GL022"],
+                      severity=NO_TIERS)
+    assert len(res.findings) == 1, [f.format() for f in res.findings]
+    assert "page_size" in res.findings[0].message
+
+
+def test_mutation_counter_pin_drop_fires_exactly_one_gl021():
+    """Deleting one counter from the pinned Prometheus exposition
+    leaves its real inc site unpinned: exactly one new GL021. The
+    mini-project holds exactly the modules that increment pinned
+    counters (the full project contains fully-dynamic ``inc(name)``
+    sites that rightly disable the never-incremented direction)."""
+    tel_rel = "replicatinggpt_tpu/utils/telemetry.py"
+    others = ["replicatinggpt_tpu/serve/router.py",
+              "replicatinggpt_tpu/serve/http.py",
+              "replicatinggpt_tpu/faults/procsup.py"]
+    tel_src = (REPO / tel_rel).read_text()
+    files = [(rel, (REPO / rel).read_text()) for rel in others]
+    res = _lint_sources([(tel_rel, tel_src)] + files, ["GL021"])
+    assert res.findings == [], [f.format() for f in res.findings]
+    needle = '"fleet_drains", '
+    assert needle in tel_src
+    res = _lint_sources([(tel_rel, tel_src.replace(needle, ""))] + files,
+                        ["GL021"])
+    assert len(res.findings) == 1, [f.format() for f in res.findings]
+    assert "fleet_drains" in res.findings[0].message
+    assert res.findings[0].path.endswith("router.py")

@@ -2,8 +2,15 @@
 # Diff-aware graftlint: lint only the files that changed vs a ref
 # (default origin/main, falling back to main, then HEAD), with the
 # whole project still indexed so cross-file dataflow stays sound.
+# Renamed/copied files count as changed under their NEW path.
 # Intended as a pre-push hook:
 #   ln -s ../../tools/lint_changed.sh .git/hooks/pre-push
+#
+# Exit codes (the linter's, passed through by exec):
+#   0  no new error-tier findings in the changed files
+#   1  at least one NEW finding (not baselined, not a tests/ warning)
+#   2  usage error — unknown rule id, bad --severity spec, or (from
+#      this wrapper) an argument that does not resolve to a commit
 set -euo pipefail
 # resolve symlinks first: installed as .git/hooks/pre-push, $0's dirname
 # would otherwise land us in .git/
@@ -30,4 +37,7 @@ if [ -z "$ref" ]; then
     done
 fi
 
-exec python -m replicatinggpt_tpu lint --baseline --changed "$ref"
+# tests/ stays warning-tier even here: a hook must apply the same
+# gate the tier-1 run applies, or pushes fail on findings CI ignores
+exec python -m replicatinggpt_tpu lint --baseline --changed "$ref" \
+    --severity 'tests/=warning'

@@ -73,6 +73,15 @@ UNSTARTED = {"request_unstarted"}
 #: are envelope-exempt (must match utils.telemetry.ROUTER_TRACK_NAME)
 ROUTER_TRACK_NAME = "router"
 
+#: Every event name this validator's logic keys on. graftlint GL023
+#: holds each entry against an actual emission site (a ``t.begin(...)``
+#: / ``t.instant(...)`` / thread_name metadata literal somewhere in the
+#: tree) — a span renamed at the emitter without updating the validator
+#: silently stops validating that lifecycle edge.
+TRACE_VALIDATED_NAMES = ("request", "page_transfer", "token",
+                         "request_unstarted", ROUTER_TRACK_NAME,
+                         "thread_name")
+
 
 def check_trace(path: str, min_requests: int = 0) -> List[str]:
     """Validate one trace file; returns a list of violation strings
