@@ -929,6 +929,10 @@ def bench_fleet(args) -> None:
                          "(host loss is a real SIGKILL + workdir "
                          "deletion of a worker PROCESS; the "
                          "in-process fleet has no host to lose)")
+    if getattr(args, "net_chaos", False) and not multiproc:
+        raise SystemExit("--net-chaos requires --multiproc (netchaos "
+                         "faults land on the fleet RPC wire; the "
+                         "in-process fleet has no wire to hurt)")
     lcfg = SessionLoadConfig(
         n_sessions=args.fleet_sessions, turns=args.fleet_turns,
         n_prefix_groups=args.fleet_prefix_groups, prefix_len=prefix_len,
@@ -961,6 +965,7 @@ def bench_fleet(args) -> None:
     import tempfile
     plan_ctx = contextlib.nullcontext()
     chaos_kind = None
+    chaos_faults = []
     if args.fleet_kill_at >= 0:
         # in-process: simulated replica_kill; multiproc: a REAL SIGKILL
         # of worker 0's OS process through the supervisor —
@@ -971,9 +976,38 @@ def bench_fleet(args) -> None:
             chaos_kind = KIND_HOST_LOSS
         else:
             chaos_kind = KIND_PROC_KILL
-        plan_ctx = installed(FaultPlan(Fault(
+        chaos_faults.append(Fault(
             site=FLEET_STEP, kind=chaos_kind, at=args.fleet_kill_at,
-            arg=0)))
+            arg=0))
+    if getattr(args, "net_chaos", False):
+        # the wire-fault ladder, fleet-wide spellings: duplicated and
+        # reordered submit frames (answered from the workers' reply
+        # caches — rpc_dup_suppressed must account for every one),
+        # delayed and dropped step frames (the ack/redelivery protocol
+        # absorbs the losses), and a 3-call one-way partition (the
+        # maybe-executed case: requests execute, responses vanish)
+        from replicatinggpt_tpu.faults.netchaos import (KIND_NET_DELAY,
+                                                        KIND_NET_DROP,
+                                                        KIND_NET_DUP,
+                                                        KIND_NET_PARTITION,
+                                                        KIND_NET_REORDER,
+                                                        net_site)
+        chaos_faults += [
+            Fault(site=net_site("*", "*", "submit"), kind=KIND_NET_DUP,
+                  at=1, times=2),
+            Fault(site=net_site("*", "*", "submit"),
+                  kind=KIND_NET_REORDER, at=4),
+            Fault(site=net_site("*", "*", "step"), kind=KIND_NET_DELAY,
+                  at=10, times=2, arg=0.01),
+            Fault(site=net_site("*", "*", "step"), kind=KIND_NET_DROP,
+                  at=25),
+            Fault(site=net_site("*", "*", "step"),
+                  kind=KIND_NET_PARTITION, at=40, times=3, arg2=1),
+        ]
+        chaos_kind = ("net_chaos" if chaos_kind is None
+                      else f"{chaos_kind}+net_chaos")
+    if chaos_faults:
+        plan_ctx = installed(FaultPlan(*chaos_faults))
     workers = None
     scale = None
     with tempfile.TemporaryDirectory() as td:
@@ -1507,6 +1541,17 @@ def main() -> None:
                         "supervisor, RPC registration, private journal "
                         "dirs); the artifact gains per-worker "
                         "pid/restart counts and requeue latency")
+    p.add_argument("--net-chaos", action="store_true",
+                   help="--mode fleet --multiproc: install the network "
+                        "fault ladder (faults/netchaos.py) on the "
+                        "fleet RPC wire mid-run — duplicated and "
+                        "reordered submit frames, delayed/dropped "
+                        "step frames, a one-way partition — and tag "
+                        "the artifact net_chaos; the router's "
+                        "idempotency keys, reply caches and "
+                        "ack/redelivery must absorb all of it "
+                        "(rpc_dup_suppressed et al. land in the "
+                        "artifact's router block)")
     p.add_argument("--fleet-host-loss", action="store_true",
                    help="--mode fleet --multiproc: upgrade "
                         "--fleet-kill-at to host_loss chaos (SIGKILL "

@@ -50,7 +50,9 @@ from .callgraph import ModuleInfo, ProjectIndex, dotted
 from .rules import Finding
 
 #: kwargs a call site may pass that are transport envelope, not payload
-_TRANSPORT_KEYS = {"timeout_s"}
+#: ("idem" / "gen" are consumed by the dispatch layer — the idempotency
+#: reply cache and the generation fence — never by op_ handlers)
+_TRANSPORT_KEYS = {"timeout_s", "idem", "gen"}
 _RPC_CALL_ATTRS = {"call", "_call"}
 
 
@@ -123,6 +125,10 @@ class CallSiteInfo:
     mod: ModuleInfo = None
     node: ast.Call = None
     sent: Set[str] = field(default_factory=set)
+    #: every kwarg at the site INCLUDING transport-envelope keys —
+    #: GL024 audits the envelope ("idem" present on mutating verbs)
+    #: that GL018's payload view deliberately excludes
+    sent_all: Set[str] = field(default_factory=set)
     sent_open: bool = False       # **spread at the call
     #: name the response is bound to (``resp = self._call(...)``), when
     #: the site is the sole value of a simple assignment
@@ -220,8 +226,10 @@ def _harvest_call_sites(idx: ProjectIndex) -> List[CallSiteInfo]:
                 for kw in sub.keywords:
                     if kw.arg is None:
                         s.sent_open = True
-                    elif kw.arg not in _TRANSPORT_KEYS:
-                        s.sent.add(kw.arg)
+                    else:
+                        s.sent_all.add(kw.arg)
+                        if kw.arg not in _TRANSPORT_KEYS:
+                            s.sent.add(kw.arg)
                 s.fn_node = fn.node          # for response-read scan
                 sites.append(s)
     return sites
@@ -358,6 +366,163 @@ def check_rpc_verb_contract(idx: ProjectIndex) -> List[Finding]:
                         f"`{stem}_from_wire` never reads it — dead wire "
                         f"weight, or a reader-side key that drifted",
                         mod))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# GL024 — mutating RPC verbs must be idempotent
+# --------------------------------------------------------------------------
+
+#: The fleet's MUTATING verbs: their handlers change worker/supervisor
+#: state, and every retry ladder in the fleet (router retry-once on
+#:  protocol errors, blind re-registration, netchaos duplicates) can
+#: deliver them twice. Each one must (a) be declared in a module-global
+#: ``*IDEMPOTENT*`` tuple next to its dispatch class, (b) have its
+#: dispatch/handler consult an idem-keyed reply cache (an attribute
+#: whose name mentions ``replies``), and (c) carry an explicit ``idem``
+#: kwarg at every literal call site. Read-only verbs (step, health,
+#: prefix, ...) are exempt — re-executing them is harmless.
+RPC_MUTATING_VERBS = ("submit", "page_transfer", "journal_drain",
+                      "register")
+
+
+def _reads_key_literal(node: ast.AST, key: str) -> bool:
+    """Whether the subtree reads the literal string ``key`` off any
+    mapping (``x["key"]`` / ``x.get("key")`` / ``"key" in x``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript) \
+                and _const_str(sub.slice) == key:
+            return True
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr == "get" and sub.args \
+                and _const_str(sub.args[0]) == key:
+            return True
+        if isinstance(sub, ast.Compare) and len(sub.ops) == 1 \
+                and isinstance(sub.ops[0], ast.In) \
+                and _const_str(sub.left) == key:
+            return True
+    return False
+
+
+def _consults_reply_cache(node: ast.AST) -> bool:
+    """Whether the subtree touches a reply-cache attribute or name
+    (``self._replies`` / ``self._reg_replies`` / ...)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and "replies" in sub.attr:
+            return True
+        if isinstance(sub, ast.Name) and "replies" in sub.id:
+            return True
+    return False
+
+
+def _idempotent_declared(mod: ModuleInfo, idx: ProjectIndex,
+                         ) -> Optional[Set[str]]:
+    """The union of verbs declared idempotent by the module's
+    ``*IDEMPOTENT*`` tuple globals; None when no such global exists."""
+    out: Optional[Set[str]] = None
+    for name, val in mod.globals.items():
+        if "IDEMPOTENT" not in name.upper():
+            continue
+        if not isinstance(val, (ast.Tuple, ast.List)):
+            continue
+        out = out or set()
+        out |= {s for s in (_resolve_str(mod, idx, e) for e in val.elts)
+                if s is not None}
+    return out
+
+
+def check_idempotent_verb_contract(idx: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # ---- dispatch classes with op_<mutating-verb> handlers -------------
+    handled_verbs: Set[str] = set()
+    for mod in idx.modules.values():
+        for info in mod.classes.values():
+            if "dispatch" not in info.methods or info.node is None:
+                continue
+            dispatch_fn = None
+            mutating = []
+            for sub in info.node.body:
+                if not isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    continue
+                if sub.name == "dispatch":
+                    dispatch_fn = sub
+                elif sub.name.startswith("op_") \
+                        and sub.name[len("op_"):] in RPC_MUTATING_VERBS:
+                    mutating.append(sub)
+            if not mutating:
+                continue
+            handled_verbs |= {m.name[len("op_"):] for m in mutating}
+            declared = _idempotent_declared(mod, idx)
+            if declared is None:
+                findings.append(_finding(
+                    "GL024", info.node,
+                    f"dispatch class `{info.name}` handles mutating RPC "
+                    f"verb(s) "
+                    f"{_fmt({m.name[len('op_'):] for m in mutating})} "
+                    f"but its module declares no *IDEMPOTENT* verbs "
+                    f"tuple — duplicated or blindly-retried calls will "
+                    f"re-execute", mod))
+            else:
+                for m in mutating:
+                    verb = m.name[len("op_"):]
+                    if verb not in declared:
+                        findings.append(_finding(
+                            "GL024", m,
+                            f"mutating RPC verb {verb!r} is not in the "
+                            f"module's *IDEMPOTENT* verbs tuple — its "
+                            f"replies are never cached, so a netchaos "
+                            f"duplicate or a protocol-error retry "
+                            f"re-executes it", mod))
+            if dispatch_fn is not None and not (
+                    _reads_key_literal(dispatch_fn, "idem")
+                    and _consults_reply_cache(dispatch_fn)):
+                findings.append(_finding(
+                    "GL024", dispatch_fn,
+                    f"`{info.name}.dispatch` handles mutating verb(s) "
+                    f"but never consults an idem-keyed reply cache "
+                    f"(read doc's 'idem' + a `*replies*` attribute) — "
+                    f"idempotency keys sent by callers are ignored",
+                    mod))
+
+    # ---- registration-style handlers (no op_ method) -------------------
+    for verb in RPC_MUTATING_VERBS:
+        if verb in handled_verbs:
+            continue
+        for mod in idx.modules.values():
+            for name, fn in sorted(mod.functions.items()):
+                short = name.split(".")[-1]
+                if short not in (f"_handle_{verb}", f"handle_{verb}"):
+                    continue
+                if fn.node is None:
+                    continue
+                handled_verbs.add(verb)
+                if not (_reads_key_literal(fn.node, "idem")
+                        and _consults_reply_cache(fn.node)):
+                    findings.append(_finding(
+                        "GL024", fn.node,
+                        f"`{short}` executes the mutating {verb!r} "
+                        f"handshake but never consults an idem-keyed "
+                        f"reply cache — a worker whose registration "
+                        f"response was lost will blind-retry and "
+                        f"reconcile twice", mod))
+
+    # ---- call sites: mutating verbs must carry an explicit idem key ----
+    if handled_verbs:
+        for s in _harvest_call_sites(idx):
+            if s.verb not in RPC_MUTATING_VERBS \
+                    or s.verb not in handled_verbs:
+                continue
+            if "idem" not in s.sent_all and not s.sent_open:
+                findings.append(_finding(
+                    "GL024", s.node,
+                    f".call({s.verb!r}, ...) sends no 'idem' key — the "
+                    f"handler caches replies by idempotency key, so an "
+                    f"unkeyed duplicate of this mutating call "
+                    f"re-executes instead of hitting the cache",
+                    s.mod))
     return findings
 
 

@@ -1930,5 +1930,88 @@ def emit(t, track, rid):
     project_checker=_project("check_telemetry_span_contract")))
 
 
+_register(Rule(
+    id="GL024", name="idempotent-mutating-verbs",
+    rationale=(
+        "Every retry ladder in the fleet is a duplicate-delivery "
+        "generator: the router re-sends after a protocol error, a "
+        "worker blind-retries registration when the response is "
+        "lost, and netchaos (faults/netchaos.py) duplicates frames "
+        "outright. A MUTATING verb (``RPC_MUTATING_VERBS`` in "
+        "analysis/contracts.py: submit, page_transfer, "
+        "journal_drain, register) that re-executes under any of "
+        "these double-decodes a request, double-appends staged KV "
+        "pages, or reconciles an attach twice — the exactly-once "
+        "promise dies at the wire. The contract has three legs, "
+        "all literal AST: the verb is declared in a module-global "
+        "``*IDEMPOTENT*`` tuple next to its dispatch class; the "
+        "dispatch/handler consults an idem-keyed reply cache (reads "
+        "``'idem'`` and touches a ``*replies*`` attribute) so a "
+        "duplicated call returns the cached reply; and every "
+        "literal call site sends an explicit ``idem`` key. Skipped "
+        "when the linted files contain no handler for a mutating "
+        "verb."),
+    bad="""\
+class WorkerStub:
+    def dispatch(self, doc):
+        op = doc.get("op")
+        fn = getattr(self, "op_" + op, None)
+        if fn is None:
+            raise ValueError(op)
+        return fn(doc)          # no reply cache, no idem read
+
+    def op_submit(self, doc):   # mutating: enqueues a request
+        req = doc["req"]
+        return {"accepted": bool(req)}
+
+class ClientStub:
+    def __init__(self, call):
+        self.call = call
+
+    def submit(self, req):
+        # no idem key: a duplicated frame re-enqueues the request
+        resp = self.call("submit", req=req, timeout_s=1.0)
+        return resp["accepted"]
+""",
+    good="""\
+IDEMPOTENT_VERBS = ("submit",)
+
+class WorkerStub:
+    def __init__(self):
+        self._replies = {}
+
+    def dispatch(self, doc):
+        op = doc.get("op")
+        fn = getattr(self, "op_" + op, None)
+        if fn is None:
+            raise ValueError(op)
+        idem = doc.get("idem")
+        if op in IDEMPOTENT_VERBS and idem is not None:
+            cached = self._replies.get(idem)
+            if cached is not None:
+                return {**cached, "idem_hit": True}
+        resp = fn(doc)
+        if op in IDEMPOTENT_VERBS and idem is not None:
+            self._replies[idem] = resp
+        return resp
+
+    def op_submit(self, doc):
+        req = doc["req"]
+        return {"accepted": bool(req)}
+
+class ClientStub:
+    def __init__(self, call):
+        self.call = call
+        self._seq = 0
+
+    def submit(self, req):
+        self._seq += 1
+        resp = self.call("submit", req=req, timeout_s=1.0,
+                         idem="sub.%d" % self._seq)
+        return resp["accepted"]
+""",
+    project_checker=_project("check_idempotent_verb_contract")))
+
+
 def all_rule_ids() -> List[str]:
     return sorted(RULES)

@@ -8,7 +8,11 @@
   speculative auto-disable with re-probe, load shedding;
 - ``fleet``: fleet-level fault kinds (replica kill / wedge-partition /
   hot-key skew) behind the same plan machinery, consulted by
-  serve/router.py and serve/loadgen.py.
+  serve/router.py and serve/loadgen.py;
+- ``netchaos``: message-level network faults (drop / duplicate /
+  reorder / delay / trickle / corrupt-frame / partition) injected by
+  :class:`FaultyTransport` around the serve/rpc.py client — the layer
+  the idempotent-RPC hardening is proven against.
 
 The ops story (fault matrix -> detection -> automatic recovery ->
 operator action) lives in docs/robustness.md.
@@ -18,6 +22,11 @@ from .fleet import (FLEET_SESSION, FLEET_STEP, KIND_HOT_KEY_SKEW,
                     KIND_REPLICA_KILL, KIND_REPLICA_WEDGE,
                     fleet_step_fault, session_skew)
 from .inject import Fault, FaultPlan, active, clear, fire, install, installed
+from .netchaos import (KIND_NET_CORRUPT, KIND_NET_DELAY, KIND_NET_DROP,
+                       KIND_NET_DUP, KIND_NET_PARTITION,
+                       KIND_NET_REORDER, KIND_NET_TRICKLE, NET_CALL,
+                       NET_KINDS, FaultyTransport, net_call_fault,
+                       net_site)
 from .supervise import (LossSpikeError, NonFiniteLossError,
                         SupervisedResult, SupervisionConfig,
                         SupervisionExhausted, supervised_train)
@@ -33,4 +42,8 @@ __all__ = [
     "FLEET_SESSION", "FLEET_STEP", "KIND_HOT_KEY_SKEW",
     "KIND_REPLICA_KILL", "KIND_REPLICA_WEDGE", "fleet_step_fault",
     "session_skew",
+    "FaultyTransport", "KIND_NET_CORRUPT", "KIND_NET_DELAY",
+    "KIND_NET_DROP", "KIND_NET_DUP", "KIND_NET_PARTITION",
+    "KIND_NET_REORDER", "KIND_NET_TRICKLE", "NET_CALL", "NET_KINDS",
+    "net_call_fault", "net_site",
 ]

@@ -1,0 +1,321 @@
+"""Network chaos: deterministic message-level faults on the fleet RPC.
+
+faults/fleet.py can kill, wedge, or SIGSTOP a *process*; nothing there
+can hurt a *message*. Every exactly-once claim the fleet makes (journal
+replay, requeue ladders, disagg transfers) therefore rode on an
+implicitly clean pipe between router and worker. This module closes
+that gap: a :class:`FaultyTransport` wraps the router's
+:class:`~..serve.rpc.RpcClient` and consults the installed
+:class:`~.inject.FaultPlan` before (and around) every call, injecting
+the seven wire-fault kinds real multi-host fleets see first —
+half-open links, duplicated retries, reordered frames, asymmetric
+partitions. Same design contract as faults/inject.py: no-op by default
+(one module-global read per call), deterministic (per-link-per-verb
+call ordinals, never wall-clock races), and injected faults are
+indistinguishable from real ones (a dropped frame raises the same
+:class:`~..serve.rpc.RpcTimeout` a SIGSTOP'd worker does).
+
+Sites are ``net/{src}->{dst}/{verb}`` — e.g.
+``net/router->worker1/submit``. :func:`net_call_fault` tries the
+spellings most-specific-first, so one plan can target one verb on one
+link, every verb on one link (``net/router->worker1/*``), one verb
+fleet-wide (``net/*->*/submit``), or everything (:data:`NET_CALL`).
+The index passed to the plan is always the transport's own per-verb
+call ordinal on that link, so ``Fault(at=2, times=3)`` means "calls
+2..4 of that verb on that link" under every spelling.
+
+Kinds (the network fault matrix — docs/robustness.md):
+
+==================  =====================================================
+kind                effect at the transport
+==================  =====================================================
+``net_delay``       sleep ``arg`` seconds, then send normally
+``net_drop``        the request frame is lost: nothing is sent, the
+                    caller sees :class:`RpcTimeout` (maybe-executed —
+                    indistinguishable from a hung worker)
+``net_dup``         the frame is sent TWICE with the same idempotency
+                    key; the caller gets the second response (the
+                    worker's cached reply, ``idem_hit``) — only calls
+                    that carry an ``idem`` key can be duplicated
+``net_reorder``     the link's PREVIOUS idempotent frame is re-sent
+                    first (a stale duplicate arriving late); its
+                    response is discarded through the observer, then
+                    the current call proceeds normally
+``net_trickle``     the frame drips onto the wire in ``arg``-byte
+                    chunks with ``arg2`` seconds between chunks
+``net_corrupt``     one byte of the request frame BODY is flipped
+                    (seeded); the far side's checksum rejects it with a
+                    typed protocol error and the stream is poisoned —
+                    never a mis-decoded result
+``net_partition``   ``arg2 == 0``: two-way — the call fails
+                    :class:`RpcDown` without touching the wire.
+                    ``arg2 == 1``: one-way — the request EXECUTES but
+                    the response is lost (:class:`RpcTimeout`, the
+                    maybe-executed case). ``times`` is the partition
+                    width in calls; the first clean call after is the
+                    heal edge
+==================  =====================================================
+
+The ``observer`` (the router's :class:`~..serve.router.RemoteReplica`)
+hears two things: ``net_chaos_response(resp)`` for responses the chaos
+layer swallowed (reorder/one-way partition) — so duplicate-suppression
+accounting sees EVERY response, even discarded ones — and
+``net_chaos_partition(active)`` on partition enter/heal edges, which
+the router turns into the ``rpc_partitions_active`` counter and the
+``net_partition``/``net_heal`` trace instants. ``dups_injected``
+counts every duplicate frame this transport actually put on the wire;
+the chaos soak asserts ``rpc_dup_suppressed`` equals its fleet-wide
+sum exactly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+from .inject import Fault, active
+
+#: lazily-bound serve.rpc module: importing it pulls the serve package
+#: (and jax with it), and the faults package must stay importable from
+#: jax-free contexts (procsup's contract) until a transport actually
+#: exists — by which point the serve package is loaded anyway
+_RPC = None
+
+
+def _rpc():
+    global _RPC
+    if _RPC is None:
+        from ..serve import rpc
+        _RPC = rpc
+    return _RPC
+
+#: the catch-all site: matches every verb on every link (tried last)
+NET_CALL = "net/call"
+
+KIND_NET_DELAY = "net_delay"
+KIND_NET_DROP = "net_drop"
+KIND_NET_DUP = "net_dup"
+KIND_NET_REORDER = "net_reorder"
+KIND_NET_TRICKLE = "net_trickle"
+KIND_NET_CORRUPT = "net_corrupt"
+KIND_NET_PARTITION = "net_partition"
+
+NET_KINDS = (KIND_NET_DELAY, KIND_NET_DROP, KIND_NET_DUP,
+             KIND_NET_REORDER, KIND_NET_TRICKLE, KIND_NET_CORRUPT,
+             KIND_NET_PARTITION)
+
+
+def net_site(src: str, dst: str, verb: str) -> str:
+    """The canonical site string for one (link, verb)."""
+    return f"net/{src}->{dst}/{verb}"
+
+
+def net_call_fault(src: str, dst: str, verb: str,
+                   index: int) -> Optional[Fault]:
+    """Ask the installed plan for a fault on this call, trying site
+    spellings most-specific-first. The index is the per-link-per-verb
+    call ordinal under EVERY spelling (deterministic regardless of how
+    broadly the plan targeted)."""
+    plan = active()
+    if plan is None:
+        return None
+    for site in (net_site(src, dst, verb), net_site(src, dst, "*"),
+                 net_site("*", "*", verb), NET_CALL):
+        f = plan.fire(site, index=index)
+        if f is not None:
+            return f
+    return None
+
+
+class FaultyTransport:
+    """Chaos-injecting wrapper with the :class:`~..serve.rpc.RpcClient`
+    call surface. ALWAYS wrapped around the router's clients
+    (:meth:`~..serve.router.RemoteReplica.connect`): with no plan
+    installed, :meth:`call` is one module-global read and a straight
+    delegate — tier-1 RPC behavior stays byte-identical."""
+
+    def __init__(self, client, src: str, dst: str, observer=None):
+        self.client = client
+        self.src = src
+        self.dst = dst
+        #: the router-side replica proxy: hears discarded responses and
+        #: partition enter/heal edges (both optional, getattr-guarded)
+        self.observer = observer
+        #: duplicate frames actually put on the wire (dup + reorder) —
+        #: the soak's ground truth for ``rpc_dup_suppressed``
+        self.dups_injected = 0
+        self.partitioned = False
+        self._counts: Dict[str, int] = {}
+        #: (op, timeout_s, kwargs) of the last idem-carrying call — the
+        #: frame ``net_reorder`` replays out of order
+        self._last_idem: Optional[Tuple[str, Optional[float],
+                                        dict]] = None
+
+    # ------------------------------------------------- client delegation
+
+    @property
+    def host(self):
+        return self.client.host
+
+    @property
+    def port(self):
+        return self.client.port
+
+    @property
+    def timeout_s(self):
+        return self.client.timeout_s
+
+    def connect(self) -> None:
+        self.client.connect()
+
+    def close(self) -> None:
+        self.client.close()
+
+    # --------------------------------------------------------- the seam
+
+    def call(self, op: str, timeout_s: Optional[float] = None,
+             **kwargs) -> dict:
+        if active() is None:       # the no-chaos fast path
+            return self.client.call(op, timeout_s=timeout_s, **kwargs)
+        idx = self._counts.get(op, 0)
+        self._counts[op] = idx + 1
+        f = net_call_fault(self.src, self.dst, op, idx)
+        if f is not None and f.kind == KIND_NET_PARTITION:
+            return self._partitioned_call(f, op, timeout_s, kwargs)
+        if self.partitioned:
+            self._set_partitioned(False)   # first clean call: the heal
+        if f is None:
+            return self._send(op, timeout_s, kwargs)
+        if f.kind == KIND_NET_DELAY:
+            time.sleep(f.arg or 0.05)  # graftlint: disable=GL019 — chaos injection: the delay IS the fault
+            return self._send(op, timeout_s, kwargs)
+        if f.kind == KIND_NET_DROP:
+            # the frame dies on the wire: nothing sent, and the caller
+            # cannot know whether the worker executed — exactly what a
+            # real lost frame looks like, so raise the maybe-executed
+            # failure, not the definitely-dead one
+            self.client.close()
+            raise _rpc().RpcTimeout(f"{op}: frame dropped (chaos)")
+        if f.kind == KIND_NET_DUP:
+            return self._dup(op, timeout_s, kwargs)
+        if f.kind == KIND_NET_REORDER:
+            self._reorder()
+            return self._send(op, timeout_s, kwargs)
+        if f.kind == KIND_NET_TRICKLE:
+            return self._trickle(f, op, timeout_s, kwargs)
+        if f.kind == KIND_NET_CORRUPT:
+            return self._corrupt(f, op, timeout_s, kwargs)
+        raise ValueError(f"unknown net fault kind {f.kind!r}")
+
+    # ----------------------------------------------------- kind payloads
+
+    def _send(self, op: str, timeout_s: Optional[float],
+              kwargs: dict) -> dict:
+        if "idem" in kwargs:
+            self._last_idem = (op, timeout_s, dict(kwargs))
+        return self.client.call(op, timeout_s=timeout_s, **kwargs)
+
+    def _dup(self, op: str, timeout_s: Optional[float],
+             kwargs: dict) -> dict:
+        """Send the frame twice with the SAME idempotency key and hand
+        the caller the second response — the worker's cached reply.
+        Calls without an idem key cannot be safely duplicated (there is
+        nothing to suppress the re-execution), so the fault degrades to
+        a normal send there."""
+        if "idem" not in kwargs:
+            return self._send(op, timeout_s, kwargs)
+        self._send(op, timeout_s, kwargs)       # the original
+        self.dups_injected += 1
+        return self.client.call(op, timeout_s=timeout_s, **kwargs)
+
+    def _reorder(self) -> None:
+        """Replay the link's previous idempotent frame ahead of the
+        current one — a stale duplicate arriving out of order. Its
+        response (the worker's cached reply) is discarded through the
+        observer so suppression accounting still sees it. No history
+        yet means nothing to reorder."""
+        if self._last_idem is None:
+            return
+        prev_op, prev_to, prev_kw = self._last_idem
+        try:
+            stale = self.client.call(prev_op, timeout_s=prev_to,
+                                     **prev_kw)
+        except _rpc().RpcError:
+            return                  # the stale frame died en route
+        self.dups_injected += 1
+        self._observe_response(stale)
+
+    def _trickle(self, f: Fault, op: str, timeout_s: Optional[float],
+                 kwargs: dict) -> dict:
+        """Drip the frame onto the wire in tiny chunks — a congested or
+        deliberately slow link. The far side must assemble the frame
+        from however the segments land (the _recv_exact loops)."""
+        self.client.send_chunking = (max(int(f.arg), 1) or 3,
+                                     float(f.arg2) or 0.002)
+        try:
+            return self._send(op, timeout_s, kwargs)
+        finally:
+            self.client.send_chunking = None
+
+    def _corrupt(self, f: Fault, op: str, timeout_s: Optional[float],
+                 kwargs: dict) -> dict:
+        """Flip one seeded byte in the request frame's BODY (never the
+        length prefix — a corrupt length desyncs framing nondeterminis-
+        tically; a corrupt body is exactly what the checksum exists to
+        catch). The far side answers a typed protocol error; the
+        caller's retry-once path re-sends with the same idem key."""
+        plan = active()
+        rng = (plan.rng(net_site(self.src, self.dst, op))
+               if plan is not None else None)
+
+        def flip(frame: bytes) -> bytes:
+            HEADER_BYTES = _rpc().HEADER_BYTES
+            if len(frame) <= HEADER_BYTES:
+                return frame
+            off = HEADER_BYTES + (int(rng.integers(
+                0, len(frame) - HEADER_BYTES)) if rng is not None else 0)
+            return (frame[:off] + bytes([frame[off] ^ 0xFF])
+                    + frame[off + 1:])
+
+        self.client.frame_filter = flip
+        try:
+            return self._send(op, timeout_s, kwargs)
+        finally:
+            self.client.frame_filter = None
+
+    def _partitioned_call(self, f: Fault, op: str,
+                          timeout_s: Optional[float],
+                          kwargs: dict) -> dict:
+        self._set_partitioned(True)
+        if int(f.arg2) == 0:
+            # two-way: the frame never leaves this host — definitely
+            # not executed, the connection looks dead
+            self.client.close()
+            raise _rpc().RpcDown(f"{op}: partitioned (chaos)")
+        # one-way: the request crosses, the response is lost — the
+        # worker EXECUTED this call and the caller cannot know. The
+        # swallowed response still reaches the observer (accounting).
+        try:
+            resp = self._send(op, timeout_s, kwargs)
+        except _rpc().RpcError:
+            pass
+        else:
+            self._observe_response(resp)
+        self.client.close()
+        raise _rpc().RpcTimeout(f"{op}: response lost to one-way "
+                                f"partition (chaos)")
+
+    # ---------------------------------------------------------- plumbing
+
+    def _observe_response(self, resp: dict) -> None:
+        cb = getattr(self.observer, "net_chaos_response", None)
+        if cb is not None:
+            cb(resp)
+
+    def _set_partitioned(self, now_active: bool) -> None:
+        if self.partitioned == now_active:
+            return
+        self.partitioned = now_active
+        cb = getattr(self.observer, "net_chaos_partition", None)
+        if cb is not None:
+            cb(now_active)

@@ -89,6 +89,7 @@ import signal
 import subprocess
 import sys
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
@@ -225,6 +226,11 @@ class ProcSupervisor:
         self._up_streak = 0
         self._down_streak = 0
         self._last_scale_tick = 0
+        #: registration reply cache, keyed by the worker's idem key: a
+        #: duplicated or blind-retried register frame is answered with
+        #: the ORIGINAL attach response instead of reconciling the
+        #: ledger twice (serve/rpc.py idempotency contract, GL024)
+        self._reg_replies: "OrderedDict[str, dict]" = OrderedDict()
 
     def attach_router(self, router) -> None:
         self.router = router
@@ -302,7 +308,25 @@ class ProcSupervisor:
         """The RpcListener handler: validate the handshake, attach the
         router. Raising :class:`RpcProtocolError` answers the worker
         with ``kind="protocol"`` — its client raises the typed error
-        and the worker exits 3 instead of retrying."""
+        and the worker exits 3 instead of retrying.
+
+        Registration MUTATES the router (attach reconciliation), so it
+        carries an idempotency key like the other mutating verbs: a
+        worker that registered but lost the response blind-retries the
+        same frame, and the reply cache answers it with the original
+        attach result instead of reconciling twice. Rejections are NOT
+        cached — a retried bad handshake must re-validate."""
+        idem = doc.get("idem")
+        if idem is not None and idem in self._reg_replies:
+            return {**self._reg_replies[idem], "idem_hit": True}
+        resp = self._register_attach(doc, peer_host)
+        if idem is not None:
+            self._reg_replies[idem] = resp
+            while len(self._reg_replies) > 64:
+                self._reg_replies.popitem(last=False)
+        return resp
+
+    def _register_attach(self, doc: dict, peer_host: str) -> dict:
         from ..serve.rpc import PROTO_VERSION, RpcProtocolError
         router = self.router
         assert router is not None, "attach_router first"

@@ -26,12 +26,14 @@
   re-route off wedged replicas (docs/serving.md). Replicas are either
   in-process engines (``Replica``) or worker PROCESSES
   (``RemoteReplica`` over the ``rpc`` protocol);
-- ``rpc``: length-prefixed JSON RPC over sockets — the wire between
-  the router and worker processes (register/submit/step/stream-drain/
-  journal-drain/cancel/drain/health verbs, ack-based finish
-  redelivery, protocol-version + engine-shape-hash handshake with
-  typed ``RpcProtocolError`` rejection, and the poll-driven
-  ``RpcListener`` registration endpoint);
+- ``rpc``: length-prefixed, CRC32-checksummed JSON RPC over sockets —
+  the wire between the router and worker processes (register/submit/
+  step/stream-drain/journal-drain/cancel/drain/health verbs, ack-based
+  finish redelivery, per-call idempotency keys on mutating verbs
+  answered from a bounded reply cache, generation fencing, protocol-
+  version + engine-shape-hash handshake with typed
+  ``RpcProtocolError`` rejection, and the poll-driven ``RpcListener``
+  registration endpoint; chaos coverage in ``faults/netchaos.py``);
 - ``disagg``: disaggregated prefill/decode tiers — page
   sources/sinks (in-process and RPC), the chunked ``TransferJob``
   that ships a prefilled request's KV pages (storage-dtype bytes +

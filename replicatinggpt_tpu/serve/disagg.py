@@ -134,9 +134,19 @@ class RpcPageSource:
         #: replica's RpcClient.call (timeouts/reconnects included)
         self.call = call
         self.page_bytes = 0              # learned from export_begin
+        self._seq = 0                    # idempotency-key ordinal
+
+    def _idem(self, kind: str, key: str) -> str:
+        """One key per LOGICAL page_transfer call: a netchaos duplicate
+        or a blind protocol retry of the same call is answered from the
+        worker's reply cache, while a fresh transfer attempt for the
+        same request id mints new keys and re-executes (GL024)."""
+        self._seq += 1
+        return f"pt.{key}.{kind}.{self._seq}"
 
     def begin(self, key: str, prompt: np.ndarray, from_page: int) -> int:
         r = self.call("page_transfer", kind="export_begin", key=key,
+                      idem=self._idem("export_begin", key),
                       prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
                       from_page=int(from_page))
         self.page_bytes = int(r.get("page_bytes", 0))
@@ -144,11 +154,13 @@ class RpcPageSource:
 
     def chunk(self, key: str, cursor: int, limit: int = 0):
         r = self.call("page_transfer", kind="export_chunk", key=key,
+                      idem=self._idem("export_chunk", key),
                       cursor=int(cursor), limit=int(limit))
         return r["blocks"], int(r["cursor"]), bool(r["done"])
 
     def end(self, key: str) -> None:
-        self.call("page_transfer", kind="export_end", key=key)
+        self.call("page_transfer", kind="export_end", key=key,
+                  idem=self._idem("export_end", key))
 
 
 # ----------------------------------------------------------------- sink
@@ -206,10 +218,19 @@ class RpcPageSink:
 
     def __init__(self, call: Callable[..., dict]):
         self.call = call
+        self._seq = 0                    # idempotency-key ordinal
+
+    def _idem(self, kind: str, key: str) -> str:
+        """See :meth:`RpcPageSource._idem` — duplicated install calls
+        (especially ``install_chunk``, which appends to a staged chain)
+        must be reply-cache hits, never double-appends (GL024)."""
+        self._seq += 1
+        return f"pt.{key}.{kind}.{self._seq}"
 
     def begin(self, key: str, prompt: np.ndarray, from_page: int,
               n_pages: int) -> bool:
         r = self.call("page_transfer", kind="install_begin", key=key,
+                      idem=self._idem("install_begin", key),
                       prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
                       from_page=int(from_page), n_pages=int(n_pages))
         # "accepted", not "ok": the transport wraps every response in
@@ -220,14 +241,17 @@ class RpcPageSink:
         wire = [b if _is_wire_block(b) else page_block_to_wire(b)
                 for b in blocks]
         self.call("page_transfer", kind="install_chunk", key=key,
+                  idem=self._idem("install_chunk", key),
                   blocks=wire)
 
     def commit(self, key: str) -> int:
-        r = self.call("page_transfer", kind="install_commit", key=key)
+        r = self.call("page_transfer", kind="install_commit", key=key,
+                      idem=self._idem("install_commit", key))
         return int(r["registered"])
 
     def abort(self, key: str) -> None:
         self.call("page_transfer", kind="install_commit", key=key,
+                  idem=self._idem("install_abort", key),
                   abort=True)
 
 

@@ -98,6 +98,7 @@ from ..faults.fleet import (KIND_HOST_LOSS, KIND_PROC_HANG,
                             KIND_PROC_KILL, KIND_REPLICA_KILL,
                             KIND_REPLICA_WEDGE, KIND_TRANSFER_KILL,
                             fleet_step_fault, transfer_fault)
+from ..faults.netchaos import FaultyTransport
 from ..utils.jsonl import load_jsonl_if_exists
 from ..utils.logging import Metrics
 from ..utils.telemetry import (ENGINE_TRACK, NULL, REPLICA_TRACK_STRIDE,
@@ -110,8 +111,8 @@ from .requests import (FINISH_CANCELLED, FINISH_DEADLINE,
                        REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL,
                        Request, RequestResult)
 from .rpc import (REJECT_REPLICA_DOWN, RpcClient, RpcDown, RpcError,
-                  RpcTimeout, request_from_wire, request_to_wire,
-                  result_from_wire)
+                  RpcProtocolError, RpcTimeout, request_from_wire,
+                  request_to_wire, result_from_wire)
 
 #: finish_reason when bounded retry exhausts without a replica
 #: accepting the requeued request
@@ -456,6 +457,13 @@ class RemoteReplica(ReplicaBase):
 
     is_local = False
 
+    #: verbs whose handlers MUTATE worker state — every call carries an
+    #: idempotency key so a netchaos duplicate or a blind protocol
+    #: retry is answered from the worker's reply cache instead of
+    #: re-executing (graftlint GL024 audits both sides of this
+    #: contract; worker.py:IDEMPOTENT_VERBS is the handler-side pin)
+    MUTATING_VERBS = ("submit", "page_transfer", "journal_drain")
+
     def __init__(self, idx: int, journal_path: Optional[str] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  rpc_timeout_s: float = 10.0,
@@ -480,8 +488,29 @@ class RemoteReplica(ReplicaBase):
         self._partials: Dict[str, List[int]] = {}
         self._seen: set = set()        # finish ids delivered, unacked
         self._acks: List[str] = []
+        #: wired by Router.__init__ so protocol-hardening counters
+        #: (rpc_dup_suppressed & friends) and net_partition/net_heal
+        #: instants land in the FLEET's metrics/trace, not a private one
+        self.metrics: Optional[Metrics] = None
+        self.tel = NULL
+        #: monotonic ordinal for auto-minted idempotency keys: each
+        #: LOGICAL call attempt gets a fresh key (a resubmission must
+        #: re-execute), while wire-level duplicates/retries of the same
+        #: attempt reuse it (the reply cache answers those)
+        self._idem_seq = 0
+        #: half-open detection: last time any RPC round-tripped. A
+        #: worker that accepts connects but never answers (one-way
+        #: partition) goes silent here; Router.step closes the client
+        #: past ``heartbeat_deadline_s`` to force a fresh connect
+        #: instead of trusting a dead socket forever.
+        self.last_ok_t = time.monotonic()
+        self.heartbeat_deadline_s: Optional[float] = None
         if port:
             self.connect(port)
+
+    def _next_idem(self, op: str) -> str:
+        self._idem_seq += 1
+        return f"r{self.idx}.{op}.{self._idem_seq}"
 
     # ------------------------------------------------------- connection
 
@@ -495,8 +524,13 @@ class RemoteReplica(ReplicaBase):
             # lives on (its connection's peer address) — a respawned
             # worker may come back on a different machine entirely
             self.host = host
-        self.client = RpcClient(self.host, port,
-                                timeout_s=self.rpc_timeout_s)
+        # FaultyTransport is a strict pass-through while no FaultPlan
+        # is installed (one module-global read per call) — wrapping
+        # unconditionally keeps chaos runs and clean runs on the SAME
+        # code path, so the soak proves the path production uses
+        self.client = FaultyTransport(
+            RpcClient(self.host, port, timeout_s=self.rpc_timeout_s),
+            src="router", dst=f"worker{self.idx}", observer=self)
         if pid is not None:
             self.pid = pid
         if gen is not None:
@@ -510,14 +544,83 @@ class RemoteReplica(ReplicaBase):
               **kw) -> dict:
         if self.client is None:
             raise ReplicaDownError(f"worker {self.idx}: never attached")
+        if op in self.MUTATING_VERBS and "idem" not in kw:
+            # safety net for call sites that forgot an explicit key —
+            # the named verbs' sites mint their own (GL024)
+            kw["idem"] = self._next_idem(op)
+        if self.gen >= 0 and "gen" not in kw:
+            # stamp the worker incarnation we believe we are talking
+            # to: a partitioned-then-restarted worker at a NEWER gen
+            # fences this call off instead of executing it (and a
+            # stale worker answering a new router gets the mirror
+            # rejection from its own fence)
+            kw["gen"] = self.gen
         try:
-            return self.client.call(op, timeout_s=timeout_s, **kw)
+            resp = self.client.call(op, timeout_s=timeout_s, **kw)
+        except RpcProtocolError as e:
+            resp = self._retry_protocol(op, timeout_s, kw, e)
         except RpcTimeout:
             raise
         except (RpcDown, RpcError) as e:
             # RpcError too: a worker whose dispatch raises is sick — the
             # supervisor's restart path is the recovery for both
             raise ReplicaDownError(f"worker {self.idx}: {e}") from e
+        self._note_response(resp)
+        return resp
+
+    def _retry_protocol(self, op: str, timeout_s: Optional[float],
+                        kw: dict, err: RpcProtocolError) -> dict:
+        """Recover from a DATA-PLANE protocol error: the stream is
+        poisoned (checksum mismatch, mid-frame EOF), not the call.
+        Reconnect and retry ONCE with the SAME kwargs — same idem key,
+        so if the first copy actually executed before the stream died,
+        the worker's reply cache answers the retry and nothing runs
+        twice. A generation-fence rejection is different: the protocol
+        is fine, WE are stale — mark the replica down so the attach
+        path renegotiates the incarnation."""
+        if "stale generation" in str(err):
+            if self.metrics is not None:
+                self.metrics.inc("rpc_stale_generation_rejects")
+            raise ReplicaDownError(f"worker {self.idx}: {err}") from err
+        if self.metrics is not None:
+            self.metrics.inc("rpc_corrupt_frames")
+        self.client.close()
+        try:
+            return self.client.call(op, timeout_s=timeout_s, **kw)
+        except RpcTimeout:
+            raise
+        except (RpcProtocolError, RpcDown, RpcError) as e2:
+            raise ReplicaDownError(f"worker {self.idx}: {e2}") from e2
+
+    def _note_response(self, resp) -> None:
+        """Bookkeeping every successful round-trip feeds: the half-open
+        heartbeat, and the duplicate-suppression ledger (``idem_hit``
+        marks a reply served from the worker's cache — the netchaos
+        soak pins rpc_dup_suppressed == injected duplicates)."""
+        self.last_ok_t = time.monotonic()
+        if (isinstance(resp, dict) and resp.get("idem_hit")
+                and self.metrics is not None):
+            self.metrics.inc("rpc_dup_suppressed")
+
+    # ------------------------------------------- netchaos observer hooks
+
+    def net_chaos_response(self, resp) -> None:
+        """FaultyTransport routes DISCARDED responses here (reorder
+        replays, one-way partitions): the call's effects happened on
+        the worker even though the caller never saw the reply, so the
+        dup-suppression accounting must still count an ``idem_hit``."""
+        self._note_response(resp)
+
+    def net_chaos_partition(self, active: bool) -> None:
+        if active:
+            if self.metrics is not None:
+                self.metrics.inc("rpc_partitions_active")
+            if self.tel.enabled:
+                self.tel.instant("net_partition", ROUTER_TRACK,
+                                 replica=self.idx)
+        elif self.tel.enabled:
+            self.tel.instant("net_heal", ROUTER_TRACK,
+                             replica=self.idx)
 
     # ----------------------------------------------------- backend verbs
 
@@ -525,6 +628,7 @@ class RemoteReplica(ReplicaBase):
         try:
             resp = self._call("submit",
                               timeout_s=self.rpc_timeout_s,
+                              idem=self._next_idem("submit"),
                               req=request_to_wire(
                                   req, time.monotonic()))
         except RpcTimeout:
@@ -601,6 +705,8 @@ class RemoteReplica(ReplicaBase):
             try:
                 resp = self._call("journal_drain",
                                   timeout_s=self.rpc_timeout_s,
+                                  idem=self._next_idem(
+                                      "journal_drain"),
                                   cursor=cursor, kinds=list(kinds))
             except (ReplicaDownError, RpcTimeout, RpcError):
                 break
@@ -748,6 +854,15 @@ class Router:
             self.replicas = list(backends)
             for rep in self.replicas:
                 rep.skip_steps = rcfg.wedge_skip_steps
+                if not rep.is_local:
+                    # protocol-hardening telemetry (rpc_* counters,
+                    # net_partition instants) lands in the FLEET's
+                    # metrics; half-open sockets are declared dead
+                    # after several silent step budgets
+                    rep.metrics = self.metrics
+                    rep.tel = self.tel
+                    rep.heartbeat_deadline_s = (
+                        rcfg.step_timeout_s * 3.0)
                 if self.tel.enabled:
                     self.tel.name_track(self._worker_track(rep.idx),
                                         f"worker{rep.idx}")
@@ -949,6 +1064,7 @@ class Router:
                 if done is not None:
                     out.append(done)
             self._probe(rep, step_idx)
+            self._probe_heartbeat(rep, step_idx)
 
         self._advance_transfers(now)
         self._observe_ttft(now)
@@ -1646,6 +1762,29 @@ class Router:
             if partial:
                 self._ttft[rid] = now - fi.t_submit
                 self.metrics.observe("fleet_ttft_s", now - fi.t_submit)
+
+    def _probe_heartbeat(self, rep: ReplicaBase,
+                         step_idx: int) -> None:
+        """Half-open socket detection: a remote replica whose RPCs all
+        time out (one-way partition, silently dropped packets) never
+        surfaces an error — every call just burns its budget. Once no
+        response has round-tripped for ``heartbeat_deadline_s``, close
+        the client so the NEXT call re-connects from scratch: a truly
+        dead peer then fails fast as ``RpcDown`` (→ mark_down → the
+        supervisor), while a healed partition gets a clean socket
+        instead of a poisoned half-open one."""
+        deadline = getattr(rep, "heartbeat_deadline_s", None)
+        if (rep.is_local or deadline is None
+                or getattr(rep, "client", None) is None):
+            return
+        silent_s = time.monotonic() - rep.last_ok_t
+        if silent_s <= deadline:
+            return
+        rep.client.close()
+        rep.last_ok_t = time.monotonic()   # one reconnect per deadline
+        self._event(f"step {step_idx}: replica {rep.idx} heartbeat "
+                    f"deadline blown ({silent_s:.1f}s silent) — "
+                    f"forcing reconnect")
 
     def _probe(self, rep: ReplicaBase, step_idx: int) -> None:
         """Wedge detection over per-step wall time + quarantine expiry."""

@@ -8,11 +8,25 @@ this protocol over a loopback socket; the router holds one
 :class:`RpcClient` per worker and drives it with the same verbs the
 in-process host API has.
 
-Framing: one message = a 4-byte big-endian unsigned length + that many
-bytes of UTF-8 JSON. Requests are ``{"op": <verb>, ...args}``;
+Framing: one message = an 8-byte header (4-byte big-endian unsigned
+length + 4-byte big-endian CRC32 of the body) + that many bytes of
+UTF-8 JSON. The checksum is the corruption fence: a flipped bit
+anywhere in the body fails the CRC on the far side and surfaces as a
+typed :class:`RpcProtocolError` — never a mis-decoded result quietly
+poisoning a stream. Requests are ``{"op": <verb>, ...args}``;
 responses are ``{"ok": true, ...result}`` or ``{"ok": false,
-"error": msg}``. Stdlib only (socket/asyncio/json) — the zero-egress
-image adds no dependency for its own fleet.
+"error": msg}``. Stdlib only (socket/asyncio/json/zlib) — the
+zero-egress image adds no dependency for its own fleet.
+
+Two envelope keys ride OUTSIDE the per-verb payload: ``idem`` (a
+per-logical-call idempotency key on mutating verbs — the worker's
+dispatch consults a bounded reply cache so a duplicated or
+blindly-retried frame returns the cached reply, marked
+``idem_hit: true``, instead of re-executing) and ``gen`` (the
+generation fence: a worker rejects calls stamped with a generation
+other than its own with a typed "stale generation" protocol error, so
+a router still talking to a partitioned-then-replaced incarnation can
+never mutate the wrong process).
 
 Verbs (dispatched in serve/worker.py):
 
@@ -78,19 +92,26 @@ Verbs (dispatched in serve/worker.py):
 
 Failure model on the client: a socket timeout raises
 :class:`RpcTimeout` (the worker may still execute the call — SIGSTOP
-looks exactly like this), any other socket failure raises
+looks exactly like this), a connection that dies BETWEEN frames raises
 :class:`RpcDown` (connection refused/reset — the process is gone or
-restarting). Both close the connection; the next call reconnects.
-The caller decides what each means: the router's wedge probe treats
-timeouts as slow steps, the supervisor treats refused connections as
-a death to restart.
+restarting), and a stream-integrity violation — a checksum mismatch,
+a connection dying MID-frame, a generation fence rejection — raises
+:class:`RpcProtocolError` (the stream is poisoned; the only safe move
+is close + reconnect, and the router's retry-once path re-sends with
+the SAME idempotency key so a maybe-executed mutation cannot double).
+All three close the connection; the next call reconnects. The caller
+decides what each means: the router's wedge probe treats timeouts as
+slow steps, the supervisor treats refused connections as a death to
+restart.
 """
 
 from __future__ import annotations
 
 import json
 import socket
-from typing import Optional
+import time
+import zlib
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
@@ -105,7 +126,12 @@ MAX_FRAME = 16 << 20
 #: at registration time (RpcProtocolError) instead of corrupting a
 #: stream mid-traffic. Bump on any incompatible change to the frame
 #: layout or the request/result wire codecs.
-PROTO_VERSION = 1
+#: v2: checksummed framing (4-byte length + 4-byte CRC32 header) plus
+#: the ``idem``/``gen`` envelope keys.
+PROTO_VERSION = 2
+
+#: frame header: 4-byte big-endian length + 4-byte big-endian CRC32
+HEADER_BYTES = 8
 
 #: journal_drain paging bound: records per frame (a frame of 256
 #: condensed records stays far under MAX_FRAME at block_size-scale
@@ -127,9 +153,16 @@ class RpcDown(RpcError):
 
 
 class RpcProtocolError(RpcError):
-    """Registration handshake rejected: protocol version or engine
-    shape hash mismatch. The worker build cannot safely join this
-    fleet — it must exit (and be rebuilt), not retry."""
+    """The protocol itself was violated — two flavors, one type:
+
+    - at REGISTRATION: protocol version or engine shape hash mismatch.
+      The worker build cannot safely join this fleet — it must exit
+      (and be rebuilt), not retry;
+    - on the DATA PLANE: stream integrity lost — a frame checksum
+      mismatch, a connection dying mid-frame, or a generation fence
+      rejection. The connection is poisoned: close, reconnect, and (on
+      the router) retry ONCE with the same idempotency key — the reply
+      cache makes that safe even if the original call executed."""
 
 
 def engine_shape_hash(mcfg, ecfg) -> str:
@@ -166,14 +199,23 @@ def encode_frame(obj: dict) -> bytes:
     data = json.dumps(obj).encode()
     if len(data) > MAX_FRAME:
         raise ValueError(f"frame too large: {len(data)} bytes")
-    return len(data).to_bytes(4, "big") + data
+    crc = zlib.crc32(data) & 0xFFFFFFFF
+    return (len(data).to_bytes(4, "big") + crc.to_bytes(4, "big")
+            + data)
 
 
-def decode_length(header: bytes) -> int:
-    n = int.from_bytes(header, "big")
+def decode_header(header: bytes) -> Tuple[int, int]:
+    """(body length, expected CRC32) from an 8-byte frame header. An
+    insane length is a loud error — a corrupt prefix must never
+    allocate gigabytes or desync the stream quietly."""
+    n = int.from_bytes(header[:4], "big")
     if n > MAX_FRAME:
         raise ValueError(f"frame too large: {n} bytes")
-    return n
+    return n, int.from_bytes(header[4:HEADER_BYTES], "big")
+
+
+def crc_ok(body: bytes, crc: int) -> bool:
+    return (zlib.crc32(body) & 0xFFFFFFFF) == crc
 
 
 # ---------------------------------------------------------- wire codecs
@@ -317,6 +359,13 @@ class RpcClient:
         self.port = port
         self.timeout_s = timeout_s
         self._sock: Optional[socket.socket] = None
+        #: chaos/test seams (faults/netchaos.py): a transform applied
+        #: to the encoded request frame before send (corrupt-frame
+        #: injection), and ``(chunk_bytes, pause_s)`` pacing that drips
+        #: the frame onto the wire (trickle injection). Both None in
+        #: production — the send path is one ``sendall``.
+        self.frame_filter: Optional[Callable[[bytes], bytes]] = None
+        self.send_chunking: Optional[Tuple[int, float]] = None
 
     def connect(self) -> None:
         if self._sock is not None:
@@ -338,37 +387,71 @@ class RpcClient:
                 pass
             self._sock = None
 
-    def _recv_exact(self, n: int) -> bytes:
+    def _recv_exact(self, n: int, mid_frame: bool = False) -> bytes:
+        """Read exactly ``n`` bytes. EOF classification (the S-series
+        contract): a clean close BETWEEN frames — zero bytes read,
+        header position — is :class:`RpcDown` (the peer went away; the
+        next call reconnects); a close MID-frame — a partial header or
+        anywhere inside a body (``mid_frame``) — is
+        :class:`RpcProtocolError` (the stream died with bytes in
+        flight; whatever was being framed is unrecoverable)."""
         buf = b""
         while len(buf) < n:
             # budget-bounded: call() sets sock.settimeout from its
             # timeout_s before every frame, so this recv cannot hang
             chunk = self._sock.recv(n - len(buf))  # graftlint: disable=GL019
             if not chunk:
-                raise RpcDown("connection closed mid-frame")
+                if buf or mid_frame:
+                    raise RpcProtocolError(
+                        f"connection closed mid-frame "
+                        f"({len(buf)}/{n} bytes)")
+                raise RpcDown("connection closed")
             buf += chunk
         return buf
+
+    def _send_frame(self, frame: bytes) -> None:
+        if self.frame_filter is not None:
+            frame = self.frame_filter(frame)
+        pacing = self.send_chunking
+        if pacing is None:
+            self._sock.sendall(frame)
+            return
+        chunk, pause = pacing
+        for i in range(0, len(frame), chunk):
+            self._sock.sendall(frame[i:i + chunk])
+            time.sleep(pause)  # graftlint: disable=GL019 — chaos injection: the trickle IS the fault
 
     def call(self, op: str, timeout_s: Optional[float] = None,
              **kwargs) -> dict:
         """One request/response exchange; returns the response dict
-        (``ok`` stripped). Raises RpcTimeout / RpcDown / RpcError."""
+        (``ok`` stripped). Raises RpcTimeout / RpcDown /
+        RpcProtocolError / RpcError."""
         self.connect()
         self._sock.settimeout(timeout_s if timeout_s is not None
                               else self.timeout_s)
         try:
-            self._sock.sendall(encode_frame({"op": op, **kwargs}))
-            n = decode_length(self._recv_exact(4))
-            body = self._recv_exact(n)
+            self._send_frame(encode_frame({"op": op, **kwargs}))
+            n, crc = decode_header(self._recv_exact(HEADER_BYTES))
+            body = self._recv_exact(n, mid_frame=True)
         except socket.timeout as e:
             self.close()
             raise RpcTimeout(f"{op}: no response") from e
+        except RpcProtocolError:
+            self.close()
+            raise
         except RpcDown:
             self.close()
             raise
-        except OSError as e:
+        except (OSError, ValueError) as e:
             self.close()
             raise RpcDown(f"{op}: {e}") from e
+        if not crc_ok(body, crc):
+            # a corrupt RESPONSE frame: never decode it — a flipped bit
+            # in a token list would otherwise become a silent wrong
+            # answer. Poisoned stream: close, typed error, reconnect.
+            self.close()
+            raise RpcProtocolError(
+                f"{op}: response frame checksum mismatch")
         try:
             doc = json.loads(body)
         except ValueError as e:
@@ -376,6 +459,10 @@ class RpcClient:
             raise RpcDown(f"{op}: undecodable response: {e}") from e
         if not doc.get("ok"):
             if doc.get("kind") == "protocol":
+                # either end declared the stream unsafe (checksum
+                # reject, generation fence): drop the connection too —
+                # a retry must start from a clean socket
+                self.close()
                 raise RpcProtocolError(
                     doc.get("error", "protocol mismatch"))
             raise RpcError(doc.get("error", "unknown worker error"))
@@ -395,9 +482,28 @@ async def serve_connection(reader, writer, dispatch) -> None:
     try:
         while True:
             try:
-                header = await reader.readexactly(4)
-                body = await reader.readexactly(decode_length(header))
+                header = await reader.readexactly(HEADER_BYTES)
+                n, crc = decode_header(header)
+                body = await reader.readexactly(n)
             except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            except ValueError:
+                # an insane length prefix: framing is lost on this
+                # connection — drop it, the client reconnects clean
+                return
+            if not crc_ok(body, crc):
+                # corrupt REQUEST frame: answer typed (the client's
+                # retry-once path needs to know this was a protocol
+                # failure, not an application error), then drop the
+                # connection — the stream cannot be trusted past a
+                # failed checksum
+                try:
+                    writer.write(encode_frame(
+                        {"ok": False, "kind": "protocol",
+                         "error": "request frame checksum mismatch"}))
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    pass
                 return
             try:
                 doc = json.loads(body)
@@ -472,12 +578,19 @@ class RpcListener:
             pass
 
     @staticmethod
-    def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    def _recv_exact(conn: socket.socket, n: int,
+                    mid_frame: bool = False) -> bytes:
+        """Same EOF classification as the client's: clean close at a
+        frame boundary is RpcDown, mid-frame is RpcProtocolError."""
         buf = b""
         while len(buf) < n:
             chunk = conn.recv(n - len(buf))
             if not chunk:
-                raise RpcDown("connection closed mid-frame")
+                if buf or mid_frame:
+                    raise RpcProtocolError(
+                        f"connection closed mid-frame "
+                        f"({len(buf)}/{n} bytes)")
+                raise RpcDown("connection closed")
             buf += chunk
         return buf
 
@@ -496,8 +609,15 @@ class RpcListener:
                 return handled
             try:
                 conn.settimeout(self.read_timeout_s)
-                n = decode_length(self._recv_exact(conn, 4))
-                doc = json.loads(self._recv_exact(conn, n))
+                n, crc = decode_header(
+                    self._recv_exact(conn, HEADER_BYTES))
+                body = self._recv_exact(conn, n, mid_frame=True)
+                if not crc_ok(body, crc):
+                    conn.sendall(encode_frame(
+                        {"ok": False, "kind": "protocol",
+                         "error": "request frame checksum mismatch"}))
+                    continue
+                doc = json.loads(body)
                 try:
                     resp = {"ok": True, **(handler(doc, peer[0]) or {})}
                 except RpcProtocolError as e:

@@ -59,18 +59,20 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import random
 import signal
 import sys
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from .engine import Engine
 from .journal import RequestJournal
 from .requests import FINISH_CANCELLED, Request, RequestResult
-from .rpc import (JOURNAL_DRAIN_LIMIT, PROTO_VERSION,
-                  REJECT_REPLICA_DOWN, RpcProtocolError, decode_length,
-                  encode_frame, request_from_wire, request_to_wire,
-                  result_to_wire, serve_connection)
+from .rpc import (HEADER_BYTES, JOURNAL_DRAIN_LIMIT, PROTO_VERSION,
+                  REJECT_REPLICA_DOWN, RpcProtocolError, crc_ok,
+                  decode_header, encode_frame, request_from_wire,
+                  request_to_wire, result_to_wire, serve_connection)
 
 
 #: re-registration pacing (ROADMAP 3a remainder): a worker that
@@ -85,6 +87,21 @@ REREGISTER_IDLE_S = 5.0
 REREGISTER_BACKOFF_S = 0.5
 REREGISTER_BACKOFF_CAP_S = 10.0
 
+#: Mutating verbs whose dispatch consults the reply cache (graftlint
+#: GL024 holds this tuple against the registry in
+#: analysis/contracts.py): a duplicated or blindly-retried frame
+#: carrying an ``idem`` key the worker has already answered returns
+#: the CACHED reply (marked ``idem_hit``) instead of re-executing —
+#: the worker-side half of exactly-once under duplication. Read-only
+#: verbs (step has its own ack/redeliver protocol; health, prefix,
+#: summary, stream_drain are pure reads) stay uncached.
+IDEMPOTENT_VERBS = ("submit", "page_transfer", "journal_drain")
+
+#: bounded reply cache: plenty for every in-flight retry window (a
+#: duplicate older than 256 mutating calls is not a retry, it is a
+#: bug), small enough to never matter in memory
+REPLY_CACHE_SIZE = 256
+
 
 class WorkerServer:
     """Dispatch table around one engine (single-threaded: runs inside
@@ -98,9 +115,21 @@ class WorkerServer:
         self.clock = clock
         self.draining = False
         self.warmed = False
+        #: this incarnation's generation (faults/procsup.py assigns it
+        #: at spawn; -1 = unfenced, for direct-embedding tests). The
+        #: dispatch gate rejects calls stamped with any OTHER
+        #: generation — a router still holding a connection to a
+        #: partitioned-then-replaced incarnation gets a typed "stale
+        #: generation" protocol error, never a quiet wrong-process
+        #: mutation.
+        self.gen = -1
         #: monotonic timestamp of the last inbound router RPC — the
         #: re-registration loop's silence detector
         self.last_contact = time.monotonic()
+        #: idempotency reply cache (bounded, insertion-ordered): the
+        #: last reply per idem key on mutating verbs — dispatch
+        #: consults it so duplicated frames answer without re-executing
+        self._replies: "OrderedDict[str, dict]" = OrderedDict()
         self.stop_event = asyncio.Event()
         #: finished results not yet acked by the router — redelivered
         #: in every step response until an ack prunes them (a response
@@ -141,6 +170,28 @@ class WorkerServer:
         if fn is None:
             raise ValueError(f"unknown op {op!r}")
         self.last_contact = time.monotonic()
+        gen = doc.get("gen")
+        if gen is not None and self.gen >= 0 and int(gen) != self.gen:
+            # the generation fence: a caller stamped with another
+            # incarnation's gen is talking to the wrong process —
+            # typed rejection, never execution (the router classifies
+            # the "stale generation" marker and re-resolves)
+            raise RpcProtocolError(
+                f"stale generation {gen} (worker at gen {self.gen})")
+        idem = doc.get("idem")
+        if idem is not None and op in IDEMPOTENT_VERBS:
+            cached = self._replies.get(idem)
+            if cached is not None:
+                # a duplicated/retried mutating frame: answer from the
+                # reply cache — the original execution's exact
+                # response, marked so the router's suppression counter
+                # can account for it
+                return {**cached, "idem_hit": True}
+            resp = fn(doc) or {}
+            self._replies[idem] = resp
+            while len(self._replies) > REPLY_CACHE_SIZE:
+                self._replies.popitem(last=False)
+            return resp
         return fn(doc)
 
     def _in_flight_ids(self) -> List[str]:
@@ -367,9 +418,13 @@ async def _register_attempt(router_addr: str, doc: dict) -> dict:
             host or "127.0.0.1", int(port))
         writer.write(encode_frame({"op": "register", **doc}))
         await writer.drain()
-        header = await asyncio.wait_for(reader.readexactly(4), 15.0)
-        body = await asyncio.wait_for(
-            reader.readexactly(decode_length(header)), 15.0)
+        header = await asyncio.wait_for(
+            reader.readexactly(HEADER_BYTES), 15.0)
+        n, crc = decode_header(header)
+        body = await asyncio.wait_for(reader.readexactly(n), 15.0)
+        if not crc_ok(body, crc):
+            raise ConnectionError(
+                "registration response checksum mismatch")
         resp = json.loads(body)
     except RpcProtocolError:
         raise
@@ -409,30 +464,55 @@ async def _reregister_loop(worker, router_addr: str, doc: dict,
                            backoff_s: float = REREGISTER_BACKOFF_S,
                            backoff_cap_s: float =
                            REREGISTER_BACKOFF_CAP_S,
-                           on_reregister=None) -> None:
+                           on_reregister=None, rng=None) -> None:
     """Keep the worker attached across router restarts (ROADMAP 3a
     remainder): the startup handshake registered exactly once, so a
     router whose listener restarted (or whose process was replaced —
     it recovers in-flight work from its OWN ledger, never worker disk)
     would simply never drive this worker again. This loop watches for
     SILENCE — no inbound RPC for ``idle_s`` — and re-sends the
-    register frame with bounded exponential backoff until a listener
-    answers; re-registering at the same gen is an idempotent re-attach
-    on the supervisor side. A typed protocol rejection stops the
-    worker (the fleet's expected shape changed under us — serving on
-    would split streams)."""
-    delay = backoff_s
+    register frame until a listener answers; re-registering at the
+    same gen is an idempotent re-attach on the supervisor side. A
+    typed protocol rejection stops the worker (the fleet's expected
+    shape changed under us — serving on would split streams).
+
+    Backoff is FULL-JITTER exponential (``uniform(0, min(cap, base *
+    2^n))``): plain doubling is synchronized across the fleet — every
+    worker detects a partition heal on the same idle tick and the
+    whole fleet re-registers against the router in one thundering
+    herd, exactly when the router is busiest reconciling. The jitter
+    decorrelates them; ``rng`` is injectable for deterministic tests
+    and seeds from the pid otherwise (each process must draw a
+    DIFFERENT schedule — that is the point).
+
+    One SILENCE EPISODE is one logical registration: the idem key on
+    the register frame is refreshed when a new episode begins and
+    reused across the retries within it, so a listener that executed
+    the attach but lost the response answers the retry from its reply
+    cache instead of reconciling twice."""
+    rng = rng or random.Random(os.getpid())
+    attempt = 0
+    episode = 0
+    in_episode = False
+    base_idem = doc.get("idem", f"reg.{doc.get('worker_idx', 0)}"
+                                f".{doc.get('gen', 0)}")
     while not worker.stop_event.is_set():
         if time.monotonic() - worker.last_contact < idle_s:
             # healthy traffic: reset the backoff and poll at half the
             # idle threshold so silence is detected promptly
-            delay = backoff_s
+            attempt = 0
+            in_episode = False
             await asyncio.sleep(idle_s / 2)
             continue
+        if not in_episode:
+            in_episode = True
+            episode += 1
+            doc = {**doc, "idem": f"{base_idem}.re{episode}"}
         try:
             await _register_attempt(router_addr, doc)
             worker.last_contact = time.monotonic()
-            delay = backoff_s
+            attempt = 0
+            in_episode = False
             if on_reregister is not None:
                 on_reregister()
         except RpcProtocolError as e:
@@ -441,10 +521,12 @@ async def _reregister_loop(worker, router_addr: str, doc: dict,
             worker.stop_event.set()
             return
         except ConnectionError:
-            delay = min(delay * 2, backoff_cap_s)
-        # attempts are spaced by the CURRENT backoff (not the idle
-        # poll), so a long outage really does decay to the cap
-        await asyncio.sleep(delay)
+            attempt += 1
+        # attempts are spaced by the full-jitter backoff (not the idle
+        # poll), so a long outage decays toward uniform draws over
+        # [0, cap) — decorrelated across the fleet
+        await asyncio.sleep(rng.uniform(
+            0.0, min(backoff_cap_s, backoff_s * (2.0 ** attempt))))
 
 
 def warm_engine(engine: Engine) -> None:
@@ -470,6 +552,9 @@ async def _run_async(worker: WorkerServer, host: str, port: int,
                      router_addr: Optional[str], gen: int,
                      worker_idx: int, shape_hash: str,
                      tier: str = "mixed") -> int:
+    # arm the wire-level generation fence: dispatch() rejects calls
+    # stamped with any OTHER incarnation's gen (see WorkerServer.gen)
+    worker.gen = gen
     server = await asyncio.start_server(
         lambda r, w: serve_connection(r, w, worker.dispatch),
         host, port)
@@ -493,12 +578,18 @@ async def _run_async(worker: WorkerServer, host: str, port: int,
         # fleet (serve/disagg.py): "prefill" takes prefill_only
         # requests, "decode" takes sessions, "mixed" takes both —
         # the router's placement policy reads it off registration
+        # the idem key makes registration safe to blind-retry: a
+        # supervisor that executed the attach but lost the response
+        # answers the retry from its reply cache (one episode = one
+        # logical attach; _reregister_loop refreshes the suffix per
+        # silence episode)
         reg_doc = {"port": bound[1], "pid": os.getpid(), "gen": gen,
                    "worker_idx": worker_idx,
                    "replayed": worker.n_replayed,
                    "proto": PROTO_VERSION, "shape_hash": shape_hash,
                    "tier": tier,
-                   "page_size": int(worker.engine.pool.page_size)}
+                   "page_size": int(worker.engine.pool.page_size),
+                   "idem": f"reg.{worker_idx}.{gen}.{os.getpid()}.0"}
         try:
             await _register_with_router(router_addr, reg_doc)
         except RpcProtocolError as e:

@@ -82,6 +82,10 @@ PROM_PINNED_COUNTERS = (
     "fleet_scale_ups", "fleet_scale_downs",
     # serve/http.py — front-door admission
     "http_rate_limited",
+    # serve/router.py — RPC protocol hardening under network faults
+    # (serve/rpc.py checksums + idempotency, faults/netchaos.py)
+    "rpc_dup_suppressed", "rpc_corrupt_frames", "rpc_partitions_active",
+    "rpc_stale_generation_rejects",
 )
 
 #: engine-level track (steps, drafts, recovery markers); per-slot

@@ -1,0 +1,27 @@
+"""GL024 bad: a mutating verb with no idempotency anywhere — no
+declaration tuple, no reply cache in dispatch, no idem key at the call
+site. (GL018-clean on purpose: keys agree in both directions, so only
+the idempotency contract fires.)"""
+
+
+class WorkerStub:
+    def dispatch(self, doc):
+        op = doc.get("op")
+        fn = getattr(self, "op_" + op, None)
+        if fn is None:
+            raise ValueError(op)
+        return fn(doc)          # no reply cache, no idem read
+
+    def op_submit(self, doc):   # mutating: enqueues a request
+        req = doc["req"]
+        return {"accepted": bool(req)}
+
+
+class ClientStub:
+    def __init__(self, call):
+        self.call = call
+
+    def submit(self, req):
+        # no idem key: a duplicated frame re-enqueues the request
+        resp = self.call("submit", req=req, timeout_s=1.0)
+        return resp["accepted"]

@@ -1,6 +1,6 @@
-"""GL018 good: every verb has a caller, keys agree in both directions.
-(Also GL024-clean: 'submit' is mutating, so it declares idempotency and
-the call site sends an idem key — good fixtures pass ALL rules.)"""
+"""GL024 good: the full idempotency contract — declared verbs tuple,
+idem-keyed reply cache consulted in dispatch, explicit idem key at the
+call site."""
 
 IDEMPOTENT_VERBS = ("submit",)
 

@@ -5,10 +5,13 @@ wedge detection + hedged re-route + rejoin, fleet-wide duplicate-id
 dedupe, the bounded retry ladder, trace validity through envelope
 migration, and the chaos soak (slow tier)."""
 
+import asyncio
 import importlib.util
 import pathlib
 import subprocess
 import sys
+import threading
+import time
 
 import jax
 import numpy as np
@@ -20,6 +23,14 @@ from replicatinggpt_tpu.faults.fleet import (FLEET_SESSION, FLEET_STEP,
                                              KIND_HOT_KEY_SKEW,
                                              KIND_REPLICA_KILL,
                                              KIND_REPLICA_WEDGE)
+from replicatinggpt_tpu.faults.netchaos import (KIND_NET_CORRUPT,
+                                                KIND_NET_DELAY,
+                                                KIND_NET_DROP,
+                                                KIND_NET_DUP,
+                                                KIND_NET_PARTITION,
+                                                KIND_NET_REORDER,
+                                                KIND_NET_TRICKLE,
+                                                net_site)
 from replicatinggpt_tpu.models.gpt import init_params
 from replicatinggpt_tpu.sample import GenerateConfig, generate
 from replicatinggpt_tpu.serve import (EngineConfig, REJECT_FLEET_CAPACITY,
@@ -700,7 +711,7 @@ def test_bench_fleet_mode_emits_artifact(tmp_path, capsys, monkeypatch):
         fleet_prefix_groups=2, fleet_prefix_len=8, fleet_kill_at=6,
         fleet_journal_dir=str(tmp_path), trace_out=None,
         metrics_timeline=None, metrics_out=None, multiproc=False,
-        fleet_load_step=False, fleet_host_loss=False)
+        fleet_load_step=False, fleet_host_loss=False, net_chaos=False)
     bench.bench_fleet(args)
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
@@ -716,3 +727,322 @@ def test_bench_fleet_mode_emits_artifact(tmp_path, capsys, monkeypatch):
         assert {"occupancy_mean", "pages_in_use",
                 "prefix_hit_rate"} <= set(rep)
     assert "fleet_ttft_p50_ms" in doc and "fleet_ttft_p99_ms" in doc
+
+
+# ---------------------------------------------------------------------------
+# the wire fleet: real sockets between router and in-process workers —
+# netchaos faults land on genuine checksummed frames
+# ---------------------------------------------------------------------------
+
+# five flushed pages at page_size 4 — long enough that disagg prefill
+# hands off real multi-page transfers for the chaos plan to hurt
+WIRE_PROMPT_LEN = 20
+
+
+def _long_reqs(n, seed=29, max_new=8):
+    rng = np.random.default_rng(seed)
+    return [Request(
+        id=f"L{i}",
+        prompt=rng.integers(1, CFG.vocab_size - 1,
+                            (WIRE_PROMPT_LEN,)).astype(np.int32),
+        max_new_tokens=max_new, sampling=SamplingParams(greedy=True),
+        rng_seed=100 + i) for i in range(n)]
+
+
+class _WireFleet:
+    """N real WorkerServers (real engines, this process), each behind a
+    real TCP socket on a shared daemon asyncio thread: the router talks
+    to them through the genuine RPC wire — framing, checksums, reply
+    caches, generation fences — so netchaos faults hit actual frames.
+    The closest in-process analogue of a multi-host fleet, minus the
+    subprocess spawn cost of the multiproc tier."""
+
+    def __init__(self, params, n, ecfg=None, gens=None):
+        from replicatinggpt_tpu.serve.engine import Engine
+        from replicatinggpt_tpu.serve.worker import WorkerServer
+        ecfg = ecfg or EngineConfig(pool_size=2, max_queue=16,
+                                    page_size=4)
+        self.workers = []
+        for i in range(n):
+            w = WorkerServer(Engine(params, CFG, ecfg), journal=None)
+            if gens is not None:
+                w.gen = gens[i]
+            self.workers.append(w)
+        self.ports = []
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(30), "wire fleet never started listening"
+
+    def _serve(self):
+        from replicatinggpt_tpu.serve.rpc import serve_connection
+
+        async def main():
+            self._loop = asyncio.get_running_loop()
+            self._stop = asyncio.Event()
+            servers = []
+            for w in self.workers:
+                s = await asyncio.start_server(
+                    lambda r, wr, w=w: serve_connection(
+                        r, wr, w.dispatch),
+                    "127.0.0.1", 0)
+                servers.append(s)
+                self.ports.append(s.sockets[0].getsockname()[1])
+            self._ready.set()
+            await self._stop.wait()
+            for s in servers:
+                s.close()
+                await s.wait_closed()
+
+        asyncio.run(main())
+
+    def router(self, rcfg, tiers=None, page_size=0):
+        from replicatinggpt_tpu.serve.router import RemoteReplica
+        backends = []
+        for i, port in enumerate(self.ports):
+            rep = RemoteReplica(i, None,
+                                rpc_timeout_s=rcfg.step_timeout_s,
+                                step_timeout_s=rcfg.step_timeout_s)
+            rep.connect(port, gen=(self.workers[i].gen
+                                   if self.workers[i].gen >= 0
+                                   else None))
+            if tiers is not None:
+                rep.tier = tiers[i]
+            if page_size:
+                rep.page_size = page_size
+            backends.append(rep)
+        return Router(rcfg=rcfg, backends=backends)
+
+    def close(self):
+        self._loop.call_soon_threadsafe(self._stop.set)
+        self._thread.join(10)
+
+
+def _drive_wire(r, fleet, ids, budget_s=240.0, on_down=None):
+    """Step the wire fleet to idle, consuming the delivery ledger every
+    step. Finishes are collected as a LIST (duplicates must show up,
+    not be collapsed — exactly-once is the thing under test). A replica
+    the router marked down is re-attached to its still-running worker;
+    ``on_down(rep)`` supplies extra attach kwargs (e.g. the new gen)."""
+    deadline = time.monotonic() + budget_s
+    emitted, streams = [], {i: [] for i in ids}
+    while not r.idle:
+        assert time.monotonic() < deadline, (
+            f"wire drain stuck; recent events: {r.events[-8:]}")
+        emitted.extend(r.step())
+        for rid in streams:
+            streams[rid].extend(r.take_new_tokens(rid))
+        for rep in r.replicas:
+            if not rep.alive:
+                extra = on_down(rep) if on_down else {}
+                r.attach_replica(rep.idx, fleet.ports[rep.idx], **extra)
+    return emitted, streams
+
+
+@pytest.mark.fleet
+def test_wire_fleet_clean_run_parity(params):
+    """Protocol hardening must cost nothing on a clean wire: with no
+    FaultPlan installed the FaultyTransport-wrapped path is a straight
+    delegate — greedy parity and exactly-once hold, no chaos counter
+    moves, and the per-verb fault ordinals are never even counted
+    (proof the fast path really is untouched)."""
+    fleet = _WireFleet(params, 2)
+    try:
+        reqs = _reqs(6, max_new=8)
+        want = _offline(params, reqs)
+        r = fleet.router(RouterConfig(n_replicas=2, journal_dir=None,
+                                      step_timeout_s=5.0))
+        for q in reqs:
+            assert r.submit(q) is None
+        emitted, streams = _drive_wire(r, fleet, [q.id for q in reqs])
+        ids = [res.id for res in emitted]
+        assert sorted(ids) == sorted(q.id for q in reqs)
+        for res in emitted:
+            assert res.tokens == want[res.id], res.id
+            assert streams[res.id] == want[res.id], res.id
+        c = r.metrics.counters
+        assert c.get("rpc_dup_suppressed", 0) == 0
+        assert c.get("rpc_corrupt_frames", 0) == 0
+        assert c.get("rpc_partitions_active", 0) == 0
+        for rep in r.replicas:
+            assert rep.client.dups_injected == 0
+            assert rep.client._counts == {}
+        r.close()
+    finally:
+        fleet.close()
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_netchaos_soak_exactly_once(params):
+    """The tentpole soak: the full wire-fault ladder — duplicated and
+    reordered submits, a corrupt request frame, dropped and delayed
+    steps, a one-way partition mid-decode, duplicated / trickled /
+    reordered page-transfer frames mid-handoff on a disaggregated
+    fleet — and the greedy token streams stay byte-identical to the
+    clean offline run, every id finishes exactly once, and suppressed
+    duplicates exactly equal injected duplicates."""
+    fleet = _WireFleet(params, 3)
+    try:
+        reqs = _reqs(6) + _long_reqs(2)
+        want = _offline(params, reqs)
+        r = fleet.router(
+            RouterConfig(n_replicas=3, journal_dir=None,
+                         step_timeout_s=5.0,
+                         tiers=("prefill", "decode", "decode"),
+                         disagg_min_tail=1),
+            tiers=("prefill", "decode", "decode"), page_size=4)
+        plan = FaultPlan(
+            Fault(site=net_site("router", "worker1", "submit"),
+                  kind=KIND_NET_DUP, at=0, times=2),
+            Fault(site=net_site("router", "worker2", "submit"),
+                  kind=KIND_NET_CORRUPT, at=0),
+            Fault(site=net_site("router", "worker1", "step"),
+                  kind=KIND_NET_DROP, at=4),
+            Fault(site=net_site("router", "worker2", "step"),
+                  kind=KIND_NET_PARTITION, at=6, times=3, arg2=1),
+            Fault(site=net_site("router", "worker1", "step"),
+                  kind=KIND_NET_DELAY, at=8, arg=0.01),
+            Fault(site=net_site("router", "worker0", "page_transfer"),
+                  kind=KIND_NET_DUP, at=1, times=2),
+            Fault(site=net_site("router", "worker0", "page_transfer"),
+                  kind=KIND_NET_TRICKLE, at=4, arg=5, arg2=0.001),
+            Fault(site=net_site("router", "worker1", "page_transfer"),
+                  kind=KIND_NET_REORDER, at=2),
+            Fault(site=net_site("router", "worker2", "page_transfer"),
+                  kind=KIND_NET_REORDER, at=2),
+        )
+        with installed(plan):
+            for q in reqs:
+                assert r.submit(q) is None
+            emitted, streams = _drive_wire(
+                r, fleet, [q.id for q in reqs], budget_s=300.0)
+        # nothing in the ladder is fatal: every replica must have
+        # survived on its ORIGINAL transport — the dup-accounting
+        # equality below is only meaningful over un-replaced clients
+        assert all(rep.alive for rep in r.replicas)
+        ids = [res.id for res in emitted]
+        assert sorted(ids) == sorted(q.id for q in reqs), (
+            "double/missing finish: %r" % ids)
+        for res in emitted:
+            assert res.tokens == want[res.id], res.id
+            assert streams[res.id] == want[res.id], res.id
+        c = r.metrics.counters
+        injected = sum(rep.client.dups_injected for rep in r.replicas)
+        assert injected >= 3
+        assert c.get("rpc_dup_suppressed", 0) == injected
+        assert c.get("rpc_corrupt_frames", 0) == 1
+        assert c.get("rpc_partitions_active", 0) == 1
+        assert c.get("fleet_disagg_prefills", 0) >= 1
+        assert c.get("fleet_transfers", 0) >= 1
+        r.close()
+    finally:
+        fleet.close()
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_netchaos_two_way_partition_mark_down_and_reattach(params):
+    """A two-way partition mid-decode: the step RPC dies as RpcDown,
+    the router marks the replica down KEEPING its in-flight ledger, and
+    re-attaching to the (still running, state intact) worker resumes
+    the kept requests — token parity and exactly-once hold across the
+    down/attach cycle."""
+    fleet = _WireFleet(params, 2)
+    try:
+        reqs = _reqs(4)
+        want = _offline(params, reqs)
+        r = fleet.router(RouterConfig(n_replicas=2, journal_dir=None,
+                                      step_timeout_s=5.0))
+        plan = FaultPlan(
+            Fault(site=net_site("router", "worker1", "step"),
+                  kind=KIND_NET_PARTITION, at=2, times=1, arg2=0))
+        with installed(plan):
+            for q in reqs:
+                assert r.submit(q) is None
+            emitted, streams = _drive_wire(r, fleet,
+                                           [q.id for q in reqs])
+        ids = [res.id for res in emitted]
+        assert sorted(ids) == sorted(q.id for q in reqs)
+        for res in emitted:
+            assert res.tokens == want[res.id], res.id
+            assert streams[res.id] == want[res.id], res.id
+        c = r.metrics.counters
+        assert c.get("rpc_partitions_active", 0) == 1
+        assert c.get("fleet_replica_downs", 0) >= 1
+        assert c.get("fleet_replica_attaches", 0) >= 1
+        assert any("attached" in e for e in r.events)
+        r.close()
+    finally:
+        fleet.close()
+
+
+@pytest.mark.fleet
+def test_heartbeat_deadline_forces_reconnect(params):
+    """Half-open detection: once no RPC has round-tripped within the
+    heartbeat deadline the router closes the socket so the next call
+    reconnects from scratch. With the deadline forced to zero EVERY
+    step blows it — decode must still finish with parity through the
+    constant reconnect churn (nothing rides on connection identity)."""
+    fleet = _WireFleet(params, 1)
+    try:
+        reqs = _reqs(2, max_new=8)
+        want = _offline(params, reqs)
+        r = fleet.router(RouterConfig(n_replicas=1, journal_dir=None,
+                                      step_timeout_s=5.0))
+        rep = r.replicas[0]
+        assert rep.heartbeat_deadline_s == pytest.approx(15.0)
+        for q in reqs:
+            assert r.submit(q) is None
+        rep.heartbeat_deadline_s = 0.0
+        emitted, streams = _drive_wire(r, fleet, [q.id for q in reqs])
+        ids = [res.id for res in emitted]
+        assert sorted(ids) == sorted(q.id for q in reqs)
+        for res in emitted:
+            assert res.tokens == want[res.id], res.id
+            assert streams[res.id] == want[res.id], res.id
+        assert any("heartbeat deadline blown" in e for e in r.events)
+        r.close()
+    finally:
+        fleet.close()
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_stale_generation_fenced_then_reattach(params):
+    """Generation fencing over the real wire: the worker is replaced by
+    a newer incarnation the router never heard about (supervisor
+    restart during a partition). Frames stamped with the old gen must
+    be REJECTED by the fence — a typed protocol error, never a quiet
+    wrong-incarnation mutation — and re-attaching at the new gen
+    resumes to full parity."""
+    fleet = _WireFleet(params, 1, gens=[0])
+    try:
+        reqs = _reqs(3)
+        want = _offline(params, reqs)
+        r = fleet.router(RouterConfig(n_replicas=1, journal_dir=None,
+                                      step_timeout_s=5.0))
+        assert r.replicas[0].gen == 0
+        for q in reqs:
+            assert r.submit(q) is None
+        for _ in range(2):
+            r.step()
+        # the worker's incarnation moves on without the router knowing
+        fleet.workers[0].gen = 4
+        emitted, streams = _drive_wire(
+            r, fleet, [q.id for q in reqs],
+            on_down=lambda rep: {"gen": 4})
+        ids = [res.id for res in emitted]
+        assert sorted(ids) == sorted(q.id for q in reqs)
+        for res in emitted:
+            assert res.tokens == want[res.id], res.id
+            assert streams[res.id] == want[res.id], res.id
+        c = r.metrics.counters
+        assert c.get("rpc_stale_generation_rejects", 0) >= 1
+        assert c.get("fleet_replica_downs", 0) >= 1
+        assert c.get("fleet_replica_attaches", 0) >= 1
+        assert any("attached" in e for e in r.events)
+        r.close()
+    finally:
+        fleet.close()

@@ -198,7 +198,7 @@ def test_rpc_protocol_error_typed_over_wire():
     def client():
         c = RpcClient("127.0.0.1", lst.port, timeout_s=5.0)
         try:
-            c.call("register", proto=99)
+            c.call("register", proto=99, idem="reg.proto-test.0")
         except Exception as e:  # noqa: BLE001 — the assertion target
             result["exc"] = e
         finally:

@@ -27,6 +27,15 @@ from replicatinggpt_tpu.config import get_config
 from replicatinggpt_tpu.faults import Fault, FaultPlan, installed
 from replicatinggpt_tpu.faults.fleet import (FLEET_STEP, KIND_PROC_HANG,
                                              KIND_PROC_KILL)
+from replicatinggpt_tpu.faults.netchaos import (NET_CALL, FaultyTransport,
+                                                KIND_NET_CORRUPT,
+                                                KIND_NET_DELAY,
+                                                KIND_NET_DROP,
+                                                KIND_NET_DUP,
+                                                KIND_NET_PARTITION,
+                                                KIND_NET_REORDER,
+                                                KIND_NET_TRICKLE,
+                                                net_site)
 from replicatinggpt_tpu.faults.procsup import (BACKOFF, QUARANTINED,
                                                ProcSupervisor, RUNNING,
                                                SupervisorConfig,
@@ -39,15 +48,20 @@ from replicatinggpt_tpu.serve.requests import (FINISH_CANCELLED,
                                                REJECT_BAD_REQUEST,
                                                Request, RequestResult,
                                                SamplingParams)
-from replicatinggpt_tpu.serve.rpc import (REJECT_REPLICA_DOWN, RpcClient,
+from replicatinggpt_tpu.serve.rpc import (HEADER_BYTES,
+                                          REJECT_REPLICA_DOWN, RpcClient,
                                           RpcDown, RpcError,
-                                          decode_length, encode_frame,
+                                          RpcProtocolError, RpcTimeout,
+                                          crc_ok, decode_header,
+                                          encode_frame,
                                           request_from_wire,
                                           request_to_wire,
                                           result_from_wire,
                                           result_to_wire,
                                           serve_connection)
-from replicatinggpt_tpu.serve.worker import WorkerServer
+from replicatinggpt_tpu.serve.worker import (IDEMPOTENT_VERBS,
+                                             REPLY_CACHE_SIZE,
+                                             WorkerServer)
 
 pytestmark = [pytest.mark.fleet, pytest.mark.multiproc]
 
@@ -123,11 +137,16 @@ def _trace_check():
 
 def test_rpc_framing_and_bounds():
     frame = encode_frame({"op": "health", "x": 1})
-    assert decode_length(frame[:4]) == len(frame) - 4
-    assert json.loads(frame[4:]) == {"op": "health", "x": 1}
+    n, crc = decode_header(frame[:HEADER_BYTES])
+    body = frame[HEADER_BYTES:]
+    assert n == len(body)
+    assert crc_ok(body, crc)
+    assert json.loads(body) == {"op": "health", "x": 1}
+    # a single flipped body byte must fail the checksum, not decode
+    assert not crc_ok(bytes([body[0] ^ 0xFF]) + body[1:], crc)
     # a corrupt length prefix must not allocate gigabytes
     with pytest.raises(ValueError, match="frame too large"):
-        decode_length((1 << 30).to_bytes(4, "big"))
+        decode_header((1 << 30).to_bytes(4, "big") + b"\x00" * 4)
     with pytest.raises(ValueError, match="frame too large"):
         encode_frame({"blob": "x" * (17 << 20)})
 
@@ -205,6 +224,58 @@ def test_rpc_client_server_roundtrip_over_socket():
     assert calls[:3] == ["ping", "boom", "ping"]
 
 
+def test_recv_exact_eof_classification():
+    """EOF position decides the failure class: a peer that closes
+    BETWEEN frames (read the request, never answered) is a dead/
+    restarting worker — RpcDown, retry elsewhere. A peer that closes
+    MID-frame (partial header or partial body) tore a frame — that is
+    a protocol failure (RpcProtocolError), and the retry-once path
+    must reconnect with the SAME idem key rather than re-route."""
+    mode = {"m": "idle_eof"}
+
+    async def handler(reader, writer):
+        try:
+            header = await reader.readexactly(HEADER_BYTES)
+            n, _ = decode_header(header)
+            await reader.readexactly(n)
+        except asyncio.IncompleteReadError:
+            return
+        m = mode["m"]
+        if m == "torn_header":
+            writer.write(b"\x00\x00\x00")           # 3 of 8 header bytes
+            await writer.drain()
+        elif m == "torn_body":
+            frame = encode_frame({"ok": True})
+            writer.write(frame[:HEADER_BYTES + 2])  # full header, 2 of n
+            await writer.drain()
+        writer.close()                              # idle_eof: reply-less
+
+    async def main():
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        loop = asyncio.get_running_loop()
+
+        def client_side():
+            c = RpcClient("127.0.0.1", port, timeout_s=5.0)
+            with pytest.raises(RpcDown, match="connection closed"):
+                c.call("ping")
+            c.close()
+            mode["m"] = "torn_header"
+            with pytest.raises(RpcProtocolError, match="mid-frame"):
+                c.call("ping")
+            c.close()
+            mode["m"] = "torn_body"
+            with pytest.raises(RpcProtocolError, match="mid-frame"):
+                c.call("ping")
+            c.close()
+
+        await loop.run_in_executor(None, client_side)
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(main())
+
+
 # ---------------------------------------------------------------------------
 # journal durability satellites
 # ---------------------------------------------------------------------------
@@ -264,6 +335,40 @@ def test_journal_torn_tail_contract_repinned(tmp_path):
         f.write('{"ev": "finish", "id": "m9_1", "rea')   # torn tail
     pending = RequestJournal.unfinished(path)
     assert [r.id for r in pending] == [b.id]
+
+
+def test_journal_torn_tail_with_duplicated_finish_lines(tmp_path):
+    """A retried/duplicated finish append (the crash window between
+    record_finish and the ack that would have suppressed the retry)
+    plus a torn tail in ONE file: the reader must survive both — each
+    duplicated finish counts once (last reason wins), the torn line is
+    skipped, and the journal_drain view the router reconciles from
+    lists every finished id exactly once."""
+    path = str(tmp_path / "dupfin.jsonl")
+    j = RequestJournal(path)
+    a, b, c = _reqs(3, seed=13)
+    for q in (a, b, c):
+        j.record_submit(q)
+    j.record_finish(a.id, "max_tokens")
+    j.record_finish(a.id, "max_tokens")      # exact duplicate line
+    j.record_finish(b.id, "max_tokens")
+    j.record_finish(b.id, "cancelled")       # duplicate, new reason
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"ev": "finish", "id": "' + c.id + '", "rea')
+    pending = RequestJournal.unfinished(path)
+    assert [r.id for r in pending] == [c.id]     # dups never resurrect
+    # the RPC-visible view: one finished record per id, last reason
+    w = WorkerServer(_FakeEngine(), journal=RequestJournal(path))
+    resp = w.dispatch({"op": "journal_drain", "cursor": 0})
+    assert resp["eof"]
+    finished = [r for r in resp["records"] if r["kind"] == "finished"]
+    assert sorted((r["id"], r["reason"]) for r in finished) == \
+        sorted([(a.id, "max_tokens"), (b.id, "cancelled")])
+    unfinished = [r for r in resp["records"]
+                  if r["kind"] == "unfinished"]
+    assert [r["req"]["id"] for r in unfinished] == [c.id]
+    w.journal.close()
 
 
 # ---------------------------------------------------------------------------
@@ -408,6 +513,304 @@ def test_worker_cancel_of_replay_pending_journals_finish(tmp_path):
     assert resp["found"]
     journal.close()
     assert RequestJournal.unfinished(path) == []
+
+
+# ---------------------------------------------------------------------------
+# idempotent dispatch + generation fence (fake engine, no subprocess)
+# ---------------------------------------------------------------------------
+
+def test_worker_reply_cache_suppresses_duplicates():
+    """The worker-side half of exactly-once under duplication: a
+    mutating frame replayed with the same idem key answers from the
+    reply cache (marked idem_hit, engine untouched); a FRESH key is a
+    new logical attempt and re-executes; the cache is bounded FIFO."""
+    eng = _FakeEngine()
+    w = WorkerServer(eng, journal=None)
+    assert "submit" in IDEMPOTENT_VERBS
+    q = _reqs(1, seed=62)[0]
+    doc = {"op": "submit", "req": request_to_wire(q, 0.0),
+           "idem": "k1"}
+    d1 = w.dispatch(dict(doc))
+    assert d1["accepted"] and "idem_hit" not in d1
+    d2 = w.dispatch(dict(doc))                   # duplicated frame
+    assert d2["accepted"] and d2["idem_hit"] is True
+    assert list(eng._inflight) == [q.id]         # executed exactly once
+    # a fresh key re-executes: the ENGINE's in-flight dedupe answers
+    d3 = w.dispatch({**doc, "idem": "k2"})
+    assert not d3["accepted"]
+    assert d3["rejection"]["finish_reason"] == REJECT_BAD_REQUEST
+    # bounded cache: REPLY_CACHE_SIZE newer entries evict k1 — a
+    # duplicate THAT stale is a bug, not a retry, and re-executes
+    for i in range(REPLY_CACHE_SIZE):
+        w.dispatch({**doc, "idem": f"evict.{i}"})
+    assert "k1" not in w._replies
+    assert len(w._replies) == REPLY_CACHE_SIZE
+
+
+def test_worker_generation_fence():
+    """A frame stamped with another incarnation's gen is talking to
+    the wrong process: typed RpcProtocolError carrying the 'stale
+    generation' marker (the router's cue to renegotiate the attach),
+    never execution. Matching or absent gens pass; gen=-1 disables
+    the fence (direct-embedding tests)."""
+    w = WorkerServer(_FakeEngine(), journal=None)
+    w.gen = 7
+    with pytest.raises(RpcProtocolError, match="stale generation 6"):
+        w.dispatch({"op": "step", "acks": [], "gen": 6})
+    assert w.dispatch({"op": "step", "acks": [], "gen": 7})["idle"]
+    assert w.dispatch({"op": "step", "acks": []})["idle"]   # unstamped
+    w.gen = -1                                   # unfenced worker
+    assert w.dispatch({"op": "step", "acks": [], "gen": 3})["idle"]
+
+
+# ---------------------------------------------------------------------------
+# netchaos transport faults (fake engine over a real socket)
+# ---------------------------------------------------------------------------
+
+class _ChaosObserver:
+    """Stands in for RemoteReplica's observer hooks: collects the
+    responses the chaos layer swallowed and the partition edges."""
+
+    def __init__(self):
+        self.responses = []
+        self.partitions = []
+
+    def net_chaos_response(self, resp):
+        self.responses.append(resp)
+
+    def net_chaos_partition(self, active):
+        self.partitions.append(active)
+
+
+def _serve_fake_worker(w):
+    """Serve ``w.dispatch`` on a real socket from a daemon asyncio
+    thread; returns (port, stop)."""
+    import threading
+    ready = {}
+    started = threading.Event()
+
+    async def main():
+        stop = asyncio.Event()
+        server = await asyncio.start_server(
+            lambda r, wr: serve_connection(r, wr, w.dispatch),
+            "127.0.0.1", 0)
+        ready["port"] = server.sockets[0].getsockname()[1]
+        ready["stop"] = stop
+        ready["loop"] = asyncio.get_running_loop()
+        started.set()
+        await stop.wait()
+        server.close()
+        await server.wait_closed()
+
+    t = threading.Thread(target=lambda: asyncio.run(main()),
+                         daemon=True)
+    t.start()
+    assert started.wait(10)
+
+    def shutdown():
+        ready["loop"].call_soon_threadsafe(ready["stop"].set)
+        t.join(10)
+
+    return ready["port"], shutdown
+
+
+def test_netchaos_transport_fault_ladder():
+    """Every netchaos kind end to end against a real worker socket:
+    dup answers from the reply cache, reorder replays the previous
+    idempotent frame (discarded response still observed), delay and
+    trickle are harmless, drop raises the maybe-executed RpcTimeout,
+    a two-way partition raises RpcDown without touching the wire, a
+    one-way partition EXECUTES but loses the response, and the first
+    clean call after is the heal edge."""
+    eng = _FakeEngine(capacity=16)
+    w = WorkerServer(eng, journal=None)
+    port, shutdown = _serve_fake_worker(w)
+    obs = _ChaosObserver()
+    ft = FaultyTransport(RpcClient("127.0.0.1", port, timeout_s=5.0),
+                         src="router", dst="worker0", observer=obs)
+    reqs = _reqs(8, seed=61)
+    sub = [{"req": request_to_wire(q, 0.0), "idem": f"lad.{i}"}
+           for i, q in enumerate(reqs)]
+    site = net_site("router", "worker0", "submit")
+    try:
+        # no plan installed: the fast path never counts an ordinal
+        assert ft.call("step", acks=[])["idle"]
+        assert ft._counts == {}
+        plan = FaultPlan(
+            Fault(site=site, kind=KIND_NET_DUP, at=0),
+            Fault(site=site, kind=KIND_NET_REORDER, at=1),
+            Fault(site=site, kind=KIND_NET_DELAY, at=2, arg=0.01),
+            Fault(site=site, kind=KIND_NET_TRICKLE, at=3, arg=5,
+                  arg2=0.001),
+            Fault(site=site, kind=KIND_NET_DROP, at=4),
+            Fault(site=site, kind=KIND_NET_PARTITION, at=5, arg2=0),
+            Fault(site=site, kind=KIND_NET_PARTITION, at=6, arg2=1),
+        )
+        with installed(plan):
+            # idx 0 dup: caller gets the SECOND response — the cache hit
+            r0 = ft.call("submit", **sub[0])
+            assert r0["accepted"] and r0["idem_hit"] is True
+            assert ft.dups_injected == 1
+            assert list(eng._inflight) == [reqs[0].id]
+            # idx 1 reorder: lad.0 replayed first (stale dup, observed
+            # + discarded), then lad.1 proceeds normally
+            r1 = ft.call("submit", **sub[1])
+            assert r1["accepted"] and "idem_hit" not in r1
+            assert ft.dups_injected == 2
+            assert obs.responses[-1]["idem_hit"] is True
+            # idx 2 delay / idx 3 trickle: harmless, seams restored
+            assert ft.call("submit", **sub[2])["accepted"]
+            assert ft.call("submit", **sub[3])["accepted"]
+            assert ft.client.send_chunking is None
+            # idx 4 drop: nothing on the wire, maybe-executed timeout
+            with pytest.raises(RpcTimeout, match="dropped"):
+                ft.call("submit", **sub[4])
+            assert reqs[4].id not in eng._inflight
+            # idx 5 two-way partition: frame never leaves this host
+            with pytest.raises(RpcDown, match="partitioned"):
+                ft.call("submit", **sub[5])
+            assert reqs[5].id not in eng._inflight
+            assert obs.partitions == [True]
+            # idx 6 one-way partition: EXECUTED, response lost but
+            # observed (dup-suppression accounting stays exact)
+            with pytest.raises(RpcTimeout, match="one-way"):
+                ft.call("submit", **sub[6])
+            assert reqs[6].id in eng._inflight
+            assert obs.responses[-1]["accepted"]
+            # idx 7 clean: the heal edge
+            assert ft.call("submit", **sub[7])["accepted"]
+            assert obs.partitions == [True, False]
+            assert not ft.partitioned
+        assert ft.dups_injected == 2
+    finally:
+        ft.close()
+        shutdown()
+
+
+def test_netchaos_corrupt_frame_typed_reject_and_idem_retry():
+    """net_corrupt flips one seeded body byte: the worker's checksum
+    rejects the frame with a TYPED protocol error (never a mis-decoded
+    request — the engine must not see it), the frame_filter seam is
+    restored, and the retry with the SAME idem key executes fresh
+    (the poisoned frame never reached dispatch, so there is nothing
+    in the reply cache)."""
+    eng = _FakeEngine()
+    w = WorkerServer(eng, journal=None)
+    port, shutdown = _serve_fake_worker(w)
+    ft = FaultyTransport(RpcClient("127.0.0.1", port, timeout_s=5.0),
+                         src="router", dst="worker0")
+    q = _reqs(1, seed=63)[0]
+    kw = {"req": request_to_wire(q, 0.0), "idem": "c0"}
+    try:
+        # the catch-all site spelling must route to this link too
+        with installed(FaultPlan(Fault(site=NET_CALL,
+                                       kind=KIND_NET_CORRUPT, at=0,
+                                       times=1))):
+            with pytest.raises(RpcProtocolError, match="checksum"):
+                ft.call("submit", **kw)
+            assert ft.client.frame_filter is None
+            assert eng._inflight == {}           # never dispatched
+            ft.close()                           # poisoned stream
+            retry = ft.call("submit", **kw)      # same idem key
+        assert retry["accepted"] and "idem_hit" not in retry
+        assert list(eng._inflight) == [q.id]
+        assert ft.dups_injected == 0             # corruption != dup
+    finally:
+        ft.close()
+        shutdown()
+
+
+# ---------------------------------------------------------------------------
+# re-registration backoff (full jitter + episode idem keys)
+# ---------------------------------------------------------------------------
+
+class _RecordingRng:
+    """Deterministic stand-in for the jitter rng: records each
+    uniform(a, b) bound and returns 0 (no actual sleeping)."""
+
+    def __init__(self):
+        self.bounds = []
+
+    def uniform(self, a, b):
+        self.bounds.append((a, b))
+        return 0.0
+
+
+class _StubWorkerLoop:
+    """The two attributes _reregister_loop reads off the worker."""
+
+    def __init__(self):
+        self.stop_event = asyncio.Event()
+        self.last_contact = time.monotonic() - 100.0
+
+
+def test_reregister_backoff_full_jitter_bounds():
+    """The backoff draws uniform(0, min(cap, base * 2^attempt)) — FULL
+    jitter, so a fleet-wide partition heal cannot thundering-herd the
+    router. Against a dead address the bounds double then clamp at the
+    cap; the low bound is always 0."""
+    from replicatinggpt_tpu.serve.worker import _reregister_loop
+
+    async def main():
+        w = _StubWorkerLoop()
+        rng = _RecordingRng()
+        task = asyncio.ensure_future(_reregister_loop(
+            w, "127.0.0.1:1",              # nothing listens on port 1
+            {"worker_idx": 0, "gen": 0},
+            idle_s=0.05, backoff_s=0.5, backoff_cap_s=2.0, rng=rng))
+        deadline = time.monotonic() + 30.0
+        while len(rng.bounds) < 5:
+            assert time.monotonic() < deadline, rng.bounds
+            await asyncio.sleep(0.001)
+        w.stop_event.set()
+        await asyncio.wait_for(task, 10.0)
+        return rng.bounds
+
+    bounds = asyncio.run(main())
+    # attempt increments BEFORE the draw: first failure already doubles
+    assert bounds[:4] == [(0.0, 1.0), (0.0, 2.0), (0.0, 2.0),
+                          (0.0, 2.0)]
+
+
+def test_reregister_episode_idem_refresh(monkeypatch):
+    """One silence episode is one logical registration: retries within
+    an episode reuse its idem key (a listener that executed the attach
+    but lost the response answers from its reply cache), and a NEW
+    episode mints a fresh key (a new logical attach must execute)."""
+    import replicatinggpt_tpu.serve.worker as worker_mod
+    seen = []
+    fail = {"next": True}
+
+    async def fake_attempt(addr, doc):
+        seen.append(doc["idem"])
+        if fail["next"]:
+            fail["next"] = False
+            raise ConnectionError("refused")
+        return {"ok": True}
+
+    monkeypatch.setattr(worker_mod, "_register_attempt", fake_attempt)
+
+    async def main():
+        w = _StubWorkerLoop()
+        task = asyncio.ensure_future(worker_mod._reregister_loop(
+            w, "127.0.0.1:1", {"worker_idx": 1, "gen": 4},
+            idle_s=0.05, backoff_s=0.001, backoff_cap_s=0.002,
+            rng=_RecordingRng()))
+        deadline = time.monotonic() + 30.0
+        while len(seen) < 2:               # episode 1: fail, then ok
+            assert time.monotonic() < deadline, seen
+            await asyncio.sleep(0.001)
+        fail["next"] = True                # re-arm for episode 2
+        w.last_contact = time.monotonic() - 100.0   # silence again
+        while len(seen) < 4:               # episode 2: fail, then ok
+            assert time.monotonic() < deadline, seen
+            await asyncio.sleep(0.001)
+        w.stop_event.set()
+        await asyncio.wait_for(task, 10.0)
+
+    asyncio.run(main())
+    assert seen[:4] == ["reg.1.4.re1", "reg.1.4.re1",
+                        "reg.1.4.re2", "reg.1.4.re2"]
 
 
 # ---------------------------------------------------------------------------
@@ -856,7 +1259,7 @@ def test_bench_fleet_multiproc_emits_tagged_artifact(tmp_path, capsys):
         fleet_prefix_groups=2, fleet_prefix_len=8, fleet_kill_at=8,
         fleet_journal_dir=str(tmp_path), trace_out=None,
         metrics_timeline=None, metrics_out=None, multiproc=True,
-        fleet_load_step=False, fleet_host_loss=False)
+        fleet_load_step=False, fleet_host_loss=False, net_chaos=False)
     bench.bench_fleet(args)
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
@@ -875,6 +1278,41 @@ def test_bench_fleet_multiproc_emits_tagged_artifact(tmp_path, capsys):
     assert workers[0]["gen"] == 1
     assert workers[1]["crash_restarts"] == 0
     assert all(isinstance(w["pid"], int) for w in doc["workers"])
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_bench_fleet_net_chaos_emits_tagged_artifact(tmp_path, capsys):
+    """`bench.py --mode fleet --multiproc --net-chaos` end to end: the
+    wire-fault ladder (dup/reorder/delay/drop/one-way-partition) runs
+    against REAL worker processes mid-replay, every turn still
+    completes, and the artifact is tagged net_chaos with the
+    protocol-hardening counters in its router block."""
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    bench._EMITTED = False
+    args = bench.main.__globals__["argparse"].Namespace(
+        preset="test-tiny", serve_pool=4, serve_rate=200.0,
+        serve_max_new_tokens=6, serve_page_size=4, serve_n_pages=0,
+        fleet_replicas=2, fleet_sessions=5, fleet_turns=2,
+        fleet_prefix_groups=2, fleet_prefix_len=8, fleet_kill_at=-1,
+        fleet_journal_dir=str(tmp_path), trace_out=None,
+        metrics_timeline=None, metrics_out=None, multiproc=True,
+        fleet_load_step=False, fleet_host_loss=False, net_chaos=True)
+    bench.bench_fleet(args)
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    assert lines, "bench_fleet emitted no artifact JSON"
+    doc = json.loads(lines[-1])
+    assert doc["chaos"] == "net_chaos"
+    assert doc["n_completed"] == doc["n_requests"] == 10
+    # the hardened protocol absorbed the ladder: every injected
+    # duplicate that reached a worker answered from its reply cache
+    assert doc["router"].get("rpc_dup_suppressed", 0) >= 1
+    assert doc["value"] > 0
 
 
 @pytest.mark.chaos

@@ -31,14 +31,15 @@ NO_TIERS = {}
 
 
 def test_registry_shape():
-    assert len(RULES) >= 23     # v1 + mesh family + protocol family
+    assert len(RULES) >= 24     # v1 + mesh family + protocol family
     for rid, rule in RULES.items():
         assert rid == rule.id and rid.startswith("GL") and len(rid) == 5
         assert rule.name and rule.rationale and rule.bad and rule.good
         assert callable(rule.checker) or callable(rule.project_checker)
     for rid in ("GL010", "GL011", "GL012", "GL013", "GL014"):
         assert rid in RULES                   # the sharding/mesh family
-    for rid in ("GL018", "GL019", "GL020", "GL021", "GL022", "GL023"):
+    for rid in ("GL018", "GL019", "GL020", "GL021", "GL022", "GL023",
+                "GL024"):
         assert rid in RULES                   # the protocol/async family
 
 
@@ -821,4 +822,46 @@ def test_mutation_counter_pin_drop_fires_exactly_one_gl021():
                         ["GL021"])
     assert len(res.findings) == 1, [f.format() for f in res.findings]
     assert "fleet_drains" in res.findings[0].message
+    assert res.findings[0].path.endswith("router.py")
+
+
+def test_mutation_idempotent_verb_drop_fires_exactly_one_gl024():
+    """Deleting one verb from serve/worker.py's IDEMPOTENT_VERBS leaves
+    a mutating handler whose replies are never cached: exactly one new
+    GL024 (the dispatch class itself still consults the cache, and a
+    single-file lint has no call sites — only the membership check can
+    fire)."""
+    rel = "replicatinggpt_tpu/serve/worker.py"
+    src = (REPO / rel).read_text()
+    assert lint_source(src, rel, ["GL024"], severity=NO_TIERS).findings \
+        == []
+    needle = '"page_transfer", '
+    assert needle in src
+    res = lint_source(src.replace(needle, ""), rel, ["GL024"],
+                      severity=NO_TIERS)
+    assert len(res.findings) == 1, [f.format() for f in res.findings]
+    assert "page_transfer" in res.findings[0].message
+    assert "IDEMPOTENT" in res.findings[0].message
+
+
+def test_mutation_unkeyed_mutating_call_site_fires_gl024():
+    """Stripping the explicit idem key from router.py's submit call
+    site leaves a mutating verb crossing the wire unkeyed (statically):
+    GL024 flags the call site. Linted as a two-module project so the
+    worker-side dispatch class arms the rule."""
+    worker_rel = "replicatinggpt_tpu/serve/worker.py"
+    router_rel = "replicatinggpt_tpu/serve/router.py"
+    worker_src = (REPO / worker_rel).read_text()
+    router_src = (REPO / router_rel).read_text()
+    res = _lint_sources([(worker_rel, worker_src),
+                         (router_rel, router_src)], ["GL024"])
+    assert res.findings == [], [f.format() for f in res.findings]
+    needle = 'idem=self._next_idem("submit"),\n'
+    assert needle in router_src
+    res = _lint_sources(
+        [(worker_rel, worker_src),
+         (router_rel, router_src.replace(needle, ""))], ["GL024"])
+    assert [f.rule for f in res.findings] == ["GL024"], \
+        [f.format() for f in res.findings]
+    assert "submit" in res.findings[0].message
     assert res.findings[0].path.endswith("router.py")

@@ -80,7 +80,7 @@ ROUTER_TRACK_NAME = "router"
 #: silently stops validating that lifecycle edge.
 TRACE_VALIDATED_NAMES = ("request", "page_transfer", "token",
                          "request_unstarted", ROUTER_TRACK_NAME,
-                         "thread_name")
+                         "thread_name", "net_partition", "net_heal")
 
 
 def check_trace(path: str, min_requests: int = 0) -> List[str]:
@@ -115,6 +115,9 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
     transfers: List[dict] = []
     # request id -> highest token-instant index seen (window deliveries)
     token_indices: Dict[str, int] = {}
+    # replica -> currently-open net_partition count (netchaos edges:
+    # every heal must match an earlier partition on the same replica)
+    net_open: Dict[object, int] = {}
 
     for ev in events:
         ph = ev.get("ph")
@@ -166,6 +169,18 @@ def check_trace(path: str, min_requests: int = 0) -> List[str]:
             elif rid is not None and name == "page_transfer":
                 transfers.append(ev)
         elif ph == "i":
+            if name in ("net_partition", "net_heal"):
+                if not on_router:
+                    errors.append(f"{name} instant off the router "
+                                  f"track (track {key})")
+                rep = args.get("replica")
+                if name == "net_partition":
+                    net_open[rep] = net_open.get(rep, 0) + 1
+                elif net_open.get(rep, 0) <= 0:
+                    errors.append(f"net_heal for replica {rep!r} with "
+                                  f"no open net_partition")
+                else:
+                    net_open[rep] -= 1
             if rid is not None and name not in UNSTARTED and not on_router:
                 tagged.append(ev)
                 if name == "token":
