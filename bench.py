@@ -401,7 +401,9 @@ def bench_serve(args) -> None:
                         decode_window_auto=args.decode_window_auto,
                         mesh_data=mesh_d, mesh_model=mesh_m,
                         kv_quant=args.kv_quant,
-                        weight_quant=args.weight_quant)
+                        weight_quant=args.weight_quant,
+                        act_quant=args.act_quant,
+                        paged_kernel=args.paged_kernel)
     summary = run_replay(state.params, cfg.model, rcfg, ecfg,
                          draft_params=draft_params, draft_cfg=draft_cfg,
                          resilience=DEFAULT_SERVE_RESILIENCE,
@@ -674,6 +676,10 @@ def bench_serve(args) -> None:
         # capacity denominator ride every serve artifact
         "kv_quant": pg["kv_quant"],
         "bytes_per_page": pg["bytes_per_page"],
+        # kernel-route decision (ISSUE 20): which step families ran the
+        # unified Pallas kernel family vs XLA, with the envelope
+        # reasons for any fallback — schema pinned in tests/test_pages
+        "kernel_route": summary.get("kernel_route", {}),
         **({"speculative": sp} if sp else {}),
         **({"dispatch_split": dispatch_split} if dispatch_split else {}),
         **({"admission_storm": storm_block} if storm_block else {}),
@@ -1623,6 +1629,18 @@ def main() -> None:
                    help="--mode serve: block matmul kernel precision "
                         "(absmax-per-channel, dequant fused into the "
                         "matmuls)")
+    p.add_argument("--paged-kernel", action="store_true",
+                   help="--mode serve: run the unified Pallas "
+                        "paged-attention kernel family for every "
+                        "engine step (decode, mixed windows, verify; "
+                        "shard_map on a >1 mesh) — the artifact's "
+                        "kernel_route block records the decision and "
+                        "any envelope fallback reasons")
+    p.add_argument("--act-quant", default="none",
+                   choices=["none", "int8"],
+                   help="--mode serve: W8A8 activation quantization "
+                        "into the int8 weight matmuls (requires "
+                        "--weight-quant int8)")
     p.add_argument("--quant-ab", action="store_true",
                    help="--mode serve: bf16-vs-int8 KV capacity + "
                         "divergence A/B at a FIXED HBM budget on the "

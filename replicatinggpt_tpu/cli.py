@@ -41,6 +41,7 @@ ENGINE_FORWARD_FLAGS = (
     ("kv_quant", "--kv-quant"),
     ("weight_quant", "--weight-quant"),
     ("quant_granularity", "--quant-granularity"),
+    ("act_quant", "--act-quant"),
 )
 #: store_true engine switches, forwarded only when set
 ENGINE_FORWARD_SWITCHES = (("no_prefix_cache", "--no-prefix-cache"),
@@ -118,16 +119,27 @@ def add_engine_flags(p: argparse.ArgumentParser) -> None:
                         "--checkpoint-dir is applied when present, "
                         "else computed (and saved) at startup")
     p.add_argument("--paged-kernel", action="store_true",
-                   help="opt into the Pallas paged decode fast path "
-                        "(falls back to the XLA gather route when the "
-                        "mesh or dtype rules it out — see "
-                        "ops/paged_pallas.paged_kernel_mesh_ok)")
+                   help="opt into the unified Pallas paged-attention "
+                        "kernel family for EVERY engine step (decode, "
+                        "mixed prefill+decode windows, speculative "
+                        "verify; shard_map-wrapped on a >1 mesh). The "
+                        "route decision is static per engine and "
+                        "exported — metrics_summary()['kernel_route'] "
+                        "names any envelope gate that forced XLA")
     p.add_argument("--quant-granularity", default="page",
                    choices=["page", "head"],
                    help="KV scale granularity: 'page' = one f32 scale "
-                        "per written row (kernel-compatible), 'head' "
-                        "= one per (row, head) — tighter for outlier "
-                        "heads at H x the metadata (XLA gather route)")
+                        "per written row, 'head' = one per (row, head) "
+                        "— tighter for outlier heads at H x the "
+                        "metadata; both dequant in-kernel on the "
+                        "Pallas route")
+    p.add_argument("--act-quant", default="none",
+                   choices=["none", "int8"],
+                   help="W8A8: quantize activation rows to int8 "
+                        "(absmax per row) into the int8 weight "
+                        "matmuls — requires --weight-quant int8; "
+                        "halves the activation operand and feeds the "
+                        "MXU a native int8 x int8 contraction")
 
 
 def engine_forward_args(args: argparse.Namespace) -> list:
@@ -168,7 +180,8 @@ def engine_config_from_args(args: argparse.Namespace):
                         mesh_data=d, mesh_model=m,
                         kv_quant=args.kv_quant,
                         weight_quant=args.weight_quant,
-                        quant_granularity=args.quant_granularity)
+                        quant_granularity=args.quant_granularity,
+                        act_quant=args.act_quant)
 
 
 def _build_mesh_if_needed(cfg):

@@ -77,6 +77,14 @@ class ModelConfig:
     # packed_decode_attention kernel (the packed-flash lane-slice trick
     # applied to decode). 'heads' stays the default until the layout A/B
     # validates on hardware (tools/hw_validate.py decode_sweep_packed).
+    act_quant: str = "none"
+    # W8A8 serving: 'int8' quantizes the ACTIVATION rows feeding the
+    # already-int8-quantized weight matmuls of the cached decode paths
+    # (per-row symmetric, models.gpt._wmm) so the contraction runs
+    # int8 x int8 -> int32. No effect unless the params carry int8
+    # kernels (quant/weights.py) — the serve engine sets this from
+    # EngineConfig.act_quant; training paths never quantize. 'none'
+    # default keeps every existing config byte-identical.
     scan_layers: Optional[bool] = None
     # lax.scan over stacked layer params. None = auto: on TPU, unroll
     # shallow stacks (n_layer <= 16) — measured on v5e, unrolling the
@@ -110,6 +118,7 @@ class ModelConfig:
                                        "ulysses")
         assert self.remat_policy in ("full", "dots", "dots_no_batch"), (
             self.remat_policy)
+        assert self.act_quant in ("none", "int8"), self.act_quant
         return self
 
 

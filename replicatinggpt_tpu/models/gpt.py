@@ -124,7 +124,7 @@ def _activation(x: jnp.ndarray, kind: str) -> jnp.ndarray:
 
 
 def _wmm(h: jnp.ndarray, lp: Dict[str, jnp.ndarray], name: str,
-         cd) -> jnp.ndarray:
+         cd, aq: bool = False) -> jnp.ndarray:
     """``h @ lp[name]`` with weight-quantization dequant fused into the
     matmul: quantized params (quant/weights.py) store the kernel in
     int8/fp8 plus a per-OUTPUT-channel f32 ``<name>_scale`` vector, and
@@ -133,9 +133,30 @@ def _wmm(h: jnp.ndarray, lp: Dict[str, jnp.ndarray], name: str,
     full-precision weight. Unquantized params take the identical
     ``h @ W.astype(cd)`` path (the scale key is simply absent, a static
     pytree property — no recompile churn, one program per params
-    structure)."""
-    y = h @ lp[name].astype(cd)
+    structure).
+
+    ``aq`` (W8A8): when the kernel is already int8, quantize the
+    ACTIVATION rows too — per-row symmetric int8 (same ``max(amax/127,
+    eps)`` scale law as quant/kv.py) into an int8 x int8 -> int32
+    ``dot_general``, dequanted by the separable rank-1 scale product
+    ``s_act (rows) x s_w (output channels)``. Rows-within-int8-range is
+    exact in int32, so W8A8 divergence comes only from the activation
+    rounding (bounded like the KV int8 budget). Falls through to the
+    weight-only path when the kernel is not int8 (fp8 kernels keep
+    f32-accumulated matmuls)."""
     s = lp.get(name + "_scale")
+    if aq and s is not None and lp[name].dtype == jnp.int8:
+        f = h.astype(jnp.float32)
+        s_act = jnp.maximum(
+            jnp.max(jnp.abs(f), axis=-1, keepdims=True) / 127.0, 1e-8)
+        hq = jnp.clip(jnp.round(f / s_act), -127.0,
+                      127.0).astype(jnp.int8)
+        y = jax.lax.dot_general(
+            hq, lp[name], (((hq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return (y.astype(jnp.float32) * s_act
+                * s.astype(jnp.float32)).astype(cd)
+    y = h @ lp[name].astype(cd)
     if s is not None:
         y = y * s.astype(cd)
     return y
@@ -355,9 +376,10 @@ def _cached_qkv_merged(h_in, lp, cfg: ModelConfig, cd):
     front half of a block as (B, T, C) q/k/v rows (one source of truth
     for the math that must produce identical K/V on decode and
     prefill). The packed cache layout writes these rows untouched."""
+    aq = getattr(cfg, "act_quant", "none") == "int8"
     h = _layer_norm(h_in, lp["ln1_scale"], lp["ln1_bias"],
                     cfg.layernorm_eps)
-    qkv = _wmm(h, lp, "qkv_kernel", cd) + lp["qkv_bias"].astype(cd)
+    qkv = _wmm(h, lp, "qkv_kernel", cd, aq=aq) + lp["qkv_bias"].astype(cd)
     return jnp.split(qkv, 3, axis=-1)
 
 
@@ -372,14 +394,16 @@ def _cached_block_tail(h_in, attn_merged, lp, cfg: ModelConfig, cd):
     """Output projection + residual + ln2 + MLP + residual — the
     cache-path back half of a block, shared by decode_step and prefill
     (no dropout: decode paths never train)."""
-    attn = (_wmm(attn_merged, lp, "attn_out_kernel", cd)
+    aq = getattr(cfg, "act_quant", "none") == "int8"
+    attn = (_wmm(attn_merged, lp, "attn_out_kernel", cd, aq=aq)
             + lp["attn_out_bias"].astype(cd))
     h_mid = h_in + attn
     h = _layer_norm(h_mid, lp["ln2_scale"], lp["ln2_bias"],
                     cfg.layernorm_eps)
-    h = _activation(_wmm(h, lp, "mlp_up_kernel", cd)
+    h = _activation(_wmm(h, lp, "mlp_up_kernel", cd, aq=aq)
                     + lp["mlp_up_bias"].astype(cd), cfg.activation)
-    h = _wmm(h, lp, "mlp_down_kernel", cd) + lp["mlp_down_bias"].astype(cd)
+    h = (_wmm(h, lp, "mlp_down_kernel", cd, aq=aq)
+         + lp["mlp_down_bias"].astype(cd))
     return h_mid + h
 
 
@@ -1087,10 +1111,42 @@ def _gather_kv(cc: Dict[str, jnp.ndarray], layer_idx, tables,
                           cd=cd))
 
 
+def _serve_kernel_mesh(shardings):
+    """The >1-device serve mesh behind a ServeShardings plan, or None
+    when the engine is effectively single-device — the static fact the
+    paged kernel branches switch on to pick the bare ``pallas_call``
+    vs its ``shard_map`` wrapper (shardings ride the jit STATIC args,
+    so this resolves at trace time, one program per plan)."""
+    if shardings is None:
+        return None
+    mesh = shardings.cache.mesh
+    return mesh if mesh.size > 1 else None
+
+
+def _paged_window_attn(q_w, k_w, v_w, k_layer, v_layer, tables, pos_eff,
+                       n_head, ks_layer, vs_layer, mesh):
+    """One layer of windowed paged attention through the unified Pallas
+    kernel family (ops/paged_pallas.py): the bare kernel on a single
+    device, the ``shard_map`` wrapper on a >1 (data, model) mesh. All
+    (B, W, C) in, (B, W, C) out, attending STALE pool + causal fresh
+    window — callers scatter the window rows afterwards."""
+    if mesh is not None:
+        from ..ops.paged_pallas import sharded_paged_window_attention
+        return sharded_paged_window_attention(
+            q_w, k_w, v_w, k_layer, v_layer, tables, pos_eff,
+            n_head=n_head, mesh=mesh, k_scales=ks_layer,
+            v_scales=vs_layer)
+    from ..ops.paged_pallas import paged_window_attention
+    return paged_window_attention(
+        q_w, k_w, v_w, k_layer, v_layer, tables, pos_eff,
+        n_head=n_head, k_scales=ks_layer, v_scales=vs_layer)
+
+
 def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
                       active: jnp.ndarray, tables: jnp.ndarray,
                       cache: Dict[str, jnp.ndarray], cfg: ModelConfig, *,
-                      use_pallas: bool = False, use_fused: bool = False
+                      use_pallas: bool = False, use_fused: bool = False,
+                      shardings=None
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """``decode_step_multi`` over a PAGED pool: per-slot positions are
     logical, and each slot's K/V is gathered through its page table.
@@ -1124,6 +1180,7 @@ def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
     woff = jnp.where(active, pos_eff % psz, psz)   # inactive -> dropped
 
     quantized = "ks" in cache
+    mesh = _serve_kernel_mesh(shardings)
     if use_fused:
         # ONE Pallas launch for the whole layer stack: the page table
         # rides scalar-prefetch SMEM so each (layer, slot) grid step
@@ -1171,13 +1228,13 @@ def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
                 # Quantized pools hand the kernel their scale layers
                 # (dequant inside the accumulation loop) and a fresh
                 # column pre-quantize-dequantized to the exact value
-                # the scatter below stores.
-                from ..ops.paged_pallas import paged_decode_attention
+                # the scatter below stores. On a >1 serve mesh the
+                # shard_map wrapper runs the same kernel per chip.
                 k_layer = jax.lax.dynamic_index_in_dim(cc["k"], layer_idx,
                                                        0, keepdims=False)
                 v_layer = jax.lax.dynamic_index_in_dim(cc["v"], layer_idx,
                                                        0, keepdims=False)
-                k_new, v_new = k_m[:, 0, :], v_m[:, 0, :]
+                k_new, v_new = k_m, v_m                      # (B, 1, C)
                 ks_layer = vs_layer = None
                 if quantized:
                     from ..quant.kv import (fake_quantize_rows,
@@ -1191,10 +1248,9 @@ def decode_step_paged(params: Params, idx_t: jnp.ndarray, pos: jnp.ndarray,
                         cc["ks"], layer_idx, 0, keepdims=False)
                     vs_layer = jax.lax.dynamic_index_in_dim(
                         cc["vs"], layer_idx, 0, keepdims=False)
-                attn_merged = paged_decode_attention(
-                    q_m[:, 0, :], k_new, v_new,
-                    k_layer, v_layer, tables, pos_eff, n_head=H,
-                    k_scales=ks_layer, v_scales=vs_layer)[:, None, :]
+                attn_merged = _paged_window_attn(
+                    q_m, k_new, v_new, k_layer, v_layer, tables,
+                    pos_eff, H, ks_layer, vs_layer, mesh)
                 cc = _scatter_kv(cc, layer_idx, phys, woff,
                                  k_m[:, 0, :], v_m[:, 0, :], packed, H)
             else:
@@ -1279,7 +1335,8 @@ def decode_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
         tok, pos, active, budget, cache, rngs = carry
         logits, cache = decode_step_paged(
             params, tok, pos, active, tables, cache, cfg,
-            use_pallas=use_pallas, use_fused=use_fused)
+            use_pallas=use_pallas, use_fused=use_fused,
+            shardings=shardings)
         nxt, rngs = sample_fn(rngs, logits)
         nxt = jnp.where(active, nxt, 0)
         emitted = active
@@ -1307,7 +1364,7 @@ def mixed_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
                        pf_toks: jnp.ndarray, tables: jnp.ndarray,
                        cache: Dict[str, jnp.ndarray], rngs: jnp.ndarray,
                        cfg: ModelConfig, *, sample_fn, length: int,
-                       shardings=None):
+                       shardings=None, use_kernel: bool = False):
     """``decode_window_paged`` with chunked prefill folded INTO the
     window — the Sarathi-style mixed step the continuous-window engine
     dispatches when an admission landed at the window boundary: newly
@@ -1366,7 +1423,7 @@ def mixed_window_paged(params: Params, tok: jnp.ndarray, pos: jnp.ndarray,
         window = jnp.where(prefilling[:, None], chunk_toks, col0)
         logits, cache = verify_step_paged(
             params, window, base, n_tok - 1, active, tables, cache, cfg,
-            shardings=shardings, logits_rows=1)
+            shardings=shardings, logits_rows=1, use_kernel=use_kernel)
         decoding = active & ~prefilling
         nxt, new_rngs = sample_fn(rngs, logits[:, 0, :])
         rngs = jnp.where(decoding[:, None], new_rngs, rngs)
@@ -1393,7 +1450,8 @@ def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
                       n_valid: jnp.ndarray, active: jnp.ndarray,
                       tables: jnp.ndarray, cache: Dict[str, jnp.ndarray],
                       cfg: ModelConfig, *, shardings=None,
-                      logits_rows: Optional[int] = None
+                      logits_rows: Optional[int] = None,
+                      use_kernel: bool = False
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """``verify_step_multi`` over a paged pool: the speculative window's
     K/V scatters through each slot's page table and the whole drafted
@@ -1412,6 +1470,18 @@ def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
     row 0 — projecting all W rows to the vocab every scan step would
     multiply the head cost by the chunk width for nothing); None keeps
     the full (B, W, V) output the speculative verifier needs.
+
+    ``use_kernel`` routes the attention core through the unified paged
+    Pallas kernel (``paged_window_attention`` / its shard_map wrapper):
+    the kernel attends the STALE pool (positions < pos) plus the causal
+    fresh window in-launch, then the scatter lands AFTER — equivalent to
+    this function's scatter-then-gather because valid query rows only
+    ever attend valid fresh rows (``valid`` is a prefix mask) and the
+    quantized fresh rows are fake-quantized to exactly what the scatter
+    stores. Padding rows (j > n_valid) and inactive rows produce
+    garbage either way and are discarded by callers (the diagonal
+    self-attention keeps them NaN-free). Callers gate on
+    ``ops.paged_pallas.mixed_step_kernel_ok`` + packed layout.
     """
     cd = _dtype(cfg.dtype)
     B, W = window.shape
@@ -1433,19 +1503,51 @@ def verify_step_paged(params: Params, window: jnp.ndarray, pos: jnp.ndarray,
     lpage = jnp.minimum(abs_pos // psz, mp - 1)
     phys = jnp.take_along_axis(tables, lpage, axis=1)   # (B, W)
     woff = jnp.where(valid & (abs_pos < Smax), abs_pos % psz, psz)
+    quantized = "ks" in cache
+    mesh = _serve_kernel_mesh(shardings)
 
     def body(carry, inputs):
         h_in, cc = carry
         lp, layer_idx = inputs
         q_m, k_m, v_m = _cached_qkv_merged(h_in, lp, cfg, cd)  # (B, W, C)
-        # scatter values laid out phys.shape-major: advanced indices
-        # (phys, woff) broadcast to (B, W) and land first
-        cc = _scatter_kv(cc, layer_idx, phys, woff, k_m, v_m, packed, H)
-        q_h = _split_heads(q_m, H)
-        k_all, v_all = _gather_kv(cc, layer_idx, tables, packed, H, cd)
-        attn = windowed_cached_attention(q_h, k_all, v_all, pos_eff)
+        if use_kernel:
+            # attend stale pool + causal fresh window in-kernel, then
+            # scatter (write-then-attend equivalence, see docstring)
+            k_layer = jax.lax.dynamic_index_in_dim(cc["k"], layer_idx,
+                                                   0, keepdims=False)
+            v_layer = jax.lax.dynamic_index_in_dim(cc["v"], layer_idx,
+                                                   0, keepdims=False)
+            k_w, v_w = k_m, v_m
+            ks_layer = vs_layer = None
+            if quantized:
+                from ..quant.kv import (fake_quantize_rows,
+                                        pool_quant_mode)
+                kv_dtype, gran = pool_quant_mode(cc)
+                k_w = fake_quantize_rows(k_m, kv_dtype, H,
+                                         gran).astype(cd)
+                v_w = fake_quantize_rows(v_m, kv_dtype, H,
+                                         gran).astype(cd)
+                ks_layer = jax.lax.dynamic_index_in_dim(
+                    cc["ks"], layer_idx, 0, keepdims=False)
+                vs_layer = jax.lax.dynamic_index_in_dim(
+                    cc["vs"], layer_idx, 0, keepdims=False)
+            attn_merged = _paged_window_attn(
+                q_m, k_w, v_w, k_layer, v_layer, tables, pos_eff, H,
+                ks_layer, vs_layer, mesh)
+            cc = _scatter_kv(cc, layer_idx, phys, woff, k_m, v_m,
+                             packed, H)
+        else:
+            # scatter values laid out phys.shape-major: advanced
+            # indices (phys, woff) broadcast to (B, W) and land first
+            cc = _scatter_kv(cc, layer_idx, phys, woff, k_m, v_m,
+                             packed, H)
+            q_h = _split_heads(q_m, H)
+            k_all, v_all = _gather_kv(cc, layer_idx, tables, packed, H,
+                                      cd)
+            attn_merged = _merge_heads(windowed_cached_attention(
+                q_h, k_all, v_all, pos_eff))
         cc = _constrain_cache(cc, shardings)
-        return (_cached_block_tail(h_in, _merge_heads(attn), lp, cfg, cd),
+        return (_cached_block_tail(h_in, attn_merged, lp, cfg, cd),
                 cc), None
 
     if cfg.use_layer_scan:

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ..quant.kv import fake_quantize_row_f32 as _fake_quant_row
+from ..quant.kv import fake_quantize_row_body as _fake_quant_row
 from .flash_pallas import (LANES, NEG_INF, _compiler_params,
                            _interpret_mode, _smem_spec, _vmem_spec, pltpu)
 
@@ -360,17 +360,17 @@ def fused_paged_decode_supported(cfg, n_slots: int, page_size: int,
     pair + the (n_slots, C) residual scratch within FUSED_LAYER_BYTES.
     The serve engine prefers this route over the per-layer paged kernel
     (ops/paged_pallas.py) whenever it fits — one launch per decode step
-    instead of one per layer. On a >1-device serving mesh the route is
-    OFF (``ops.paged_pallas.paged_kernel_mesh_ok``): a bare pallas_call
-    cannot be GSPMD-partitioned, so sharded engines take the XLA path.
-    Quantized KV pools (quant/): int8 at PAGE granularity streams the
-    (page, 1) scale blocks and dequants in the accumulation loop —
-    fp8 / head granularity route the XLA gather path (same reasoning
-    as ``paged_pallas.paged_decode_supported``)."""
-    from .paged_pallas import paged_kernel_mesh_ok
-    if not paged_kernel_mesh_ok(mesh):
-        return False
-    if kv_quant not in ("none", "int8") or granularity != "page":
+    instead of one per layer. The shape/quant checks are the SHARED
+    envelope (``ops.paged_pallas.paged_attention_envelope`` — int8 AND
+    fp8, page AND head granularity all dequant in the accumulation
+    loop now); this predicate layers the fused-only gates on top:
+    packed cache layout, a 1x1 mesh (the fused kernel streams whole
+    weight matrices per layer step, which tensor parallelism shards —
+    sharded engines route the per-layer kernel's shard_map wrapper
+    instead), and one layer's weights + a double-buffered page pair +
+    the (n_slots, C) residual scratch within FUSED_LAYER_BYTES."""
+    from .paged_pallas import paged_attention_envelope
+    if mesh is not None and mesh.size > 1:
         return False
     if cfg.decode_cache_layout != "packed":
         return False
@@ -378,9 +378,10 @@ def fused_paged_decode_supported(cfg, n_slots: int, page_size: int,
     if C % H != 0:
         return False
     D = C // H
-    if D not in (32, 64, 128, 256) or H > LANES:
-        return False
-    if page_size % 8 != 0:
+    ok, _ = paged_attention_envelope(
+        H, D, page_size, itemsize=itemsize, kv_quant=kv_quant,
+        granularity=granularity)
+    if not ok:
         return False
     if pltpu is None:
         return False
@@ -395,7 +396,7 @@ def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
                         ln2b_ref, wup_ref, bup_ref, wdown_ref, bdown_ref,
                         kp_ref, vp_ref, *rest, n_layer, n_head, head_dim,
                         page_size, n_pages_per_slot, eps, scale,
-                        activation, quantized):
+                        activation, quantized, kv_dtype, head_gran):
     """Grid (layer, slot, logical page), all sequential: the residual
     row of every slot is carried across layer steps in VMEM scratch
     (exactly ``_decode_kernel``'s trick, widened to B rows), each
@@ -407,11 +408,13 @@ def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
     keep a constant block index across the whole (slot, page) subgrid,
     so they stream exactly once per layer.
 
-    ``quantized`` (int8 pool, page-granularity scales): two extra
-    (psz, 1) f32 scale blocks ride the page index map and dequant the
-    K/V pages inside the accumulation loop, and the fresh K/V rows are
-    FAKE-QUANTIZED (``_fake_quant_row`` — bit-identical math to
-    quant.kv) before attending, so the fresh column scores exactly
+    ``quantized`` (int8 OR fp8 pool): two extra f32 scale blocks —
+    (psz, 1) page granularity, (psz, H) head granularity with the
+    per-head lane column selected in the loop — ride the page index
+    map and dequant the K/V pages inside the accumulation loop, and
+    the fresh K/V rows are FAKE-QUANTIZED (``_fake_quant_row`` —
+    bit-identical math to quant.kv, including fp8's saturating e4m3
+    round-trip) before attending, so the fresh column scores exactly
     what the caller's quantize-on-write scatter will store; the raw
     rows still leave through newk/newv for that scatter."""
     if quantized:
@@ -442,8 +445,10 @@ def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
         v_row = qkv[:, 2 * C:]
         if quantized:
             # attend the value the pool will actually hold (docstring)
-            kdq = _fake_quant_row(k_row, 127.0)
-            vdq = _fake_quant_row(v_row, 127.0)
+            kdq = _fake_quant_row(k_row, kv_dtype, n_head,
+                                  "head" if head_gran else "page")
+            vdq = _fake_quant_row(v_row, kv_dtype, n_head,
+                                  "head" if head_gran else "page")
             knew_scr[...] = kdq.astype(knew_scr.dtype)
             vnew_scr[...] = vdq.astype(vnew_scr.dtype)
         else:
@@ -459,7 +464,7 @@ def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
     def _accumulate():
         kpos = jax.lax.broadcasted_iota(jnp.int32, (psz, 1), 0) + p * psz
         if quantized:
-            ksc = ksp_ref[...]                                   # (psz, 1)
+            ksc = ksp_ref[...]           # (psz, 1) page / (psz, H) head
             vsc = vsp_ref[...]
         for i in range(H):
             sl = slice(i * D, (i + 1) * D)
@@ -469,8 +474,8 @@ def _paged_fused_kernel(tables_ref, pos_ref, x0_ref, ln1s_ref, ln1b_ref,
             kcf = kc.astype(jnp.float32)
             vcf = vc.astype(jnp.float32)
             if quantized:
-                kcf = kcf * ksc
-                vcf = vcf * vsc
+                kcf = kcf * (ksc[:, i:i + 1] if head_gran else ksc)
+                vcf = vcf * (vsc[:, i:i + 1] if head_gran else vsc)
             s = jnp.sum(kcf * q, axis=-1,
                         keepdims=True) * scale                   # (psz, 1)
             s = jnp.where(kpos < pos, s, NEG_INF)
@@ -529,20 +534,23 @@ def fused_paged_decode_layers(x0: jnp.ndarray,
     scatters the fresh K/V rows through the page tables (drop-routed
     for inactive slots), mirroring ``fused_decode_layers``'s
     attend-stale-then-write contract."""
+    from ..quant.kv import pool_quant_mode
     from .paged_pallas import clamped_live_page
     L, N, psz, C = cache["k"].shape
     H = cfg.n_head
     D = C // H
     B, mp = tables.shape
     cd = x0.dtype
-    quantized = "ks" in cache
+    kv_dtype, gran = pool_quant_mode(cache)
+    quantized = kv_dtype is not None
+    head_gran = gran == "head"
     w = {k: v.astype(cd) for k, v in blocks.items()}
     vec = lambda name: w[name].reshape(L, 1, -1)
     kernel = functools.partial(
         _paged_fused_kernel, n_layer=L, n_head=H, head_dim=D,
         page_size=psz, n_pages_per_slot=mp, eps=cfg.layernorm_eps,
         scale=D ** -0.5, activation=cfg.activation,
-        quantized=quantized)
+        quantized=quantized, kv_dtype=kv_dtype, head_gran=head_gran)
     lrow = lambda width: _vmem_spec((None, 1, width),
                                     lambda l, b, p, t, q: (l, 0, 0))
     lmat = lambda a, c: _vmem_spec((None, a, c),
@@ -581,12 +589,14 @@ def fused_paged_decode_layers(x0: jnp.ndarray,
               w["mlp_down_kernel"], vec("mlp_down_bias"),
               cache["k"], cache["v"]]
     if quantized:
-        # (L, N, psz) page-granularity scales -> (psz, 1) blocks per
-        # (layer, physical page), same fetch-skip index map as K/V
-        scale_spec = _vmem_spec((None, None, psz, 1), page_map)
+        # (L, N, psz) page-granularity scales -> (psz, 1) blocks, or
+        # packed head-granularity (L, N, psz, H) -> (psz, H) blocks,
+        # per (layer, physical page) on the same fetch-skip index map
+        swidth = H if head_gran else 1
+        scale_spec = _vmem_spec((None, None, psz, swidth), page_map)
         in_specs += [scale_spec, scale_spec]
-        inputs += [cache["ks"].reshape(L, N, psz, 1),
-                   cache["vs"].reshape(L, N, psz, 1)]
+        inputs += [cache["ks"].reshape(L, N, psz, swidth),
+                   cache["vs"].reshape(L, N, psz, swidth)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(L, B, mp),

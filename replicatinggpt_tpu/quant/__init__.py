@@ -76,6 +76,9 @@ class QuantConfig:
     kv_dtype: str = "none"        # paged KV page storage
     weight_dtype: str = "none"    # block matmul kernels
     granularity: str = "page"     # KV scale granularity (page | head)
+    act_dtype: str = "none"       # W8A8: activation rows into int8
+                                  # weight matmuls (int8 only; requires
+                                  # weight_dtype == "int8")
 
     def validate(self) -> None:
         if self.kv_dtype not in QUANT_DTYPES:
@@ -87,6 +90,13 @@ class QuantConfig:
         if self.granularity not in GRANULARITIES:
             raise ValueError(f"granularity must be one of "
                              f"{GRANULARITIES}, got {self.granularity!r}")
+        if self.act_dtype not in ("none", "int8"):
+            raise ValueError(f"act_dtype must be 'none' or 'int8', "
+                             f"got {self.act_dtype!r}")
+        if self.act_dtype == "int8" and self.weight_dtype != "int8":
+            raise ValueError(
+                "act_dtype='int8' (W8A8) requires weight_dtype='int8' — "
+                "activation quantization feeds the int8 weight matmuls")
 
     @property
     def kv_enabled(self) -> bool:
@@ -97,8 +107,12 @@ class QuantConfig:
         return self.weight_dtype != "none"
 
     @property
+    def act_enabled(self) -> bool:
+        return self.act_dtype != "none"
+
+    @property
     def enabled(self) -> bool:
-        return self.kv_enabled or self.weight_enabled
+        return self.kv_enabled or self.weight_enabled or self.act_enabled
 
 
 from .kv import (dequant_gathered, kv_itemsize, kv_qmax,  # noqa: E402

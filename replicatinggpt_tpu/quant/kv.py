@@ -144,6 +144,45 @@ def fake_quantize_row_f32(row: jnp.ndarray, qmax: float,
     return jnp.clip(jnp.round(f / s), -qmax, qmax) * s
 
 
+def _fake_quantize_span_f32(f: jnp.ndarray, kv_dtype: str,
+                            eps: float = SCALE_EPS) -> jnp.ndarray:
+    """One scale span (a whole row at page granularity, one head's
+    lanes at head granularity) through quantize -> dequantize in f32.
+    int8 values are integers within ±qmax — exact in f32, so the int
+    cast is skipped (value-identical, pinned in tests/test_quant.py);
+    fp8 keeps the ACTUAL saturating e4m3 cast round-trip, because e4m3
+    mantissa rounding is not representable as a round()/clip() in f32.
+    """
+    qmax = kv_qmax(kv_dtype)
+    s = jnp.maximum(jnp.max(jnp.abs(f)) / qmax, eps)
+    if kv_dtype == "int8":
+        return jnp.clip(jnp.round(f / s), -qmax, qmax) * s
+    q = jnp.clip(f / s, -qmax, qmax).astype(jnp.float8_e4m3fn)
+    return q.astype(jnp.float32) * s
+
+
+def fake_quantize_row_body(row: jnp.ndarray, kv_dtype: str, n_head: int,
+                           granularity: str,
+                           eps: float = SCALE_EPS) -> jnp.ndarray:
+    """Kernel-body form of :func:`fake_quantize_rows` for ONE (1, C)
+    row, any dtype x granularity — what the fused decode kernel applies
+    to its fresh K/V column in-kernel so the column attends exactly the
+    value the caller's quantize-on-write scatter will store. Head
+    granularity runs the span math per static head lane slice (the
+    kernels address heads as D-wide lane slices, so the python loop
+    unrolls to the same slices). Math is :func:`quantize_rows`'s at
+    f32 — pinned value-identical in tests/test_quant.py; change it
+    THERE and HERE together."""
+    f = row.astype(jnp.float32)
+    if granularity == "head":
+        D = f.shape[-1] // n_head
+        return jnp.concatenate(
+            [_fake_quantize_span_f32(f[:, i * D:(i + 1) * D], kv_dtype,
+                                     eps)
+             for i in range(n_head)], axis=-1)
+    return _fake_quantize_span_f32(f, kv_dtype, eps)
+
+
 def dequant_gathered(g: jnp.ndarray, s: jnp.ndarray, packed: bool,
                      n_head: int, cd) -> jnp.ndarray:
     """Dequantize a page-gathered view back to the compute dtype.

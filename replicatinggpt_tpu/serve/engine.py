@@ -87,6 +87,7 @@ with ``annotate()`` spans around the prefill and decode phases.
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -123,6 +124,8 @@ from .speculative import (DraftContext, Drafter, spec_accept_and_sample,
 #: per decision, capped at decode_window) so eagerness cannot overshoot.
 WINDOW_AUTOTUNE_INTERVAL = 8
 WINDOW_AUTOTUNE_HOST_FRAC = 0.05
+
+log = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -200,8 +203,14 @@ class EngineConfig:
                                 # f32 scale per written row, 'head' =
                                 # one per (row, head) — tighter for
                                 # outlier heads at H x the metadata
-                                # (head granularity routes the XLA
-                                # gather path; kernels are page-gran)
+                                # (both granularities dequant inside
+                                # the paged kernels)
+    act_quant: str = "none"     # W8A8: 'int8' quantizes activation
+                                # rows into the int8 weight matmuls
+                                # (requires weight_quant='int8';
+                                # models.gpt._wmm runs the contraction
+                                # int8 x int8 -> int32, dequanted by
+                                # the separable row x channel scales)
 
     @property
     def mesh_shape(self) -> tuple:
@@ -212,7 +221,8 @@ class EngineConfig:
         from ..quant import QuantConfig
         q = QuantConfig(kv_dtype=self.kv_quant,
                         weight_dtype=self.weight_quant,
-                        granularity=self.quant_granularity)
+                        granularity=self.quant_granularity,
+                        act_dtype=self.act_quant)
         q.validate()
         return q
 
@@ -251,6 +261,101 @@ class EngineConfig:
         compile). ONE definition, shared by the replay warmup and the
         worker's readiness warmup."""
         return 1 if self.decode_window <= 1 else 2 * self.decode_window + 2
+
+
+@dataclass(frozen=True)
+class KernelRoute:
+    """The per-engine kernel-route decision, computed ONCE at
+    construction (``decide_kernel_route``) and exported verbatim —
+    ``metrics_summary()['kernel_route']``, the
+    ``kernel_route_pallas`` Prometheus gauge and the serve bench
+    artifact all read this object, so "no XLA fallback" is observable,
+    not asserted.
+
+    ``route`` is the headline: "pallas" iff EVERY hot step of this
+    engine (decode windows, mixed prefill+decode windows, speculative
+    verify) runs the unified Pallas kernel family; "xla" otherwise,
+    with ``reasons`` naming each failed envelope check (the shared
+    ``ops.paged_pallas.paged_attention_envelope`` vocabulary plus the
+    engine-level gates below). ``decode`` distinguishes which decode
+    kernel won: "fused" (all layers, one launch per step) vs "pallas"
+    (per-layer windowed kernel) vs "xla"."""
+
+    route: str                    # "pallas" | "xla"
+    decode: str                   # "fused" | "pallas" | "xla"
+    window: str                   # mixed/verify windowed steps
+    sharded: bool                 # kernels run under shard_map
+    mesh: tuple                   # (data, model)
+    kv_quant: str
+    weight_quant: str
+    granularity: str
+    act_quant: str
+    reasons: tuple                # every failed gate ("" when pallas)
+
+    def summary(self) -> dict:
+        """The pinned ``metrics_summary()['kernel_route']`` schema."""
+        return {
+            "route": self.route,
+            "decode": self.decode,
+            "window": self.window,
+            "sharded": self.sharded,
+            "mesh": list(self.mesh),
+            "kv_quant": self.kv_quant,
+            "weight_quant": self.weight_quant,
+            "granularity": self.granularity,
+            "act_quant": self.act_quant,
+            "reasons": list(self.reasons),
+        }
+
+
+def decide_kernel_route(cfg: ModelConfig, ecfg: EngineConfig, qcfg,
+                        page_size: int, n_pages: int, itemsize: int,
+                        n_slots: int, mesh) -> KernelRoute:
+    """Route every engine step family onto the unified Pallas kernel
+    family, once, statically. The ONLY gates left are real envelope
+    limits (shape/VMEM/backend) and the explicit ``paged_kernel`` knob
+    — mixed windows, fp8/head-granularity pools, weight-quantized
+    params and >1 (data, model) meshes all route Pallas now (ISSUE 20;
+    the shard_map wrapper covers sharded engines when the pool
+    geometry divides, ``paged_kernel_mesh_ok``). The fused all-layers
+    kernel keeps its extra gates (packed weights streamed in-kernel:
+    1x1 mesh only, unquantized weights, VMEM weight budget) and wins
+    over the per-layer kernel when both fit."""
+    from ..ops import decode_pallas, paged_pallas
+    reasons = []
+    if not ecfg.paged_kernel:
+        reasons.append("paged_kernel_off")
+    if cfg.decode_cache_layout != "packed":
+        reasons.append("cache_layout")
+    if not paged_pallas._paged_attn_backend_ok():
+        reasons.append("backend")
+    ok_env, env_reasons = paged_pallas.paged_attention_envelope(
+        cfg.n_head, cfg.head_dim, page_size, itemsize=itemsize,
+        mesh=mesh, kv_quant=qcfg.kv_dtype, granularity=qcfg.granularity,
+        n_pages=n_pages)
+    reasons.extend(env_reasons)
+    base_ok = not reasons
+    use_fused = bool(
+        base_ok and not qcfg.weight_enabled
+        and decode_pallas.fused_paged_decode_supported(
+            cfg, n_slots, page_size, itemsize, mesh=mesh,
+            kv_quant=qcfg.kv_dtype, granularity=qcfg.granularity))
+    use_window = bool(base_ok and paged_pallas.mixed_step_kernel_ok(
+        cfg.n_head, cfg.head_dim, page_size, itemsize, mesh=mesh,
+        kv_quant=qcfg.kv_dtype, granularity=qcfg.granularity,
+        n_pages=n_pages))
+    decode = ("fused" if use_fused
+              else "pallas" if base_ok else "xla")
+    window = "pallas" if use_window else "xla"
+    route = "pallas" if (decode != "xla" and window != "xla") else "xla"
+    return KernelRoute(
+        route=route, decode=decode, window=window,
+        sharded=bool(mesh is not None and mesh.size > 1
+                     and decode != "xla"),
+        mesh=(ecfg.mesh_data, ecfg.mesh_model),
+        kv_quant=qcfg.kv_dtype, weight_quant=qcfg.weight_dtype,
+        granularity=qcfg.granularity, act_quant=qcfg.act_dtype,
+        reasons=tuple(reasons))
 
 
 @dataclass
@@ -380,13 +485,14 @@ def _engine_decode_window(params, tok, pos, active, budget, eos, life,
                                shardings=shardings)
 
 
-@partial(jax.jit, static_argnames=("cfg", "k", "shardings"),
+@partial(jax.jit, static_argnames=("cfg", "k", "use_kernel", "shardings"),
          donate_argnames=("tok", "pos", "active", "budget", "cache",
                           "rngs"))
 def _engine_mixed_window(params, tok, pos, active, budget, eos, life,
                          pfc, pf_toks, tables, cache, rngs,
                          temp, top_k, top_p, greedy, cfg: ModelConfig,
-                         k: int, shardings=None):
+                         k: int, use_kernel: bool = False,
+                         shardings=None):
     """The mixed steady-state program: ``models.gpt.mixed_window_paged``
     behind the same lifecycle merge, donation set and sampling closure
     as ``_engine_decode_window`` — dispatched instead of the pure decode
@@ -395,13 +501,16 @@ def _engine_mixed_window(params, tok, pos, active, budget, eos, life,
     cadence never breaks. One compiled program per window bucket (the
     prefill chunk width and pool shapes are static); the per-slot phase
     mask, chunk cursors and chunk payloads are all traced inputs, so
-    WHICH slots prefill and how much never retraces. Routes the XLA
-    gather path regardless of the paged-kernel knob — the fused/
-    per-layer Pallas kernels are single-token decode kernels
-    (ops/paged_pallas.mixed_step_kernel_ok is the seam a mixed-phase
-    kernel would flip). ``pfc`` packs the three (n_slots,) prefill
-    cursors — chunks-this-window / next write position / true prompt
-    length — into one (3, n_slots) upload, like ``life``."""
+    WHICH slots prefill and how much never retraces. ``use_kernel``
+    (STATIC; the engine gates it on
+    ``ops.paged_pallas.mixed_step_kernel_ok``) routes every step's
+    windowed forward through the unified paged Pallas kernel —
+    prefilling slots scatter chunk rows through their page tables and
+    decoding slots do the verify<->decode row math in the SAME launch
+    (the seam PR 12 documented, now flipped). ``pfc`` packs the three
+    (n_slots,) prefill cursors — chunks-this-window / next write
+    position / true prompt length — into one (3, n_slots) upload,
+    like ``life``."""
     tok, pos, active, budget = _merge_lifecycle(
         tok, pos, active, budget, life, shardings)
 
@@ -415,7 +524,7 @@ def _engine_mixed_window(params, tok, pos, active, budget, eos, life,
                               pfc[0], pfc[1], pfc[2], pf_toks,
                               tables, cache, rngs, cfg,
                               sample_fn=sample_fn, length=k,
-                              shardings=shardings)
+                              shardings=shardings, use_kernel=use_kernel)
 
 
 @partial(jax.jit, static_argnames=("cfg", "shardings"),
@@ -426,11 +535,11 @@ def _engine_prefill(params, chunk, offset, limit, table_row, cache,
                                cache, cfg, shardings=shardings)
 
 
-@partial(jax.jit, static_argnames=("cfg", "shardings"),
+@partial(jax.jit, static_argnames=("cfg", "use_kernel", "shardings"),
          donate_argnames=("cache", "rngs"))
 def _engine_verify(params, window, pos, m, active, tables, cache, rngs,
                    temp, top_k, top_p, greedy, cfg: ModelConfig,
-                   shardings=None):
+                   use_kernel: bool = False, shardings=None):
     """The speculative steady-state program: ONE target forward over a
     static (n_slots, k+1) window against the PAGED pool + per-position
     acceptance. Draft count k is carried by the window's static width,
@@ -445,7 +554,8 @@ def _engine_verify(params, window, pos, m, active, tables, cache, rngs,
     """
     logits, cache = verify_step_paged(params, window, pos, m, active,
                                       tables, cache, cfg,
-                                      shardings=shardings)
+                                      shardings=shardings,
+                                      use_kernel=use_kernel)
     m_eff = jnp.where(active, m, 0)
     n_acc, out, rngs = spec_accept_and_sample(rngs, logits, window, m_eff,
                                               temp, top_k, top_p, greedy)
@@ -606,13 +716,19 @@ class Engine:
         (``track_label`` prefixes the human-readable track names)."""
         cfg.validate()
         self.params = params
-        self.cfg = cfg
-        self.ecfg = ecfg
         # quantization (replicatinggpt_tpu/quant/): weight-side params
         # quantize HERE, before any mesh placement, unless the caller
         # handed in an already-quantized tree (a serialized calibration
         # applied at the CLI layer — quant/weights.py load_calibration)
         self.qcfg = ecfg.quant()
+        if self.qcfg.act_enabled and cfg.act_quant != self.qcfg.act_dtype:
+            # W8A8 threads through ModelConfig (models.gpt._wmm reads
+            # it) — replace() keeps the caller's cfg untouched; the
+            # field is part of the fleet shape hash via asdict(cfg)
+            import dataclasses as _dc
+            cfg = _dc.replace(cfg, act_quant=self.qcfg.act_dtype)
+        self.cfg = cfg
+        self.ecfg = ecfg
         if self.qcfg.weight_enabled:
             from ..quant.weights import quantize_params
             self.params = quantize_params(self.params,
@@ -690,42 +806,29 @@ class Engine:
         self._at_host = 0.0           # autotune accumulators: host
         self._at_wall = 0.0           # dispatch tax vs window wall time
         self._at_n = 0                # over windows since last decision
-        # Pallas paged-decode route: static per engine (one compiled
-        # program either way); packed layout + TPU backend + envelope.
-        # The FUSED all-layers kernel (one launch per decode step,
-        # page-table scalar-prefetch inside the layer loop) is
-        # preferred; the per-layer paged-attention kernel is the
-        # fallback when the layer weights don't fit its VMEM envelope.
-        from ..ops import decode_pallas, paged_pallas
+        # Kernel route: decided ONCE, statically, for every step family
+        # (decode windows, mixed prefill+decode windows, speculative
+        # verify) — decide_kernel_route() above; the decision is logged,
+        # exported through metrics_summary()["kernel_route"], and
+        # mirrored as the kernel_route_pallas Prometheus gauge. The
+        # FUSED all-layers kernel is preferred for pure decode; the
+        # per-layer windowed kernel (and its shard_map wrapper on a >1
+        # mesh) carries everything else.
         itemsize = jnp.dtype(self.pool.cache["k"].dtype).itemsize
-        # (the mesh AND quant gates live inside the two supported()
-        # calls below — ops.paged_pallas.paged_kernel_mesh_ok is the
-        # mesh seam; int8 page-granularity pools keep the kernels with
-        # in-kernel dequant, fp8/head-granularity route the XLA gather
-        # path. Weight-quantized params gate the kernels off entirely:
-        # their weight streams don't consume the per-channel scales —
-        # _wmm's fused dequant is an XLA-path construct.)
-        kernel_ok = (ecfg.paged_kernel
-                     and cfg.decode_cache_layout == "packed"
-                     and not self.qcfg.weight_enabled
-                     and paged_pallas._paged_attn_backend_ok())
-        self._use_fused = bool(
-            kernel_ok and decode_pallas.fused_paged_decode_supported(
-                cfg, P, self.pool.page_size, itemsize, mesh=self.mesh,
-                kv_quant=self.qcfg.kv_dtype,
-                granularity=self.qcfg.granularity))
-        self._use_pallas = bool(
-            kernel_ok and not self._use_fused
-            and paged_pallas.paged_decode_supported(
-                cfg.n_head, cfg.head_dim, self.pool.page_size, itemsize,
-                mesh=self.mesh, kv_quant=self.qcfg.kv_dtype,
-                granularity=self.qcfg.granularity))
-        # mixed prefill+decode windows route the XLA gather path no
-        # matter what the paged-kernel knob says: the Pallas kernels
-        # above are single-token decode kernels
-        # (ops/paged_pallas.mixed_step_kernel_ok documents the seam a
-        # mixed-phase Sarathi-style fused kernel would flip — wiring it
-        # means adding use_pallas-style routing to _engine_mixed_window)
+        self.kernel_route = decide_kernel_route(
+            cfg, ecfg, self.qcfg, self.pool.page_size,
+            self.pool.cache["k"].shape[1], itemsize, P, self.mesh)
+        self._use_fused = self.kernel_route.decode == "fused"
+        self._use_pallas = self.kernel_route.decode == "pallas"
+        self._use_window_kernel = self.kernel_route.window == "pallas"
+        self.metrics.gauge("kernel_route_pallas",
+                           1.0 if self.kernel_route.route == "pallas"
+                           else 0.0)
+        log.info("kernel route: %s (decode=%s window=%s sharded=%s%s)",
+                 self.kernel_route.route, self.kernel_route.decode,
+                 self.kernel_route.window, self.kernel_route.sharded,
+                 (" reasons=" + ",".join(self.kernel_route.reasons)
+                  if self.kernel_route.reasons else ""))
         self._tok = np.zeros((P,), np.int32)
         # ALIAS of pool.positions (one host buffer): the pool exposes the
         # committed frontier to drafters, the engine advances it in place
@@ -1274,6 +1377,9 @@ class Engine:
         s["step_latency"] = self.step_timer.summary(skip=1)
         s["n_steps"] = self.n_steps
         s["compile_counts"] = compile_counts()
+        # kernel-route decision: static per engine, schema pinned in
+        # tests/test_pages.py (bench serve artifacts carry it verbatim)
+        s["kernel_route"] = self.kernel_route.summary()
         s["compile_guards"] = {"decode": self._decode_guard.stats(),
                                "mixed": self._mixed_guard.stats(),
                                "prefill": self._prefill_guard.stats(),
@@ -1645,7 +1751,8 @@ class Engine:
                 jnp.zeros((3, P), jnp.int32),
                 jnp.zeros((k, P, self._chunk), jnp.int32),
                 tables_d, cache, rngs, *sample,
-                self.cfg, k=k, shardings=self._plan)
+                self.cfg, k=k, use_kernel=self._use_window_kernel,
+                shardings=self._plan)
             _, _, t_, p_, a_, b_, cache, rngs = out
             state = (t_, p_, a_, b_)
         self.pool.cache = cache
@@ -1753,6 +1860,7 @@ class Engine:
                 jnp.asarray(pfc), jnp.asarray(pf_toks),
                 tables_d, self.pool.cache, self._rngs,
                 temp_d, top_k_d, top_p_d, greedy_d, self.cfg, k=k,
+                use_kernel=self._use_window_kernel,
                 shardings=self._plan)
             for slot in pf:
                 slot = int(slot)
@@ -2025,6 +2133,7 @@ class Engine:
                 self._rngs, jnp.asarray(self._temp),
                 jnp.asarray(self._top_k), jnp.asarray(self._top_p),
                 jnp.asarray(self._greedy), self.cfg,
+                use_kernel=self._use_window_kernel,
                 shardings=self._plan)
             self.step_timer.lap(n_acc)
         self.pool.cache = cache

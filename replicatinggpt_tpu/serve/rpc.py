@@ -187,7 +187,8 @@ def engine_shape_hash(mcfg, ecfg) -> str:
                     # different KV/weight precision is a DIFFERENT
                     # model numerically — mismatched fleets must
                     # reject at registration, never mix streams
-                    "kv_quant", "weight_quant", "quant_granularity")},
+                    "kv_quant", "weight_quant", "quant_granularity",
+                    "act_quant")},
     }
     return hashlib.sha256(
         json.dumps(doc, sort_keys=True).encode()).hexdigest()[:16]

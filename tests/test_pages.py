@@ -573,8 +573,15 @@ def test_metrics_summary_key_schema(params):
     s = eng.metrics_summary()
     for key in ("counters", "gauges", "histograms", "step_latency",
                 "n_steps", "compile_counts", "compile_guards", "recovery",
-                "pages"):
+                "pages", "kernel_route"):
         assert key in s, key
+    # kernel-route decision (ISSUE 20): static per engine; the bench
+    # serve artifact carries this block verbatim
+    assert set(s["kernel_route"]) == {
+        "route", "decode", "window", "sharded", "mesh", "kv_quant",
+        "weight_quant", "granularity", "act_quant", "reasons"}
+    assert s["kernel_route"]["route"] in ("pallas", "xla")
+    assert "kernel_route_pallas" in s["gauges"]
     assert set(s["compile_counts"]) == {
         "decode", "mixed", "prefill", "verify", "page_copy",
         "page_export", "page_install", "draft_decode", "draft_prefill"}

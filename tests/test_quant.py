@@ -408,23 +408,28 @@ def test_quantized_kernel_routes_greedy_parity(params, monkeypatch):
     assert got2 == want
 
 
-def test_kernel_envelopes_gate_quant_modes(params):
-    """fp8 pools, head-granularity scales and weight-quantized params
-    all route the XLA gather path — the kernels' envelopes say no
-    (documented seams, decided once per engine)."""
+def test_kernel_envelopes_accept_every_quant_mode(params):
+    """ISSUE 20 flips the old seams: fp8 pools and head-granularity
+    scales dequant INSIDE the unified kernel family now, so the
+    envelopes accept every shipped (kv_quant, granularity) cell —
+    decided once per engine and exported via kernel_route."""
     from replicatinggpt_tpu.ops.decode_pallas import (
         fused_paged_decode_supported)
     from replicatinggpt_tpu.ops.paged_pallas import paged_decode_supported
     cfg = dataclasses.replace(CFG, n_embd=64,
                               decode_cache_layout="packed")
-    assert fused_paged_decode_supported(cfg, 2, 8, 1, kv_quant="int8")
+    for kvq in ("none", "int8", "fp8"):
+        for gran in ("page", "head"):
+            assert fused_paged_decode_supported(cfg, 2, 8, 1,
+                                                kv_quant=kvq,
+                                                granularity=gran), \
+                (kvq, gran)
+            assert paged_decode_supported(2, 32, 8, 1, kv_quant=kvq,
+                                          granularity=gran), (kvq, gran)
+    # unknown modes still gate (the reasons vocabulary stays honest)
+    assert not paged_decode_supported(2, 32, 8, 1, kv_quant="int4")
     assert not fused_paged_decode_supported(cfg, 2, 8, 1,
-                                            kv_quant="fp8")
-    assert not fused_paged_decode_supported(cfg, 2, 8, 1,
-                                            kv_quant="int8",
-                                            granularity="head")
-    assert paged_decode_supported(2, 32, 8, 1, kv_quant="int8")
-    assert not paged_decode_supported(2, 32, 8, 1, kv_quant="fp8")
+                                            granularity="token")
 
 
 # ---------------------------------------------------------------------------
@@ -518,6 +523,9 @@ def test_shape_hash_covers_quant_knobs():
     assert engine_shape_hash(
         CFG, EngineConfig(kv_quant="int8", quant_granularity="head")) \
         != engine_shape_hash(CFG, EngineConfig(kv_quant="int8"))
+    assert engine_shape_hash(
+        CFG, EngineConfig(weight_quant="int8", act_quant="int8")) \
+        != engine_shape_hash(CFG, EngineConfig(weight_quant="int8"))
     assert engine_shape_hash(CFG, EngineConfig()) == base
 
 
@@ -531,15 +539,17 @@ def test_cli_forwards_quant_flags():
                                         engine_forward_args)
     p = argparse.ArgumentParser()
     add_engine_flags(p)
-    args = p.parse_args(["--kv-quant", "int8", "--weight-quant", "fp8",
-                         "--quant-granularity", "head"])
+    args = p.parse_args(["--kv-quant", "int8", "--weight-quant", "int8",
+                         "--quant-granularity", "head",
+                         "--act-quant", "int8"])
     fwd = engine_forward_args(args)
     assert "--kv-quant" in fwd and "int8" in fwd
     args2 = p.parse_args(fwd)
     e1, e2 = (engine_config_from_args(a) for a in (args, args2))
     assert e1 == e2
-    assert e1.kv_quant == "int8" and e1.weight_quant == "fp8"
+    assert e1.kv_quant == "int8" and e1.weight_quant == "int8"
     assert e1.quant_granularity == "head"
+    assert e1.act_quant == "int8"
 
 
 def test_prometheus_carries_quant_gauges(params, tmp_path):

@@ -63,6 +63,22 @@ timeout -s INT --kill-after=60 1800 python bench.py --mode fleet \
   --disagg --fleet-replicas 4 --kv-quant int8 \
   > benchmarks/BENCH_fleet_disagg_ab_int8.json 2>> "$LOG"
 echo "=== fleet-disagg-ab-int8 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+# unified-kernel rows (ISSUE 20): every shipped config through the ONE
+# Pallas kernel family — the artifact's kernel_route block must read
+# route == "pallas" with empty reasons on each row
+timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
+  --paged-kernel --kv-quant int8 --serve-storm-trace \
+  > benchmarks/BENCH_serve_kernel_1x1.json 2>> "$LOG"
+echo "=== serve-kernel-1x1 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
+  --paged-kernel --kv-quant int8 --mesh-shape 2x2 --serve-storm-trace \
+  > benchmarks/BENCH_serve_kernel_2x2.json 2>> "$LOG"
+echo "=== serve-kernel-2x2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+timeout -s INT --kill-after=60 1800 python bench.py --mode serve \
+  --paged-kernel --kv-quant int8 --quant-granularity head \
+  --serve-prefix-trace \
+  > benchmarks/BENCH_serve_kernel_headgran.json 2>> "$LOG"
+echo "=== serve-kernel-headgran rc=$? $(date -u +%FT%TZ)" >> "$LOG"
 mkdir -p benchmarks/converged_gpt2
 timeout -s INT --kill-after=60 5400 python -m replicatinggpt_tpu train \
   --preset gpt2-large --dataset datasets/shakespeare.txt \
